@@ -1,0 +1,126 @@
+"""paddle_tpu: a TPU-native deep-learning framework with a paddle-shaped API.
+
+Built from scratch on jax/XLA/Pallas/pjit (see SURVEY.md for the reference
+architecture map this replaces).  The compute path is XLA end-to-end: eager
+ops dispatch one jnp call each; ``@to_static``/Model.fit trace whole steps
+into single fused HLO modules; distribution is mesh + shardings over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+import jax as _jax
+
+# int64/float64 semantics parity with the reference (paddle defaults labels
+# and index tensors to int64).  Model code stays float32/bf16; f64 on TPU is
+# a user error surfaced by XLA, same as the reference on most GPU kernels.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from .framework import dtypes as _dtypes
+from .framework.state import get_default_dtype, set_default_dtype  # noqa: F401
+from .framework.random import seed, get_rng_state, set_rng_state  # noqa: F401
+
+# dtype aliases: paddle_tpu.float32 etc.
+import numpy as _np
+import jax.numpy as _jnp
+
+bool = _jnp.bool_  # noqa: A001
+uint8 = _jnp.uint8
+int8 = _jnp.int8
+int16 = _jnp.int16
+int32 = _jnp.int32
+int64 = _jnp.int64
+float16 = _jnp.float16
+bfloat16 = _jnp.bfloat16
+float32 = _jnp.float32
+float64 = _jnp.float64
+complex64 = _jnp.complex64
+complex128 = _jnp.complex128
+
+from .tensor import *  # noqa: F401,F403 — Tensor, Parameter + full op surface
+from .tensor import Tensor, Parameter  # noqa: F401
+from .tensor import linalg  # noqa: F401 — paddle.linalg namespace
+
+from .flags import set_flags, get_flags  # noqa: F401
+from .device import (  # noqa: F401
+    set_device, get_device, is_compiled_with_tpu, is_compiled_with_cuda,
+    is_compiled_with_xpu, is_compiled_with_rocm, is_compiled_with_custom_device,
+    CPUPlace, TPUPlace, Place,
+)
+
+from .autograd import no_grad, enable_grad, set_grad_enabled, grad, is_grad_enabled  # noqa: F401
+
+# subpackages loaded lazily so partial builds stay importable
+import importlib as _importlib
+
+_LAZY = ("nn", "optimizer", "amp", "io", "metric", "jit", "static", "vision",
+         "distributed", "autograd", "device", "framework", "hapi", "profiler",
+         "incubate", "ops", "parallel", "utils", "models", "sparse", "signal", "fft")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = _importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name == "Model":
+        from .hapi import Model as M
+
+        globals()["Model"] = M
+        return M
+    if name in ("save", "load"):
+        from .framework import io as _io
+
+        globals()["save"], globals()["load"] = _io.save, _io.load
+        return globals()[name]
+    if name == "DataParallel":
+        from .distributed.parallel import DataParallel as DP
+
+        globals()["DataParallel"] = DP
+        return DP
+    if name == "summary":
+        from .hapi import summary as s
+
+        globals()["summary"] = s
+        return s
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def disable_static(place=None):
+    """API compat: this framework is always 'dygraph by default'."""
+    return None
+
+
+def enable_static():
+    from . import static as _static
+
+    _static._STATIC_MODE[0] = True
+
+
+def in_dynamic_mode():
+    from . import static as _static
+
+    return not _static._STATIC_MODE[0]
+
+
+def get_cudnn_version():
+    return None
+
+
+def device_count():
+    import jax
+
+    return len(jax.devices())
+
+
+def is_grad_enabled():  # re-exported via autograd too
+    from .framework import state
+
+    return state.grad_enabled()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    from .tensor import creation
+
+    return creation.to_tensor(data, dtype, place, stop_gradient)

@@ -1,0 +1,141 @@
+"""Autograd public API (reference: python/paddle/autograd/)."""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..framework import state as _state
+from .tape import backward, grad, Node  # noqa: F401
+
+
+class no_grad(contextlib.ContextDecorator):
+    """Context manager / decorator disabling gradient recording."""
+
+    def __enter__(self):
+        self._prev = _state.set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        _state.set_grad_enabled(self._prev)
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _state.set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        _state.set_grad_enabled(self._prev)
+        return False
+
+
+class set_grad_enabled(contextlib.ContextDecorator):
+    def __init__(self, mode: bool):
+        self._mode = mode
+        self._prev = _state.set_grad_enabled(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _state.set_grad_enabled(self._prev)
+        return False
+
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled()
+
+
+class PyLayerContext:
+    """ctx passed to PyLayer.forward/backward (reference:
+    python/paddle/autograd/py_layer.py)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.attrs = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined autograd function; the TPU-native analog wires the user's
+    backward as a custom VJP node on the eager tape (reference PyLayer records
+    a GradNodePyLayer).  Subclass and define static ``forward(ctx, ...)`` and
+    ``backward(ctx, *grads)``.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..tensor.tensor import Tensor
+        from .tape import Node
+        import jax.numpy as jnp
+
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+
+        tracked = [a for a in args if isinstance(a, Tensor) and not a.stop_gradient]
+        if _state.grad_enabled() and tracked:
+            # wrap the user's backward as the node function's vjp via
+            # jax.custom_vjp so the standard tape machinery applies
+            import jax
+
+            t_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor) and not a.stop_gradient]
+
+            @jax.custom_vjp
+            def fwd_fn(*tvals):
+                return tuple(o._value for o in outs) if multi else outs[0]._value
+
+            def fwd_rule(*tvals):
+                return fwd_fn(*tvals), None
+
+            def bwd_rule(_, cts):
+                g = cts if multi else (cts,)
+                gt = cls.backward(ctx, *[Tensor(c) for c in g])
+                if not isinstance(gt, (tuple, list)):
+                    gt = (gt,)
+                vals = []
+                for x in gt:
+                    vals.append(x._value if isinstance(x, Tensor) else x)
+                # align to tracked inputs only
+                if len(vals) == len(args):
+                    vals = [vals[i] for i in t_idx]
+                return tuple(
+                    v if v is not None else jnp.zeros_like(args[i]._value)
+                    for v, i in zip(vals, t_idx)
+                )
+
+            fwd_fn.defvjp(fwd_rule, bwd_rule)
+
+            new_outs = []
+            for o in outs:
+                t = Tensor(o._value, stop_gradient=False)
+                new_outs.append(t)
+            node = Node(fwd_fn, [args[i] for i in t_idx], {}, new_outs, name=cls.__name__)
+            for t in new_outs:
+                t._grad_node = node
+            return tuple(new_outs) if multi else new_outs[0]
+        return out

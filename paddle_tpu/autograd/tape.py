@@ -1,0 +1,227 @@
+"""Eager autograd: an op tape whose backward runs per-node ``jax.vjp``.
+
+Reference analog: the eager engine (paddle/fluid/eager/) — codegen'd
+GradNodes recorded per op, topologically executed by backward.cc.  The
+TPU-native rebuild records, per differentiable eager op, the *pure jax
+function* that produced the outputs plus its tensor inputs; ``backward``
+walks the graph in reverse topological order calling ``jax.vjp`` on each
+node's function.  No per-op grad kernels exist anywhere — jax derives them.
+
+This is the correctness path for eager mode.  The performance path is
+``@to_static``/Model.fit, which traces the whole step and takes ``jax.grad``
+of the fused program (see paddle_tpu.jit) — there the tape is bypassed
+entirely, exactly like the reference collapses dygraph into a static Program.
+
+Note: per-node ``jax.vjp`` re-executes that node's forward (linearization),
+so eager backward costs ~2x forward compute.  The reference pays an
+analogous cost in per-op grad-kernel launches; under jit both collapse into
+one fused XLA program.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Any, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class Node:
+    """One recorded differentiable op.
+
+    fn: pure function (jax arrays -> jax array or tuple of arrays)
+    inputs: the op's positional args; Tensors are tracked, rest are consts
+    kwargs: non-tensor keyword args (closed over at vjp time)
+    outputs: weakrefs to produced Tensors (tuple ops have several)
+    """
+
+    __slots__ = ("fn", "inputs", "kwargs", "outputs", "name", "__weakref__")
+
+    def __init__(self, fn, inputs: Sequence[Any], kwargs: dict, outputs, name: str = ""):
+        self.fn = fn
+        self.inputs = list(inputs)
+        self.kwargs = kwargs
+        self.outputs = [weakref.ref(o) for o in outputs]
+        self.name = name or getattr(fn, "__name__", "op")
+
+    def tensor_inputs(self):
+        from ..tensor.tensor import Tensor
+
+        return [(i, t) for i, t in enumerate(self.inputs) if isinstance(t, Tensor) and not t.stop_gradient]
+
+
+def _topo_from(root_node) -> List[Node]:
+    """Reverse-postorder (iterative; eager graphs can be deep)."""
+    order, seen = [], set()
+    stack = [(root_node, False)]
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for _, t in node.tensor_inputs():
+            child = t._grad_node
+            if child is not None and id(child) not in seen:
+                stack.append((child, False))
+    return order  # children before parents; iterate reversed for backward
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False, into=None):
+    """Run backward from ``tensors`` (paddle.autograd.backward semantics).
+
+    Accumulates ``.grad`` on every reachable leaf tensor with
+    ``stop_gradient=False``.  Non-leaf grads are kept only if the tensor
+    called ``retain_grads()``.  If ``into`` (a dict) is given, grads are
+    written there keyed by ``id(tensor)`` instead of touching ``.grad`` —
+    used by :func:`grad` so it has no side effects on other leaves.
+    """
+    from ..tensor.tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # cotangent accumulator keyed by tensor identity
+    cts: dict[int, Any] = {}
+    keep: dict[int, Tensor] = {}  # keep tensors alive during walk
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError("backward() on a tensor with stop_gradient=True")
+        seed = g._value if isinstance(g, Tensor) else (g if g is not None else jnp.ones_like(t._value))
+        cts[id(t)] = cts.get(id(t), 0) + seed
+        keep[id(t)] = t
+        if t._grad_node is not None:
+            roots.append(t._grad_node)
+
+    # merged topological order over all roots
+    order, seen = [], set()
+    for r in roots:
+        for n in _topo_from(r):
+            if id(n) not in seen:
+                seen.add(id(n))
+                order.append(n)
+
+    _run_nodes(order, cts, keep)
+
+    # store accumulated grads on leaves (and retain_grads tensors), once
+    for tid, t in keep.items():
+        if tid not in cts:
+            continue
+        is_leaf = t._grad_node is None
+        if (is_leaf and not t.stop_gradient) or getattr(t, "_retain_grads", False):
+            g = cts[tid]
+            if into is not None:
+                into[tid] = into[tid] + g if tid in into else g
+            elif t.grad is None:
+                t.grad = Tensor(g, stop_gradient=True)
+            else:
+                t.grad = Tensor(t.grad._value + g, stop_gradient=True)
+
+
+def _run_nodes(order, cts, keep):
+    """Execute vjps parents-first; accumulate cotangents into ``cts``."""
+    from ..tensor.tensor import Tensor
+
+    for node in reversed(order):
+        outs = [r() for r in node.outputs]
+        out_cts = []
+        have_any = False
+        for o in outs:
+            if o is not None and id(o) in cts:
+                out_cts.append(cts[id(o)])
+                have_any = True
+            else:
+                out_cts.append(None)
+        if not have_any:
+            continue
+
+        tin = node.tensor_inputs()
+        if not tin:
+            continue
+        idxs = [i for i, _ in tin]
+        tvals = [t._value for _, t in tin]
+
+        def primal(*vs, _node=node, _idxs=idxs):
+            args = list(_node.inputs)
+            for i, v in zip(_idxs, vs):
+                args[i] = v
+            args = [a._value if isinstance(a, Tensor) else a for a in args]
+            return _node.fn(*args, **_node.kwargs)
+
+        primal_out, vjp_fn = jax.vjp(primal, *tvals)
+        if isinstance(primal_out, (tuple, list)):
+            ct = tuple(
+                c if c is not None else _zero_cotangent(po)
+                for c, po in zip(out_cts, primal_out)
+            )
+        else:
+            ct = out_cts[0]
+        in_cts = vjp_fn(ct)
+
+        for (_, t), g in zip(tin, in_cts):
+            tid = id(t)
+            keep[tid] = t
+            cts[tid] = cts[tid] + g if tid in cts else g
+
+
+def _zero_cotangent(po):
+    """Zero cotangent matching jax.vjp's contract: float0 for non-inexact
+    primal outputs (e.g. topk's index output)."""
+    import numpy as np
+
+    if hasattr(po, "dtype") and jnp.issubdtype(po.dtype, jnp.inexact):
+        return jnp.zeros_like(po)
+    return np.zeros(jnp.shape(po), dtype=jax.dtypes.float0)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
+         only_inputs=True, allow_unused=False):
+    """paddle.grad: return grads of ``outputs`` w.r.t. ``inputs`` with NO
+    side effects on any tensor's ``.grad`` (grads flow into a private sink).
+    ``create_graph`` (double grad) is not yet supported on the eager tape —
+    compose ``jax.grad`` via jit/to_static for higher-order derivatives.
+    """
+    from ..tensor.tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported on the eager tape; "
+            "use jit/to_static + jax.grad composition instead")
+    single_in = isinstance(inputs, Tensor)
+    inputs = [inputs] if single_in else list(inputs)
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+
+    retains = []
+    for t in inputs:
+        if t._grad_node is not None and not getattr(t, "_retain_grads", False):
+            t._retain_grads = True
+            retains.append(t)
+    sink: dict = {}
+    try:
+        backward(outputs, grad_outputs, retain_graph=retain_graph, into=sink)
+        results = []
+        for t in inputs:
+            g = sink.get(id(t))
+            if g is None:
+                if not allow_unused:
+                    raise RuntimeError("an input tensor is unused in the graph (allow_unused=False)")
+                results.append(None)
+            else:
+                results.append(Tensor(g, stop_gradient=True))
+    finally:
+        for t in retains:
+            t._retain_grads = False
+    return results[0] if single_in else results

@@ -1,0 +1,92 @@
+"""Typed, env-overridable global flag registry.
+
+TPU-native analog of the reference's gflags-style ``FLAGS_*`` system
+(reference: paddle/utils/flags.h, phi/core/flags.cc — ~300 C++ gflags settable
+via env or ``paddle.set_flags``).  Here flags are a plain typed registry:
+values come from (highest priority first) ``set_flags()`` calls, environment
+variables named ``FLAGS_<name>``, then the registered default.  XLA-level
+knobs are intentionally NOT mirrored here — they pass through ``XLA_FLAGS``
+to the compiler, which is the idiomatic TPU channel.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict
+
+
+class _Flag:
+    __slots__ = ("name", "default", "type", "help", "_value", "_set")
+
+    def __init__(self, name: str, default: Any, typ: Callable, help: str = ""):
+        self.name = name
+        self.default = default
+        self.type = typ
+        self.help = help
+        self._value = None
+        self._set = False
+
+    def get(self):
+        if self._set:
+            return self._value
+        env = os.environ.get("FLAGS_" + self.name)
+        if env is not None:
+            return self._parse(env)
+        return self.default
+
+    def set(self, value):
+        self._value = self._parse(value)
+        self._set = True
+
+    def _parse(self, value):
+        if self.type is bool and isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return self.type(value)
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default: Any, help: str = "", type: Callable | None = None):
+    """Register a flag. ``type`` defaults to ``type(default)``."""
+    typ = type or (default.__class__ if default is not None else str)
+    _REGISTRY[name] = _Flag(name, default, typ, help)
+    return _REGISTRY[name]
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    """Return {name: value}. ``names`` may be a str, list of str, or None (=all)."""
+    if names is None:
+        names = list(_REGISTRY)
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        if n not in _REGISTRY:
+            raise ValueError(f"unknown flag {n!r}")
+        out[n] = _REGISTRY[n].get()
+    return out
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Set flags from a dict, e.g. ``set_flags({'check_nan_inf': True})``."""
+    for k, v in flags.items():
+        if k not in _REGISTRY:
+            raise ValueError(f"unknown flag {k!r}")
+        _REGISTRY[k].set(v)
+
+
+def get_flag(name: str):
+    return _REGISTRY[name].get()
+
+
+# Core flags (the subset of the reference's ~300 that have TPU meaning).
+define_flag("check_nan_inf", False, "check outputs of every op for nan/inf (debug)")
+define_flag("cudnn_deterministic", False, "kept for API compat; XLA on TPU is deterministic by default")
+define_flag("paddle_tpu_default_matmul_precision", "default",
+            "jax matmul precision: default|high|highest")
+define_flag("use_donated_buffers", True, "donate input buffers in compiled train steps")
+define_flag("allocator_strategy", "xla", "API compat; memory is owned by the XLA runtime")
+define_flag("eager_delete_tensor_gb", 0.0, "API compat no-op; XLA owns memory")
+define_flag("init_allocated_mem", False, "API compat no-op")
+define_flag("benchmark", False, "block on every op for timing (eager mode)")

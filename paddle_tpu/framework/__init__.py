@@ -1,0 +1,4 @@
+"""Framework internals: state, dtypes, RNG, IO."""
+
+from . import dtypes, random, state  # noqa: F401
+from .state import get_default_dtype, set_default_dtype  # noqa: F401

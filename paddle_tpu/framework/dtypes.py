@@ -1,0 +1,57 @@
+"""Dtype names and promotion helpers.
+
+The reference exposes dtypes as ``paddle.float32`` etc. (VarType enum in
+paddle/fluid/framework/framework.proto; phi DataType).  Here a dtype IS a
+jax/numpy dtype; the paddle-style names are aliases, so tensors interoperate
+with jnp directly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical name -> jnp dtype
+_NAMED = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_ALIASES = {"float": "float32", "double": "float64", "half": "float16", "int": "int32", "long": "int64"}
+
+
+def canonical_name(d) -> str:
+    """'float32', np.float32, jnp.float32, paddle_tpu.float32 -> 'float32'."""
+    if d is None:
+        from .state import get_default_dtype
+
+        return get_default_dtype()
+    if isinstance(d, str):
+        d = _ALIASES.get(d, d)
+        if d not in _NAMED:
+            raise ValueError(f"unknown dtype {d!r}")
+        return d
+    return np.dtype(d).name if np.dtype(d).name in _NAMED else jnp.dtype(d).name
+
+
+def to_jax(d):
+    """Any dtype spec -> jnp dtype."""
+    return _NAMED[canonical_name(d)]
+
+
+def is_floating(d) -> bool:
+    return jnp.issubdtype(to_jax(d), jnp.floating)
+
+
+def is_integer(d) -> bool:
+    return jnp.issubdtype(to_jax(d), jnp.integer)

@@ -1,0 +1,64 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py).
+
+State dicts (nested dict/list of Tensors) serialize via pickle with tensors
+converted to numpy — same portability contract as the reference's pickled
+``.pdparams``.  Large-scale sharded/async checkpointing lives in
+``paddle_tpu.io.checkpoint`` (orbax-backed); this is the simple single-host
+path.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..tensor.tensor import Tensor, Parameter
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._value)
+        # bfloat16 has no native numpy dtype portable via pickle on all
+        # platforms; store as (dtype_str, raw_bytes, shape)
+        return {"__tensor__": True, "dtype": str(obj._value.dtype),
+                "data": arr.view(np.uint16) if str(obj._value.dtype) == "bfloat16" else arr,
+                "param": isinstance(obj, Parameter)}
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_serializable(v) for v in obj)
+    return obj
+
+
+def _from_serializable(obj):
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            data = obj["data"]
+            if obj["dtype"] == "bfloat16":
+                data = data.view(ml_dtypes.bfloat16)
+            v = jnp.asarray(data)
+            return Parameter(v) if obj.get("param") else Tensor(v)
+        return {k: _from_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_serializable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_serializable(obj)

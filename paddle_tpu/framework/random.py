@@ -1,0 +1,95 @@
+"""RNG: stateful host key for eager mode + scoped keys for compiled code.
+
+Reference analog: the global Generator (paddle/phi/core/generator.cc) seeded
+by ``paddle.seed`` and consulted by every random kernel; plus Fleet's
+``get_rng_state_tracker`` for tensor-parallel-aware dropout
+(fleet/meta_parallel/parallel_layers/random.py).
+
+TPU-native design:
+- Eager ops call :func:`next_key` which splits a host-side key — fully
+  reproducible via ``paddle_tpu.seed``.
+- Compiled train steps open an :func:`rng_scope` with a per-step key (derived
+  from seed + step counter); random ops inside the trace then consume splits
+  of THAT key, so the mask is a traced value, fresh each step, not a baked
+  constant.
+- The TP-aware tracker maps to :func:`fold_in_axis`: fold the mesh-axis index
+  into the key so tensor-parallel ranks get distinct (or deliberately equal)
+  dropout masks.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_global_key = jax.random.key(0)
+_seed_value = 0
+
+_scope = threading.local()
+
+
+def seed(s: int):
+    """Set the global seed (paddle.seed equivalent). Returns None."""
+    global _global_key, _seed_value
+    with _lock:
+        _seed_value = int(s)
+        _global_key = jax.random.key(int(s))
+
+
+def get_seed() -> int:
+    return _seed_value
+
+
+def next_key():
+    """Return a fresh PRNG key.
+
+    Inside an :func:`rng_scope` (compiled code path) keys are split from the
+    scoped key; otherwise from the stateful global key.
+    """
+    stack = getattr(_scope, "stack", None)
+    if stack:
+        key, n = stack[-1]
+        sub = jax.random.fold_in(key, n)
+        stack[-1] = (key, n + 1)
+        return sub
+    global _global_key
+    with _lock:
+        _global_key, sub = jax.random.split(_global_key)
+    return sub
+
+
+@contextlib.contextmanager
+def rng_scope(key):
+    """Thread an explicit key for random ops (use inside jit-traced steps)."""
+    if not hasattr(_scope, "stack"):
+        _scope.stack = []
+    _scope.stack.append((key, 0))
+    try:
+        yield
+    finally:
+        _scope.stack.pop()
+
+
+def in_rng_scope() -> bool:
+    return bool(getattr(_scope, "stack", None))
+
+
+def fold_in_axis(key, axis_name: str):
+    """TP-aware RNG: fold the mesh axis index into ``key`` so each rank on
+    ``axis_name`` draws an independent stream (Fleet RNGStatesTracker analog).
+    Only valid inside shard_map/pjit where ``axis_name`` is bound."""
+    return jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+
+
+def get_rng_state():
+    """Return opaque RNG state (the current key)."""
+    return _global_key
+
+
+def set_rng_state(state):
+    global _global_key
+    with _lock:
+        _global_key = state

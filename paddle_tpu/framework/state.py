@@ -1,0 +1,73 @@
+"""Global framework state: default dtype, grad mode, device, RNG.
+
+This is the TPU-native replacement for the reference's scattered global state
+(paddle/fluid/framework tracer state, phi DeviceContextPool, the global
+generator in paddle/phi/core/generator.cc).  Everything here is host-side
+Python state; device state lives in XLA.
+
+RNG design (TPU-first): JAX PRNG is functional (threaded keys), while the
+paddle API is stateful (``paddle.seed``).  We keep a host-side stateful key
+that is split on every eager random op.  Inside traced/compiled code a split
+of a *concrete* key would bake a constant mask into the program, so compiled
+training steps thread an explicit per-step key via ``rng_scope`` — see
+``paddle_tpu.framework.random``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "init"):
+        _state.init = True
+        _state.grad_enabled = True
+        _state.default_dtype = "float32"
+        _state.amp_state = None  # set by paddle_tpu.amp.auto_cast
+    return _state
+
+
+# ---------------------------------------------------------------- grad mode
+def grad_enabled() -> bool:
+    return _tls().grad_enabled
+
+
+def set_grad_enabled(mode: bool) -> bool:
+    prev = _tls().grad_enabled
+    _tls().grad_enabled = bool(mode)
+    return prev
+
+
+@contextlib.contextmanager
+def no_grad_ctx():
+    prev = set_grad_enabled(False)
+    try:
+        yield
+    finally:
+        set_grad_enabled(prev)
+
+
+# ------------------------------------------------------------ default dtype
+def get_default_dtype() -> str:
+    return _tls().default_dtype
+
+
+def set_default_dtype(d) -> None:
+    from . import dtypes
+
+    _tls().default_dtype = dtypes.canonical_name(d)
+
+
+# ------------------------------------------------------------------- AMP
+def amp_state():
+    """Current auto_cast state or None. See paddle_tpu.amp."""
+    return _tls().amp_state
+
+
+def set_amp_state(s):
+    prev = _tls().amp_state
+    _tls().amp_state = s
+    return prev

@@ -1,0 +1,152 @@
+"""Tensor package: assembles the op surface and attaches methods/operators
+onto Tensor (the reference does this via generated pybind methods in
+paddle/fluid/pybind/eager_method.cc + python/paddle/tensor/__init__.py's
+``tensor_method_func`` monkey-patch list — same idea, pure Python here)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import Tensor, Parameter
+from .dispatch import apply, unwrap
+from . import creation, math, manipulation, logic, linalg, search, random, stat, attribute, einsum as _einsum_mod
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .attribute import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+
+# linalg is exposed as a namespace (paddle.linalg.*) plus a few top-level names
+from .linalg import norm, dist  # noqa: F401
+
+
+def t(x, name=None):  # paddle.t — 2-D transpose
+    nd = x.ndim if isinstance(x, Tensor) else jnp.ndim(unwrap(x))
+    if nd > 2:
+        raise ValueError("paddle.t only supports ndim<=2; use transpose")
+    return manipulation.transpose(x, [1, 0]) if nd == 2 else (x.clone() if isinstance(x, Tensor) else Tensor(x))
+
+
+def cast(x, dtype):
+    return x.astype(dtype) if isinstance(x, Tensor) else Tensor(x).astype(dtype)
+
+
+def numel(x, name=None):
+    return attribute.numel(x)
+
+
+# ---------------------------------------------------------------- operators
+def _binop(fn, reverse=False):
+    def op(self, other):
+        if reverse:
+            return apply(lambda b, a: fn(a, b), self, other, op_name=fn.__name__)
+        return apply(fn, self, other, op_name=fn.__name__)
+
+    return op
+
+
+Tensor.__add__ = _binop(jnp.add)
+Tensor.__radd__ = _binop(jnp.add, True)
+Tensor.__sub__ = _binop(jnp.subtract)
+Tensor.__rsub__ = _binop(jnp.subtract, True)
+Tensor.__mul__ = _binop(jnp.multiply)
+Tensor.__rmul__ = _binop(jnp.multiply, True)
+Tensor.__truediv__ = _binop(jnp.divide)
+Tensor.__rtruediv__ = _binop(jnp.divide, True)
+Tensor.__floordiv__ = _binop(jnp.floor_divide)
+Tensor.__rfloordiv__ = _binop(jnp.floor_divide, True)
+Tensor.__mod__ = _binop(jnp.mod)
+Tensor.__rmod__ = _binop(jnp.mod, True)
+Tensor.__pow__ = _binop(jnp.power)
+Tensor.__rpow__ = _binop(jnp.power, True)
+Tensor.__matmul__ = _binop(jnp.matmul)
+Tensor.__rmatmul__ = _binop(jnp.matmul, True)
+Tensor.__and__ = _binop(jnp.bitwise_and)
+Tensor.__or__ = _binop(jnp.bitwise_or)
+Tensor.__xor__ = _binop(jnp.bitwise_xor)
+Tensor.__lshift__ = _binop(jnp.left_shift)
+Tensor.__rshift__ = _binop(jnp.right_shift)
+Tensor.__neg__ = lambda self: apply(jnp.negative, self, op_name="neg")
+Tensor.__pos__ = lambda self: self
+Tensor.__abs__ = lambda self: apply(jnp.abs, self, op_name="abs")
+Tensor.__invert__ = lambda self: apply(jnp.bitwise_not, self, op_name="invert")
+Tensor.__eq__ = lambda self, o: logic.equal(self, o)
+Tensor.__ne__ = lambda self, o: logic.not_equal(self, o)
+Tensor.__lt__ = lambda self, o: logic.less_than(self, o)
+Tensor.__le__ = lambda self, o: logic.less_equal(self, o)
+Tensor.__gt__ = lambda self, o: logic.greater_than(self, o)
+Tensor.__ge__ = lambda self, o: logic.greater_equal(self, o)
+
+# ---------------------------------------------------------------- methods
+_METHOD_SOURCES = [math, manipulation, logic, linalg, search, stat, attribute, creation]
+
+_METHOD_NAMES = [
+    # math
+    "abs", "acos", "asin", "atan", "acosh", "asinh", "atanh", "ceil", "cos", "cosh",
+    "exp", "expm1", "floor", "log", "log2", "log10", "log1p", "reciprocal", "round",
+    "rsqrt", "sign", "sin", "sinh", "sqrt", "square", "tan", "tanh", "erf", "erfinv",
+    "digamma", "lgamma", "trunc", "frac", "angle", "conj", "real", "imag", "neg",
+    "sigmoid", "deg2rad", "rad2deg", "exp2",
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "logaddexp", "hypot",
+    "heaviside", "copysign", "nextafter", "ldexp", "gcd", "lcm", "bitwise_and",
+    "bitwise_or", "bitwise_xor", "bitwise_not", "logical_and", "logical_or",
+    "logical_xor", "logical_not", "inner", "outer", "kron", "cross",
+    "scale", "clip", "lerp", "stanh", "sum", "mean", "prod", "max", "min", "amax",
+    "amin", "nansum", "nanmean", "logsumexp", "all", "any", "count_nonzero",
+    "cumsum", "cumprod", "cummax", "cummin", "matmul", "mm", "bmm", "dot", "mv",
+    "addmm", "diff", "trace", "isfinite", "isinf", "isnan", "nan_to_num", "inverse",
+    "floor_mod",
+    # manipulation
+    "reshape", "reshape_", "flatten", "transpose", "moveaxis", "swapaxes", "squeeze",
+    "unsqueeze", "squeeze_", "unsqueeze_", "split", "chunk", "tensor_split", "slice",
+    "expand", "expand_as", "broadcast_to", "tile", "repeat_interleave", "flip",
+    "rot90", "roll", "gather", "gather_nd", "take_along_axis", "put_along_axis",
+    "scatter", "scatter_", "scatter_nd_add", "index_select", "index_sample",
+    "index_add", "index_put", "take", "masked_select", "masked_fill",
+    "masked_scatter", "where", "nonzero", "pad", "unbind", "unique",
+    "unique_consecutive", "as_real", "as_complex", "unstack", "view", "view_as",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than", "less_equal",
+    "equal_all", "allclose", "isclose", "is_empty", "isin",
+    # linalg
+    "norm", "dist", "det", "slogdet", "inv", "pinv", "solve", "cholesky",
+    "cholesky_solve", "qr", "svd", "eig", "eigh", "eigvals", "eigvalsh",
+    "matrix_power", "lu", "lstsq", "cond",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
+    "searchsorted", "bucketize", "index_fill", "histogram", "bincount",
+    # stat
+    "var", "std", "median", "nanmedian", "quantile", "nanquantile",
+    # creation
+    "diag", "diagflat", "tril", "triu",
+]
+
+
+def _attach_methods():
+    for name in _METHOD_NAMES:
+        fn = None
+        for mod in _METHOD_SOURCES:
+            fn = getattr(mod, name, None)
+            if fn is not None:
+                break
+        if fn is None:
+            raise RuntimeError(f"tensor method source missing for {name!r}")
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+    # extras under different names
+    Tensor.einsum = lambda self, eq, *others: _einsum_mod.einsum(eq, self, *others)
+    Tensor.t = t
+    Tensor.rank = lambda self: self.ndim
+    Tensor.exponential_ = random.exponential_
+    Tensor.normal_ = random.normal_
+    Tensor.uniform_ = random.uniform_
+    Tensor.bernoulli_ = random.bernoulli_
+
+
+_attach_methods()

@@ -1,0 +1,37 @@
+"""Tensor attribute ops (reference: python/paddle/tensor/attribute.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dispatch import unwrap
+from .tensor import Tensor
+
+
+def shape(input):
+    """paddle.shape: returns a 1-D int tensor (dynamic-friendly under trace)."""
+    return Tensor(jnp.asarray(jnp.shape(unwrap(input)), dtype=jnp.int64))
+
+
+def rank(input):
+    return Tensor(jnp.asarray(jnp.ndim(unwrap(input)), dtype=jnp.int64))
+
+
+def numel(x, name=None):
+    v = unwrap(x)
+    n = 1
+    for s in v.shape:
+        n *= s
+    return Tensor(jnp.asarray(n, dtype=jnp.int64))
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.integer)
+
+
+def is_complex(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.complexfloating)

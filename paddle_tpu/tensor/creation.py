@@ -1,0 +1,163 @@
+"""Creation ops (reference: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as _dt
+from ..framework import state as _state
+from .dispatch import apply, unwrap
+from .tensor import Tensor
+
+# this module defines paddle ops named `complex` etc. that shadow builtins
+_PY_SCALARS = (bool, int, float, complex)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor: numpy dtype preserved; python floats -> default dtype."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._value, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    if dtype is None:
+        if isinstance(data, _PY_SCALARS) or (
+            isinstance(data, (list, tuple)) and _all_py_scalars(data)
+        ):
+            arr = np.asarray(data)
+            if arr.dtype == np.float64:
+                dtype = _state.get_default_dtype()
+    v = jnp.asarray(np.asarray(data) if not isinstance(data, jax.Array) else data,
+                    dtype=_dt.to_jax(dtype) if dtype is not None else None)
+    t = Tensor(v, stop_gradient=stop_gradient)
+    if place is not None:
+        t = t._to_device(f"{place.kind}:{place.index}" if hasattr(place, "kind") else str(place))
+    return t
+
+
+def _all_py_scalars(x):
+    if isinstance(x, (list, tuple)):
+        return all(_all_py_scalars(i) for i in x)
+    return isinstance(x, _PY_SCALARS)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape._value)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), dtype=_dt.to_jax(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_list(shape), dtype=_dt.to_jax(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fv = unwrap(fill_value)
+    if dtype is None and isinstance(fill_value, (bool, int)):
+        dtype = "int64" if isinstance(fill_value, int) and not isinstance(fill_value, bool) else "bool"
+    return Tensor(jnp.full(_shape_list(shape), fv, dtype=_dt.to_jax(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(unwrap(x), dtype=_dt.to_jax(dtype) if dtype else None))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(unwrap(x), dtype=_dt.to_jax(dtype) if dtype else None))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(unwrap(x), unwrap(fill_value),
+                                dtype=_dt.to_jax(dtype) if dtype else None))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        py_ints = all(isinstance(v, (int, np.integer)) or
+                      (hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.integer))
+                      for v in (start, end, step))
+        dtype = "int64" if py_ints else _state.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=_dt.to_jax(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               dtype=_dt.to_jax(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)), base=base,
+                               dtype=_dt.to_jax(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt.to_jax(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    v = unwrap(x)
+    if v.ndim == 1 and padding_value != 0:
+        d = jnp.diag(v, k=offset)
+        mask = jnp.eye(d.shape[0], dtype=bool) if offset == 0 else (jnp.diag(jnp.ones_like(v), k=offset) != 0)
+        return apply(lambda vv: jnp.where(mask, jnp.diag(vv, k=offset), padding_value), x, op_name="diag")
+    return apply(lambda vv: jnp.diag(vv, k=offset), x, op_name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda v: jnp.diagflat(v, k=offset), x, op_name="diagflat")
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.tril(v, k=diagonal), x, op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.triu(v, k=diagonal), x, op_name="triu")
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [unwrap(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return [Tensor(m) for m in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def assign(x, output=None):
+    v = jnp.asarray(unwrap(x))
+    if output is not None:
+        output._value = v.astype(output.dtype) if output._value.shape == v.shape else v
+        return output
+    return Tensor(v)
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):
+    return apply(lambda r, i: r + 1j * i, real, imag, op_name="complex")
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(_dt.to_jax(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = jnp.triu_indices(row, k=offset, m=col or row)
+    return Tensor(jnp.stack([r, c]).astype(_dt.to_jax(dtype)))

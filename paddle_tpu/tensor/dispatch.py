@@ -1,0 +1,79 @@
+"""Eager op dispatch: pure jax function -> tape-recorded Tensor op.
+
+TPU-native replacement for the reference's dispatch chain
+(pybind eager_op_function -> phi/api kernel selection -> KernelFactory ->
+device kernel, see SURVEY.md §3.1).  Here there is exactly one step: every
+op is a pure function over jax arrays; ``apply`` executes it via jax (which
+dispatches to XLA:TPU) and records a tape Node when grad is required.
+Under a jax trace (to_static) the same functions trace transparently.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..framework import state
+from ..autograd.tape import Node
+from .. import flags as _flags
+
+
+def unwrap(x):
+    from .tensor import Tensor
+
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _any_tracked(args) -> bool:
+    from .tensor import Tensor
+
+    return any(isinstance(a, Tensor) and not a.stop_gradient for a in args)
+
+
+def apply(fn, *args, op_name: str = "", n_outs: int = 1, **kwargs):
+    """Run ``fn`` on unwrapped args; wrap output(s); record tape node.
+
+    ``args`` may contain Tensors (tracked) and constants.  ``kwargs`` must be
+    non-tensor (static) arguments.  Multi-output ops pass n_outs>1 (or return
+    a tuple and pass n_outs=None to infer).
+    """
+    from .tensor import Tensor
+
+    vals = [unwrap(a) for a in args]
+    out_val = fn(*vals, **kwargs)
+
+    if _flags.get_flag("check_nan_inf"):
+        _check_nan_inf(out_val, op_name or getattr(fn, "__name__", "op"))
+
+    multi = isinstance(out_val, (tuple, list))
+    outs_v = list(out_val) if multi else [out_val]
+    track = state.grad_enabled() and _any_tracked(args)
+    outs = [
+        Tensor(v, stop_gradient=not (track and _is_float(v)))
+        for v in outs_v
+    ]
+    if track:
+        diff_outs = [o for o in outs if not o.stop_gradient]
+        if diff_outs:
+            node = Node(fn, args, kwargs, outs, name=op_name)
+            for o in outs:
+                if not o.stop_gradient:
+                    o._grad_node = node
+    return tuple(outs) if multi else outs[0]
+
+
+def _is_float(v) -> bool:
+    try:
+        return jnp.issubdtype(v.dtype, jnp.floating) or jnp.issubdtype(v.dtype, jnp.complexfloating)
+    except Exception:
+        return False
+
+
+def _check_nan_inf(val, name):
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(val):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            if bool(jnp.any(~jnp.isfinite(leaf))):
+                raise FloatingPointError(f"nan/inf in output of op '{name}' (FLAGS_check_nan_inf)")

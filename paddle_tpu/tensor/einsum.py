@@ -1,0 +1,12 @@
+"""einsum (reference: python/paddle/tensor/einsum.py) — direct jnp.einsum,
+which XLA lowers onto the MXU as batched matmuls."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dispatch import apply
+
+
+def einsum(equation, *operands):
+    return apply(lambda *vs: jnp.einsum(equation, *vs), *operands, op_name="einsum")

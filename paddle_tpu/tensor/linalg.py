@@ -1,0 +1,196 @@
+"""Linear algebra (reference: python/paddle/tensor/linalg.py + paddle.linalg).
+
+Dense linalg maps onto jnp.linalg (XLA custom calls on TPU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .dispatch import apply, unwrap
+from .tensor import Tensor
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(v):
+        if axis is None and p is None:
+            return jnp.linalg.norm(v.reshape(-1))
+        pp = 2 if p is None or p == "fro" else p
+        if axis is None:
+            return jnp.linalg.norm(v.reshape(-1), ord=pp, keepdims=keepdim)
+        if isinstance(axis, (list, tuple)):
+            return jnp.linalg.norm(v, ord="fro" if p in (None, "fro") else p,
+                                   axis=tuple(axis), keepdims=keepdim)
+        if pp == float("inf"):
+            return jnp.max(jnp.abs(v), axis=axis, keepdims=keepdim)
+        if pp == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=axis, keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=axis, keepdims=keepdim)
+        return jnp.sum(jnp.abs(v) ** pp, axis=axis, keepdims=keepdim) ** (1.0 / pp)
+
+    return apply(fn, x, op_name="norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply(lambda v: jnp.linalg.norm(v, ord=p, axis=tuple(axis), keepdims=keepdim),
+                 x, op_name="matrix_norm")
+
+
+def dist(x, y, p=2, name=None):
+    return apply(lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), x, y, op_name="dist")
+
+
+def cond(x, p=None, name=None):
+    return Tensor(jnp.linalg.cond(unwrap(x), p=p))
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x, op_name="det")
+
+
+def slogdet(x, name=None):
+    def fn(v):
+        sign, logd = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logd])
+
+    return apply(fn, x, op_name="slogdet")
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, x, op_name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), x, op_name="pinv")
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y, op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(a, b, lower=not upper, trans=1 if transpose else 0,
+                                                 unit_diagonal=unitriangular)
+
+    return apply(fn, x, y, op_name="triangular_solve")
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return apply(fn, x, op_name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return apply(fn, x, y, op_name="cholesky_solve")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    v = unwrap(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(v)
+    outs = (Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1))
+    if get_infos:
+        return outs + (Tensor(jnp.zeros((), jnp.int32)),)
+    return outs
+
+
+def qr(x, mode="reduced", name=None):
+    def fn(v):
+        q, r = jnp.linalg.qr(v, mode=mode)
+        return q, r
+
+    if mode == "r":
+        return Tensor(jnp.linalg.qr(unwrap(x), mode="r"))
+    return apply(fn, x, op_name="qr")
+
+
+def svd(x, full_matrices=False, name=None):
+    def fn(v):
+        u, s, vh = jnp.linalg.svd(v, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2)  # paddle returns V not V^H
+
+    return apply(fn, x, op_name="svd")
+
+
+def svdvals(x, name=None):
+    return apply(lambda v: jnp.linalg.svd(v, compute_uv=False), x, op_name="svdvals")
+
+
+def eig(x, name=None):
+    w, v = jnp.linalg.eig(unwrap(x))
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    def fn(v):
+        return jnp.linalg.eigh(v, UPLO=UPLO)
+
+    return apply(fn, x, op_name="eigh")
+
+
+def eigvals(x, name=None):
+    return Tensor(jnp.linalg.eigvals(unwrap(x)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x, op_name="eigvalsh")
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda v: jnp.linalg.matrix_power(v, n), x, op_name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(unwrap(x), rtol=tol))
+
+
+def multi_dot(x, name=None):
+    return apply(lambda *vs: jnp.linalg.multi_dot(vs), *list(x), op_name="multi_dot")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(unwrap(x), unwrap(y), rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return Tensor(jnp.corrcoef(unwrap(x), rowvar=rowvar))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return Tensor(jnp.cov(unwrap(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                          fweights=unwrap(fweights), aweights=unwrap(aweights)))
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.where(jnp.arange(m) < i, 0, a[..., :, i])
+            v = v.at[i].set(1.0)
+            q = q - t[i] * jnp.outer(q @ v, v)
+        return q
+
+    return apply(fn, x, tau, op_name="householder_product")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    v = unwrap(x)
+    if center:
+        v = v - v.mean(axis=0, keepdims=True)
+    u, s, vt = jnp.linalg.svd(v, full_matrices=False)
+    k = q or min(v.shape)
+    return Tensor(u[:, :k]), Tensor(s[:k]), Tensor(vt[:k].T)

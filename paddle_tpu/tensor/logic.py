@@ -1,0 +1,51 @@
+"""Comparison / logic ops (reference: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .dispatch import apply, unwrap
+from .tensor import Tensor
+
+
+def _cmp(fn, name):
+    def op(x, y, name=None):
+        return apply(fn, x, y, op_name=_n)
+
+    _n = name
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(lambda a, b: jnp.equal(a, b), "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(unwrap(x), unwrap(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 x, y, op_name="isclose")
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(unwrap(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply(lambda a, b: jnp.isin(a, b, invert=invert), x, test_x, op_name="isin")

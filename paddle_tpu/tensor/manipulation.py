@@ -1,0 +1,500 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as _dt
+from .dispatch import apply, unwrap
+from .tensor import Tensor
+
+
+def _ints(x):
+    if isinstance(x, Tensor):
+        return [int(i) for i in np.asarray(x._value)]
+    if isinstance(x, (int, np.integer)):
+        return [int(x)]
+    return [int(i._value) if isinstance(i, Tensor) else int(i) for i in x]
+
+
+def reshape(x, shape, name=None):
+    s = _ints(shape)
+    return apply(lambda v: jnp.reshape(v, s), x, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace_from(reshape(x._snapshot(), shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim if isinstance(x, Tensor) else jnp.ndim(unwrap(x))
+    sa = start_axis % nd if nd else 0
+    so = stop_axis % nd if nd else 0
+
+    def fn(v):
+        shape = v.shape[:sa] + (-1,) + v.shape[so + 1:]
+        return jnp.reshape(v, shape)
+
+    return apply(fn, x, op_name="flatten")
+
+
+def transpose(x, perm, name=None):
+    p = _ints(perm)
+    return apply(lambda v: jnp.transpose(v, p), x, op_name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda v: jnp.moveaxis(v, source, destination), x, op_name="moveaxis")
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply(lambda v: jnp.swapaxes(v, axis1, axis2), x, op_name="swapaxes")
+
+
+def squeeze(x, axis=None, name=None):
+    ax = None if axis is None else tuple(a for a in _ints(axis)
+                                         if unwrap(x).shape[a] == 1)
+
+    def fn(v):
+        return jnp.squeeze(v, axis=ax)
+
+    return apply(fn, x, op_name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _ints(axis)
+
+    def fn(v):
+        out = v
+        for a in sorted(a if a >= 0 else a + out.ndim + 1 for a in ax):
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return apply(fn, x, op_name="unsqueeze")
+
+
+squeeze_ = squeeze
+unsqueeze_ = unsqueeze
+
+
+def concat(x, axis=0, name=None):
+    ax = int(unwrap(axis)) if not isinstance(axis, int) else axis
+    tensors = list(x)
+
+    def fn(*vs):
+        return jnp.concatenate(vs, axis=ax)
+
+    return apply(fn, *tensors, op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+
+    def fn(*vs):
+        return jnp.stack(vs, axis=axis)
+
+    return apply(fn, *tensors, op_name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or unwrap(x).shape[axis]
+
+    def fn(v):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(v, n, axis=axis))
+
+    return list(apply(fn, x, op_name="unstack"))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(unwrap(axis)) if not isinstance(axis, int) else axis
+    v = unwrap(x)
+    dim = v.shape[ax]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dim {dim} on axis {ax} is not divisible by {num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sec = _ints(num_or_sections)
+        rem = dim - sum(s for s in sec if s > 0)
+        sizes = [s if s > 0 else rem for s in sec]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def fn(vv):
+        return tuple(jax.lax.slice_in_dim(vv, o, o + s, axis=ax) for o, s in zip(offsets, sizes))
+
+    return list(apply(fn, x, op_name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    v = unwrap(x)
+    parts = jnp.array_split(v, num_or_indices if isinstance(num_or_indices, int) else _ints(num_or_indices), axis=axis)
+    sizes = [p.shape[axis] for p in parts]
+    offs = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def fn(vv):
+        return tuple(jax.lax.slice_in_dim(vv, o, o + s, axis=axis) for o, s in zip(offs, sizes))
+
+    return list(apply(fn, x, op_name="tensor_split"))
+
+
+def slice(x, axes, starts, ends, name=None):
+    axes, starts, ends = _ints(axes), _ints(starts), _ints(ends)
+
+    def fn(v):
+        out = v
+        for ax, st, en in zip(axes, starts, ends):
+            n = out.shape[ax]
+            st_ = max(st + n, 0) if st < 0 else min(st, n)
+            en_ = max(en + n, 0) if en < 0 else min(en, n)
+            out = jax.lax.slice_in_dim(out, st_, en_, axis=ax)
+        return out
+
+    return apply(fn, x, op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = _ints(axes), _ints(starts), _ints(ends), _ints(strides)
+
+    # NB: builtins.slice — this module defines a paddle `slice` op above
+    def fn2(v):
+        import builtins
+
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(st, en, sd)
+        return v[tuple(idx)]
+
+    return apply(fn2, x, op_name="strided_slice")
+
+
+def expand(x, shape, name=None):
+    s = _ints(shape)
+
+    def fn(v):
+        tgt = [v.shape[i - (len(s) - v.ndim)] if d == -1 else d for i, d in enumerate(s)]
+        return jnp.broadcast_to(v, tgt)
+
+    return apply(fn, x, op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    tgt = tuple(unwrap(y).shape)
+    return apply(lambda v: jnp.broadcast_to(v, tgt), x, op_name="expand_as")
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(unwrap(i).shape) for i in inputs]
+    tgt = np.broadcast_shapes(*shapes)
+    return [apply(lambda v: jnp.broadcast_to(v, tgt), i, op_name="broadcast_tensors") for i in inputs]
+
+
+def tile(x, repeat_times, name=None):
+    r = _ints(repeat_times)
+    return apply(lambda v: jnp.tile(v, r), x, op_name="tile")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    rep = unwrap(repeats)
+    return apply(lambda v: jnp.repeat(v, rep, axis=axis), x, op_name="repeat_interleave")
+
+
+def flip(x, axis, name=None):
+    ax = _ints(axis) if not isinstance(axis, int) else [axis]
+    return apply(lambda v: jnp.flip(v, axis=tuple(ax)), x, op_name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x, op_name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = shifts if isinstance(shifts, int) else tuple(_ints(shifts))
+    ax = axis if axis is None or isinstance(axis, int) else tuple(_ints(axis))
+    return apply(lambda v: jnp.roll(v, sh, axis=ax), x, op_name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(unwrap(axis)) if not isinstance(axis, int) else axis
+    return apply(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=ax), x, index, op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def fn(v, idx):
+        idx = idx.astype(jnp.int32)
+        return v[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return apply(fn, x, index, op_name="gather_nd")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply(lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=axis),
+                 arr, indices, op_name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def fn(v, i, val):
+        i = i.astype(jnp.int32)
+        val = jnp.broadcast_to(val, i.shape).astype(v.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, i, val, axis=axis, inplace=False)
+        dnums = None
+        out = v
+        # scatter-style reduce via at[] on advanced index grid
+        idx = list(jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing="ij"))
+        idx[axis] = i
+        if reduce == "add":
+            return out.at[tuple(idx)].add(val)
+        if reduce in ("mul", "multiply"):
+            return out.at[tuple(idx)].multiply(val)
+        raise ValueError(f"unsupported reduce {reduce!r}")
+
+    return apply(fn, arr, indices, values, op_name="put_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(v, i, u):
+        i = i.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return v.at[i].set(u.astype(v.dtype))
+        return v.at[i].add(u.astype(v.dtype))
+
+    return apply(fn, x, index, updates, op_name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._inplace_from(scatter(x._snapshot(), index, updates, overwrite))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def fn(i, u):
+        i = i.astype(jnp.int32)
+        z = jnp.zeros(_ints(shape), dtype=u.dtype)
+        return z.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply(fn, index, updates, op_name="scatter_nd")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(v, i, u):
+        i = i.astype(jnp.int32)
+        return v.at[tuple(jnp.moveaxis(i, -1, 0))].add(u.astype(v.dtype))
+
+    return apply(fn, x, index, updates, op_name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=axis), x, index,
+                 op_name="index_select")
+
+
+def index_sample(x, index):
+    def fn(v, i):
+        i = i.astype(jnp.int32)
+        rows = jnp.arange(v.shape[0])[:, None]
+        return v[rows, i]
+
+    return apply(fn, x, index, op_name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(v, i, val):
+        i = i.astype(jnp.int32)
+        vm = jnp.moveaxis(v, axis, 0)
+        valm = jnp.moveaxis(val, axis, 0)
+        out = vm.at[i].add(valm.astype(v.dtype))
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply(fn, x, index, value, op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(unwrap(i) for i in indices)
+
+    def fn(v, val):
+        if accumulate:
+            return v.at[idx].add(val.astype(v.dtype))
+        return v.at[idx].set(val.astype(v.dtype))
+
+    return apply(fn, x, value, op_name="index_put")
+
+
+def take(x, index, mode="raise", name=None):
+    def fn(v, i):
+        i = i.astype(jnp.int32)
+        flat = v.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            i = jnp.mod(i, n)
+        elif mode == "clip":
+            i = jnp.clip(i, 0, n - 1)
+        else:
+            i = jnp.where(i < 0, i + n, i)
+        return flat[i]
+
+    return apply(fn, x, index, op_name="take")
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: host-side (not jittable) — paddle semantics
+    v, m = unwrap(x), unwrap(mask)
+    return Tensor(v[np.asarray(m).astype(bool)])
+
+
+def masked_fill(x, mask, value, name=None):
+    return apply(lambda v, m: jnp.where(m.astype(bool), jnp.asarray(unwrap(value), v.dtype), v),
+                 x, mask, op_name="masked_fill")
+
+
+def masked_scatter(x, mask, value, name=None):
+    v, m, val = unwrap(x), np.asarray(unwrap(mask)).astype(bool), unwrap(value)
+    out = np.asarray(v).copy()
+    out[m] = np.asarray(val).reshape(-1)[: int(m.sum())]
+    return Tensor(jnp.asarray(out))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c.astype(bool), a, b), condition, x, y, op_name="where")
+
+
+def nonzero(x, as_tuple=False):
+    v = np.asarray(unwrap(x))
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    p = _ints(pad)
+
+    def fn(v):
+        nd = v.ndim
+        if len(p) == 2 * nd:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle semantics: pad applies to last len(p)//2 spatial dims,
+            # format-dependent for NCHW/NHWC conv-style pads
+            k = len(p) // 2
+            width = [(0, 0)] * nd
+            if data_format in ("NCHW", "NCL", "NCDHW"):
+                dims = list(range(nd - k, nd))
+            else:
+                dims = list(range(1, 1 + k))
+            # paddle orders pad pairs starting from the LAST spatial dim? No:
+            # F.pad pads [left,right,top,bottom,...] over dims reversed-last.
+            for j, d in enumerate(reversed(dims)):
+                width[d] = (p[2 * j], p[2 * j + 1])
+        if mode == "constant":
+            return jnp.pad(v, width, mode="constant", constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        return jnp.pad(v, width, mode=jmode)
+
+    return apply(fn, x, op_name="pad")
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis=axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    v = np.asarray(unwrap(x))
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    v = np.asarray(unwrap(x))
+    if axis is None:
+        v = v.reshape(-1)
+        change = np.concatenate([[True], v[1:] != v[:-1]])
+    else:
+        raise NotImplementedError("unique_consecutive with axis")
+    out = v[change]
+    rets = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        rets.append(Tensor(jnp.asarray(np.cumsum(change) - 1)))
+    if return_counts:
+        idx = np.flatnonzero(change)
+        rets.append(Tensor(jnp.asarray(np.diff(np.append(idx, v.size)))))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def as_real(x, name=None):
+    return apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x, op_name="as_real")
+
+
+def as_complex(x, name=None):
+    return apply(lambda v: v[..., 0] + 1j * v[..., 1], x, op_name="as_complex")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    s = _ints(shape)
+    o = _ints(offsets) if offsets is not None else [0] * len(s)
+
+    def fn(v):
+        tgt = [v.shape[i] if d == -1 else d for i, d in enumerate(s)]
+        return jax.lax.dynamic_slice(v, o, tgt)
+
+    return apply(fn, x, op_name="crop")
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return apply(lambda v: v.view(_dt.to_jax(shape_or_dtype)), x, op_name="view")
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply(jnp.atleast_1d, i, op_name="atleast_1d") for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply(jnp.atleast_2d, i, op_name="atleast_2d") for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply(jnp.atleast_3d, i, op_name="atleast_3d") for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def hstack(x, name=None):
+    return apply(lambda *vs: jnp.hstack(vs), *list(x), op_name="hstack")
+
+
+def vstack(x, name=None):
+    return apply(lambda *vs: jnp.vstack(vs), *list(x), op_name="vstack")
+
+
+def dstack(x, name=None):
+    return apply(lambda *vs: jnp.dstack(vs), *list(x), op_name="dstack")
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def column_stack(x, name=None):
+    return apply(lambda *vs: jnp.column_stack(vs), *list(x), op_name="column_stack")

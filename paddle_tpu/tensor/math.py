@@ -1,0 +1,321 @@
+"""Math ops (reference: python/paddle/tensor/math.py — largest op module).
+
+All ops are thin differentiable wrappers over jnp/lax; XLA fuses chains of
+them into single TPU kernels, which is why there is no hand-written fusion
+layer here (the reference's phi/kernels/fusion/ has no analog by design).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtypes as _dt
+from .dispatch import apply, unwrap
+from .tensor import Tensor
+
+_mod = __import__(__name__)
+
+
+# ---------------------------------------------------------------- helpers
+def _axis(a):
+    if a is None:
+        return None
+    if isinstance(a, Tensor):
+        a = a.tolist()
+    if isinstance(a, (list, tuple)):
+        return tuple(int(x) for x in a)
+    return int(a)
+
+
+def _make_unary(name, fn):
+    def op(x, name=None, **kw):
+        return apply(fn, x, op_name=name_, **kw)
+
+    name_ = name
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"Elementwise {name} (maps to jnp.{getattr(fn, '__name__', name)})."
+    return op
+
+
+def _make_binary(name, fn):
+    def op(x, y, name=None):
+        return apply(fn, x, y, op_name=name_)
+
+    name_ = name
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+_UNARY = {
+    "abs": jnp.abs, "acos": jnp.arccos, "asin": jnp.arcsin, "atan": jnp.arctan,
+    "acosh": jnp.arccosh, "asinh": jnp.arcsinh, "atanh": jnp.arctanh,
+    "ceil": jnp.ceil, "cos": jnp.cos, "cosh": jnp.cosh, "exp": jnp.exp,
+    "expm1": jnp.expm1, "floor": jnp.floor, "log": jnp.log, "log2": jnp.log2,
+    "log10": jnp.log10, "log1p": jnp.log1p, "reciprocal": lambda x: 1.0 / x,
+    "round": jnp.round, "rsqrt": jax.lax.rsqrt, "sign": jnp.sign,
+    "sin": jnp.sin, "sinh": jnp.sinh, "sqrt": jnp.sqrt, "square": jnp.square,
+    "tan": jnp.tan, "tanh": jnp.tanh, "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv, "digamma": jax.scipy.special.digamma,
+    "lgamma": jax.scipy.special.gammaln, "trunc": jnp.trunc, "frac": lambda x: x - jnp.trunc(x),
+    "angle": jnp.angle, "conj": jnp.conj, "real": jnp.real, "imag": jnp.imag,
+    "neg": jnp.negative, "i0": lambda x: jax.scipy.special.i0(x),
+    "i1": lambda x: jax.scipy.special.i1(x), "sigmoid": jax.nn.sigmoid,
+    "deg2rad": jnp.deg2rad, "rad2deg": jnp.rad2deg, "exp2": jnp.exp2,
+}
+
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "floor_divide": jnp.floor_divide, "mod": jnp.mod,
+    "remainder": jnp.remainder, "pow": jnp.power, "maximum": jnp.maximum,
+    "minimum": jnp.minimum, "fmax": jnp.fmax, "fmin": jnp.fmin,
+    "atan2": jnp.arctan2, "logaddexp": jnp.logaddexp, "hypot": jnp.hypot,
+    "heaviside": jnp.heaviside, "copysign": jnp.copysign,
+    "nextafter": jnp.nextafter, "ldexp": lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)),
+    "gcd": jnp.gcd, "lcm": jnp.lcm,
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "inner": jnp.inner, "outer": jnp.outer, "kron": jnp.kron, "cross": jnp.cross,
+}
+
+for _n, _f in _UNARY.items():
+    globals()[_n] = _make_unary(_n, _f)
+for _n, _f in _BINARY.items():
+    globals()[_n] = _make_binary(_n, _f)
+
+
+def bitwise_not(x, name=None):
+    return apply(jnp.bitwise_not, x, op_name="bitwise_not")
+
+
+def logical_not(x, name=None):
+    return apply(jnp.logical_not, x, op_name="logical_not")
+
+
+def divide_(x, y):
+    return x._inplace_binop(jnp.divide, y, "divide_")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = unwrap(scale), unwrap(bias)
+
+    def fn(v):
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out
+
+    return apply(fn, x, op_name="scale")
+
+
+def clip(x, min=None, max=None, name=None):
+    lo, hi = unwrap(min), unwrap(max)
+    return apply(lambda v: jnp.clip(v, lo, hi), x, op_name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight, op_name="lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda v: scale_b * jnp.tanh(scale_a * v), x, op_name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    stacked = jnp.stack([unwrap(i) for i in inputs], axis=0)
+    idx = unwrap(index).reshape(-1)
+    return Tensor(stacked[idx, jnp.arange(idx.shape[0])])
+
+
+# ------------------------------------------------------------- reductions
+def _reduce(fn, x, axis, keepdim, dtype=None, op_name="reduce"):
+    ax = _axis(axis)
+    jd = _dt.to_jax(dtype) if dtype is not None else None
+    return apply(lambda v: fn(v, axis=ax, keepdims=keepdim, **({"dtype": jd} if jd else {})),
+                 x, op_name=op_name)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _reduce(jnp.sum, x, axis, keepdim, dtype, "sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.mean, x, axis, keepdim, None, "mean")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return _reduce(jnp.prod, x, axis, keepdim, dtype, "prod")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.max, x, _axis(axis), keepdim, None, "max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.min, x, _axis(axis), keepdim, None, "min")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _reduce(jnp.nansum, x, axis, keepdim, dtype, "nansum")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.nanmean, x, axis, keepdim, None, "nanmean")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda v: jax.scipy.special.logsumexp(v, axis=ax, keepdims=keepdim),
+                 x, op_name="logsumexp")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim), x, op_name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim), x, op_name="any")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.count_nonzero(v, axis=_axis(axis), keepdims=keepdim),
+                 x, op_name="count_nonzero")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    jd = _dt.to_jax(dtype) if dtype else None
+    if axis is None:
+        return apply(lambda v: jnp.cumsum(v.reshape(-1), dtype=jd), x, op_name="cumsum")
+    return apply(lambda v: jnp.cumsum(v, axis=int(axis), dtype=jd), x, op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    jd = _dt.to_jax(dtype) if dtype else None
+    return apply(lambda v: jnp.cumprod(v, axis=int(dim), dtype=jd), x, op_name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    xx = x if axis is not None else x.reshape([-1])
+    ax = 0 if axis is None else int(axis)
+    vals = apply(lambda vv: jax.lax.associative_scan(jnp.maximum, vv, axis=ax),
+                 xx, op_name="cummax")
+    idx = _cum_arg(unwrap(xx), vals._value, ax).astype(_dt.to_jax(dtype))
+    return vals, Tensor(idx)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    xx = x if axis is not None else x.reshape([-1])
+    ax = 0 if axis is None else int(axis)
+    vals = apply(lambda vv: jax.lax.associative_scan(jnp.minimum, vv, axis=ax),
+                 xx, op_name="cummin")
+    idx = _cum_arg(unwrap(xx), vals._value, ax).astype(_dt.to_jax(dtype))
+    return vals, Tensor(idx)
+
+
+def _cum_arg(v, cum, ax):
+    """Index of the running extremum (last hit wins, matching ties-to-latest)."""
+    ar = jnp.arange(v.shape[ax]).reshape([-1 if i == ax else 1 for i in range(v.ndim)])
+    idx = jnp.where(v == cum, ar, -1)
+    return jax.lax.associative_scan(jnp.maximum, idx, axis=ax)
+
+
+# ------------------------------------------------------------- matmul etc.
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply(fn, x, y, op_name="matmul")
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y, op_name="dot")
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, x, vec, op_name="mv")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y, op_name="addmm")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    p, ap = unwrap(prepend), unwrap(append)
+    return apply(lambda v: jnp.diff(v, n=n, axis=axis, prepend=p, append=ap), x, op_name="diff")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), x, op_name="trace")
+
+
+def isfinite(x, name=None):
+    return apply(jnp.isfinite, x, op_name="isfinite")
+
+
+def isinf(x, name=None):
+    return apply(jnp.isinf, x, op_name="isinf")
+
+
+def isnan(x, name=None):
+    return apply(jnp.isnan, x, op_name="isnan")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf),
+                 x, op_name="nan_to_num")
+
+
+def increment(x, value=1.0, name=None):
+    return x._inplace_unary(lambda v: v + value, "increment")
+
+
+def floor_mod(x, y, name=None):
+    return apply(jnp.mod, x, y, op_name="floor_mod")
+
+
+def inverse(x, name=None):
+    return apply(jnp.linalg.inv, x, op_name="inverse")
+
+
+def log_(x):
+    return x._inplace_unary(jnp.log, "log_")
+
+
+def rsqrt_(x):
+    return x._inplace_unary(jax.lax.rsqrt, "rsqrt_")
+
+
+def sqrt_(x):
+    return x._inplace_unary(jnp.sqrt, "sqrt_")
+
+
+def exp_(x):
+    return x._inplace_unary(jnp.exp, "exp_")
+
+
+def reciprocal_(x):
+    return x._inplace_unary(lambda v: 1.0 / v, "reciprocal_")

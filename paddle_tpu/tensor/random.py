@@ -1,0 +1,123 @@
+"""Random ops (reference: python/paddle/tensor/random.py).
+
+Keys come from ``framework.random.next_key()`` — stateful in eager mode,
+scope-threaded inside compiled steps (see that module's docstring).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtypes as _dt
+from ..framework import random as _rng
+from ..framework import state as _state
+from .creation import _shape_list
+from .dispatch import unwrap
+from .tensor import Tensor
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    jd = _dt.to_jax(dtype or _state.get_default_dtype())
+    return Tensor(jax.random.normal(_rng.next_key(), _shape_list(shape), dtype=jd))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if shape is None:
+        shape = jnp.broadcast_shapes(jnp.shape(unwrap(mean)), jnp.shape(unwrap(std)))
+    else:
+        shape = _shape_list(shape)
+    jd = _dt.to_jax(_state.get_default_dtype())
+    z = jax.random.normal(_rng.next_key(), tuple(shape), dtype=jd)
+    return Tensor(z * unwrap(std) + unwrap(mean))
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    jd = _dt.to_jax(dtype or _state.get_default_dtype())
+    z = jax.random.normal(_rng.next_key(), _shape_list(shape), dtype=jd)
+    return Tensor(z * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    jd = _dt.to_jax(dtype or _state.get_default_dtype())
+    key = jax.random.key(seed) if seed else _rng.next_key()
+    return Tensor(jax.random.uniform(key, _shape_list(shape), dtype=jd,
+                                     minval=unwrap(min), maxval=unwrap(max)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    jd = _dt.to_jax(dtype)
+    return Tensor(jax.random.randint(_rng.next_key(), _shape_list(shape), low, high).astype(jd))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    v = unwrap(x)
+    return randint(low, high, list(v.shape), dtype or v.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_rng.next_key(), n).astype(_dt.to_jax(dtype)))
+
+
+def shuffle(x, name=None):
+    v = unwrap(x)
+    return Tensor(jax.random.permutation(_rng.next_key(), v, axis=0, independent=False))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    v = unwrap(x)
+    logp = jnp.log(jnp.clip(v / v.sum(-1, keepdims=True), 1e-30, None))
+    key = _rng.next_key()
+    if replacement:
+        out = jax.random.categorical(key, logp, axis=-1, shape=(num_samples,) + v.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        g = jax.random.gumbel(key, v.shape)
+        _, out = jax.lax.top_k(logp + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    v = unwrap(x)
+    return Tensor(jax.random.bernoulli(_rng.next_key(), v).astype(v.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._value = jax.random.bernoulli(_rng.next_key(), p, x._value.shape).astype(x.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    v = unwrap(x)
+    return Tensor(jax.random.poisson(_rng.next_key(), v).astype(v.dtype))
+
+
+def binomial(count, prob, name=None):
+    c, p = unwrap(count), unwrap(prob)
+    return Tensor(jax.random.binomial(_rng.next_key(), c.astype(jnp.float32), p).astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._value = (jax.random.exponential(_rng.next_key(), x._value.shape, dtype=x._value.dtype) / lam)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._value = mean + std * jax.random.normal(_rng.next_key(), x._value.shape, dtype=x._value.dtype)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else _rng.next_key()
+    x._value = jax.random.uniform(key, x._value.shape, dtype=x._value.dtype, minval=min, maxval=max)
+    return x

@@ -1,0 +1,128 @@
+"""Search / sort ops (reference: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as _dt
+from .dispatch import apply, unwrap
+from .tensor import Tensor
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    jd = _dt.to_jax(dtype)
+    return Tensor(jnp.argmax(unwrap(x), axis=axis, keepdims=keepdim).astype(jd))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    jd = _dt.to_jax(dtype)
+    return Tensor(jnp.argmin(unwrap(x), axis=axis, keepdims=keepdim).astype(jd))
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    def fn(v):
+        idx = jnp.argsort(v, axis=axis, stable=stable, descending=descending)
+        return idx
+
+    return Tensor(fn(unwrap(x)).astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    def fn(v):
+        return jnp.sort(v, axis=axis, stable=stable, descending=descending)
+
+    return apply(fn, x, op_name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    kk = int(unwrap(k))
+
+    def fn(v):
+        ax = axis % v.ndim
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, kk)
+        else:
+            vals, idx = jax.lax.top_k(-vm, kk)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(jnp.int64)
+
+    vals, idx = apply(fn, x, op_name="topk")
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        sv = jnp.sort(v, axis=ax)
+        si = jnp.argsort(v, axis=ax)
+        vals = jnp.take(sv, k - 1, axis=ax)
+        idx = jnp.take(si, k - 1, axis=ax)
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, ax), jnp.expand_dims(idx, ax)
+        return vals, idx.astype(jnp.int64)
+
+    return apply(fn, x, op_name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = np.asarray(unwrap(x))
+    ax = axis % v.ndim
+    vm = np.moveaxis(v, ax, -1)
+    flat = vm.reshape(-1, vm.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=v.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for i, row in enumerate(flat):
+        uq, cnt = np.unique(row, return_counts=True)
+        m = uq[np.argmax(cnt)]
+        vals[i] = m
+        idxs[i] = np.max(np.nonzero(row == m)[0])
+    shp = vm.shape[:-1]
+    vals, idxs = vals.reshape(shp), idxs.reshape(shp)
+    if keepdim:
+        vals, idxs = np.expand_dims(vals, ax), np.expand_dims(idxs, ax)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+
+    def fn(s, v):
+        out = jnp.searchsorted(s, v, side=side)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return Tensor(fn(unwrap(sorted_sequence), unwrap(values)))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def fn(v, i):
+        vm = jnp.moveaxis(v, axis, 0)
+        out = vm.at[i.astype(jnp.int32)].set(jnp.asarray(unwrap(value), v.dtype))
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply(fn, x, index, op_name="index_fill")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    v = unwrap(input)
+    lo, hi = (float(v.min()), float(v.max())) if min == 0 and max == 0 else (min, max)
+    h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+    return Tensor(h.astype(jnp.int64))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    h, e = np.histogramdd(np.asarray(unwrap(x)), bins=bins, range=ranges, density=density,
+                          weights=np.asarray(unwrap(weights)) if weights is not None else None)
+    return Tensor(jnp.asarray(h)), [Tensor(jnp.asarray(i)) for i in e]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    v = np.asarray(unwrap(x))
+    w = np.asarray(unwrap(weights)) if weights is not None else None
+    return Tensor(jnp.asarray(np.bincount(v, weights=w, minlength=minlength)))

@@ -1,0 +1,53 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dispatch import apply, unwrap
+from .tensor import Tensor
+from .math import _axis
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.var(v, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x, op_name="var")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.std(v, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x, op_name="std")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fn(v):
+        if mode == "avg":
+            return jnp.median(v, axis=_axis(axis), keepdims=keepdim)
+        # 'min' mode: lower of the two middle elements
+        ax = _axis(axis)
+        if ax is None:
+            v = v.reshape(-1)
+            ax = 0
+        sv = jnp.sort(v, axis=ax)
+        n = sv.shape[ax]
+        out = jnp.take(sv, (n - 1) // 2, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+
+    return apply(fn, x, op_name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply(lambda v: jnp.nanmedian(v, axis=_axis(axis), keepdims=keepdim),
+                 x, op_name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = unwrap(q)
+    return apply(lambda v: jnp.quantile(v, jnp.asarray(qq), axis=_axis(axis), keepdims=keepdim,
+                                        method=interpolation), x, op_name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = unwrap(q)
+    return apply(lambda v: jnp.nanquantile(v, jnp.asarray(qq), axis=_axis(axis), keepdims=keepdim,
+                                           method=interpolation), x, op_name="nanquantile")

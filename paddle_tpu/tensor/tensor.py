@@ -1,0 +1,375 @@
+"""The Tensor: a paddle-shaped, mutable-feeling handle over an immutable
+``jax.Array``.
+
+Reference analog: phi::DenseTensor + the eager Tensor bindings
+(paddle/phi/core/dense_tensor.h, paddle/fluid/pybind/eager_method.cc).
+TPU-native design decisions:
+
+- Storage IS ``jax.Array`` — device memory is owned by the XLA runtime (no
+  allocator layer to rebuild; the reference's AutoGrowthBestFitAllocator has
+  no TPU counterpart by design, see SURVEY.md §2.1).
+- "In-place" ops (``tensor[...] = v``, ``add_``, optimizer updates) REBIND
+  the handle to a new functional value — the one place the paddle API's
+  mutability meets XLA's immutability.  Under jit tracing the same rebind
+  discipline traces to pure dataflow.
+- ``stop_gradient`` defaults True (paddle semantics); ``Parameter`` flips it.
+- Tensors are registered as jax pytree nodes, so whole models / state dicts
+  flow through ``jax.jit`` / ``pjit`` / ``shard_map`` unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as _dt
+from ..framework import state as _state
+from . import dispatch
+
+_bool = bool  # guarded against the paddle-style module-level `bool` dtype alias
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "grad", "_grad_node", "_retain_grads",
+                 "name", "persistable", "__weakref__")
+
+    # let Tensor.__r*__ win over np.ndarray ops
+    __array_priority__ = 100
+
+    def __init__(self, value, dtype=None, stop_gradient: bool = True, name: str | None = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if dtype is not None:
+            value = jnp.asarray(value, dtype=_dt.to_jax(dtype))
+        elif not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._retain_grads = False
+        self.name = name
+        self.persistable = False
+
+    # ------------------------------------------------------------ basics
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        from .. import device as _device
+
+        try:
+            devs = self._value.devices()
+            d = next(iter(devs))
+            kind = "cpu" if d.platform == "cpu" else "tpu"
+            return _device.Place(kind, d.id)
+        except Exception:
+            return _device.Place("cpu", 0)
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        from . import manipulation
+
+        return manipulation.transpose(self, list(range(self.ndim))[::-1])
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+    def item(self, *idx):
+        v = self._value if not idx else self._value[idx]
+        return np.asarray(v).item()
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        # lets raw jnp.* functions consume Tensors directly
+        return self._value
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def _scalar_value(self):
+        # paddle allows python-scalar conversion of any size-1 tensor
+        return self._value.reshape(()) if self._value.ndim else self._value
+
+    def __bool__(self):
+        return bool(self._scalar_value())
+
+    def __float__(self):
+        return float(self._scalar_value())
+
+    def __int__(self):
+        return int(self._scalar_value())
+
+    def __index__(self):
+        return int(self._scalar_value())
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name if hasattr(self.dtype,'name') else self.dtype}, "
+                f"stop_gradient={sg},\n       {np.asarray(self._value)!r})")
+
+    # ------------------------------------------------------------ autograd
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from ..autograd import tape
+
+        tape.backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True)
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        return dispatch.apply(lambda x: x + 0, self, op_name="clone")
+
+    # ------------------------------------------------------------ mutation
+    def _replace_value(self, new_value):
+        """Rebind storage (the in-place discipline). jax.Array only."""
+        self._value = new_value
+
+    def set_value(self, value):
+        v = value._value if isinstance(value, Tensor) else jnp.asarray(value, dtype=self.dtype)
+        if tuple(v.shape) != tuple(self._value.shape):
+            raise ValueError(f"set_value shape mismatch {v.shape} vs {self._value.shape}")
+        self._value = v.astype(self.dtype)
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def zero_(self):
+        return self._inplace_unary(jnp.zeros_like, "zero_")
+
+    def fill_(self, v):
+        return self._inplace_unary(lambda x: jnp.full_like(x, v), "fill_")
+
+    def _snapshot(self):
+        """Alias of the current state as a separate Tensor, so an in-place op
+        can record it as the tape input (avoids self-referential nodes).
+        The producing node's output ref is re-pointed at the snapshot, which
+        now represents the pre-mutation value in the graph."""
+        old = Tensor(self._value, stop_gradient=self.stop_gradient)
+        old._grad_node = self._grad_node
+        old._retain_grads = self._retain_grads
+        _swap_node_output(self._grad_node, self, old)
+        return old
+
+    def _inplace_from(self, out):
+        """Adopt ``out``'s value+node (the in-place rebind discipline); this
+        handle becomes the node's output for cotangent matching."""
+        self._value = out._value
+        self._grad_node = out._grad_node
+        self.stop_gradient = out.stop_gradient and self.stop_gradient
+        _swap_node_output(self._grad_node, out, self)
+        return self
+
+    def _inplace_binop(self, fn, other, op_name):
+        out = dispatch.apply(fn, self._snapshot(), other, op_name=op_name)
+        return self._inplace_from(out)
+
+    def _inplace_unary(self, fn, op_name):
+        """Tape-correct unary in-place (fill_/zero_/scale_/exp_ ...): routes
+        through the snapshot discipline when tracked, cheap rebind otherwise."""
+        from ..framework import state as _st
+
+        if _st.grad_enabled() and (not self.stop_gradient or self._grad_node is not None):
+            out = dispatch.apply(fn, self._snapshot(), op_name=op_name)
+            return self._inplace_from(out)
+        self._value = fn(self._value)
+        return self
+
+    def add_(self, y):
+        return self._inplace_binop(jnp.add, y, "add_")
+
+    def subtract_(self, y):
+        return self._inplace_binop(jnp.subtract, y, "subtract_")
+
+    def multiply_(self, y):
+        return self._inplace_binop(jnp.multiply, y, "multiply_")
+
+    def scale_(self, scale=1.0, bias=0.0):
+        return self._inplace_unary(lambda x: x * scale + bias, "scale_")
+
+    def clip_(self, min=None, max=None):
+        return self._inplace_unary(lambda x: jnp.clip(x, min, max), "clip_")
+
+    # ------------------------------------------------------------ indexing
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return dispatch.apply(lambda x: x[idx], self, op_name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        if isinstance(value, Tensor):
+            out = dispatch.apply(lambda x, v: x.at[idx].set(v.astype(x.dtype)),
+                                 self._snapshot(), value, op_name="setitem")
+        else:
+            out = dispatch.apply(lambda x: x.at[idx].set(jnp.asarray(value).astype(x.dtype)),
+                                 self._snapshot(), op_name="setitem")
+        self._inplace_from(out)
+
+    # ------------------------------------------------------------ dtype/device
+    def astype(self, dtype):
+        jd = _dt.to_jax(dtype)
+        return dispatch.apply(lambda x: x.astype(jd), self, op_name="cast")
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        kwargs.pop("blocking", None)  # transfers are synchronous-on-use in XLA
+        t = self
+        for a in list(args) + list(kwargs.values()):
+            if a is None or isinstance(a, _bool):  # positional `blocking`
+                continue
+            if isinstance(a, str) and (a in ("cpu", "tpu") or a.startswith(("cpu:", "tpu:", "gpu"))):
+                t = t._to_device(a)
+            else:
+                t = t.astype(a)
+        return t
+
+    def _to_device(self, device: str):
+        from .. import device as _device
+
+        kind, _, idx = device.partition(":")
+        if kind == "gpu":
+            kind = "tpu"
+        place = _device.Place(kind, int(idx) if idx else 0)
+        return Tensor(jax.device_put(self._value, place.jax_device()), stop_gradient=self.stop_gradient)
+
+    def cpu(self):
+        return self._to_device("cpu")
+
+    def tpu(self, index=0):
+        return self._to_device(f"tpu:{index}")
+
+    def cuda(self, index=0):
+        return self._to_device("tpu")  # script-portability shim
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # arithmetic dunders are attached in tensor/__init__.py (table-driven),
+    # as are the ~200 forwarding methods (x.sum(), x.reshape(), ...).
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: paddle.ParamAttr / EagerParamBase)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "do_model_average", "need_clip")
+
+    def __init__(self, value, dtype=None, name=None, trainable=True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = True
+        self.persistable = True
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _swap_node_output(node, old_t, new_t):
+    """Re-point a tape node's output ref from ``old_t`` to ``new_t``."""
+    if node is None:
+        return
+    import weakref as _weakref
+
+    for i, r in enumerate(node.outputs):
+        if r() is old_t:
+            node.outputs[i] = _weakref.ref(new_t)
+            return
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    return idx
+
+
+# ---------------------------------------------------------------- pytree
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (type(t), t.stop_gradient)
+
+
+def _tensor_unflatten(aux, children):
+    cls, sg = aux
+    if cls is Parameter:
+        return Parameter(children[0], trainable=not sg)
+    t = cls.__new__(cls)
+    Tensor.__init__(t, children[0], stop_gradient=sg)
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+jax.tree_util.register_pytree_node(Parameter, _tensor_flatten, _tensor_unflatten)

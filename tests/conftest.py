@@ -1,0 +1,29 @@
+"""Test environment: force an 8-device virtual CPU mesh (SURVEY.md §4).
+
+Every parallelism test runs on this fake mesh in CI; real TPU only in
+hardware CI (the driver's bench run).  This mirrors the reference's use of
+a CPU/Gloo ProcessGroup as the no-GPU collective fallback.
+
+The axon sitecustomize in this image imports jax at interpreter startup and
+pins JAX_PLATFORMS=axon (single tunneled TPU, which hangs under concurrent
+test workers), so we must override the *already-imported* jax config rather
+than env vars, and drop the axon backend factory before first backend init.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+assert jax.device_count() == 8, f"expected 8 virtual cpu devices, got {jax.devices()}"

@@ -1,0 +1,72 @@
+"""OpTest harness — the rebuild's analog of the reference's OpTest base
+(test/legacy_test/op_test.py): every op checked against a numpy reference
+(check_output) and its gradient against finite differences (check_grad).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+class OpTest:
+    """Subclass and set: self.op (callable on Tensors), self.inputs (dict of
+    numpy arrays), self.ref (callable on numpy arrays), optional self.attrs."""
+
+    rtol = 1e-5
+    atol = 1e-6
+
+    def run_op(self, inputs):
+        ts = {k: paddle.to_tensor(v, stop_gradient=False) if v.dtype.kind == "f"
+              else paddle.to_tensor(v) for k, v in inputs.items()}
+        out = self.op(**ts, **getattr(self, "attrs", {}))
+        return ts, out
+
+    def check_output(self):
+        ts, out = self.run_op(self.inputs)
+        ref = self.ref(**self.inputs, **getattr(self, "attrs", {}))
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        refs = ref if isinstance(ref, (tuple, list)) else [ref]
+        for o, r in zip(outs, refs):
+            np.testing.assert_allclose(o.numpy(), r, rtol=self.rtol, atol=self.atol)
+
+    def check_grad(self, wrt=None, eps=1e-3, rtol=1e-2, atol=1e-3):
+        """Analytic grad (tape backward) vs central finite differences."""
+        wrt = wrt or [k for k, v in self.inputs.items() if v.dtype.kind == "f"]
+        ts, out = self.run_op(self.inputs)
+        loss = _as_scalar(out)
+        loss.backward()
+        for name in wrt:
+            analytic = ts[name].grad.numpy()
+            numeric = _numeric_grad(self, name, eps)
+            np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
+                                       err_msg=f"grad mismatch for input {name!r}")
+
+
+def _as_scalar(out):
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    total = None
+    for o in outs:
+        s = o.sum() if (o.size > 1 or o.ndim > 0) else o
+        total = s if total is None else total + s
+    return total
+
+
+def _numeric_grad(test, name, eps):
+    base = {k: v.copy() for k, v in test.inputs.items()}
+    x = base[name]
+    g = np.zeros_like(x, dtype=np.float64)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        for sign in (+1, -1):
+            pert = {k: v.copy() for k, v in base.items()}
+            pert[name][idx] += sign * eps
+            _, out = test.run_op(pert)
+            val = float(np.sum([np.asarray(o.numpy(), np.float64).sum()
+                                for o in (out if isinstance(out, (tuple, list)) else [out])]))
+            g[idx] += sign * val
+        g[idx] /= 2 * eps
+        it.iternext()
+    return g.astype(x.dtype)

@@ -1,0 +1,248 @@
+"""Op unit tests vs numpy references (SURVEY.md §4: OpTest philosophy)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+
+class TestMatmul(OpTest):
+    def setup_method(self, m):
+        self.op = lambda x, y: paddle.matmul(x, y)
+        self.ref = lambda x, y: x @ y
+        self.inputs = {"x": np.random.randn(3, 4).astype("float32"),
+                       "y": np.random.randn(4, 5).astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestSoftplusLike(OpTest):
+    def setup_method(self, m):
+        self.op = lambda x: paddle.log1p(paddle.exp(x))
+        self.ref = lambda x: np.log1p(np.exp(x))
+        self.inputs = {"x": np.random.randn(4, 7).astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestReduceMean(OpTest):
+    def setup_method(self, m):
+        self.op = lambda x: paddle.mean(x, axis=1, keepdim=True)
+        self.ref = lambda x: x.mean(axis=1, keepdims=True)
+        self.inputs = {"x": np.random.randn(5, 6).astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+@pytest.mark.parametrize("name,pfn,nfn", [
+    ("exp", paddle.exp, np.exp),
+    ("tanh", paddle.tanh, np.tanh),
+    ("sqrt", paddle.sqrt, np.sqrt),
+    ("sigmoid", paddle.sigmoid, lambda x: 1 / (1 + np.exp(-x))),
+    ("floor", paddle.floor, np.floor),
+    ("abs", paddle.abs, np.abs),
+    ("log1p", paddle.log1p, np.log1p),
+])
+def test_unary(name, pfn, nfn):
+    x = np.random.randn(3, 4).astype("float32")
+    if name == "sqrt":
+        x = np.abs(x) + 1
+    if name == "log1p":
+        x = np.abs(x)
+    np.testing.assert_allclose(pfn(paddle.to_tensor(x)).numpy(), nfn(x), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("pfn,nfn", [
+    (paddle.add, np.add), (paddle.subtract, np.subtract),
+    (paddle.multiply, np.multiply), (paddle.maximum, np.maximum),
+    (paddle.minimum, np.minimum), (paddle.pow, np.power),
+])
+def test_binary_broadcast(pfn, nfn):
+    x = np.abs(np.random.randn(3, 1, 4).astype("float32")) + 0.5
+    y = np.abs(np.random.randn(5, 1).astype("float32")) + 0.5
+    np.testing.assert_allclose(pfn(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(),
+                               nfn(x, y), rtol=1e-5)
+
+
+def test_creation_dtypes():
+    assert paddle.zeros([2, 3]).dtype == np.float32
+    assert paddle.arange(10).dtype == np.int64
+    assert paddle.ones([2], dtype="int32").dtype == np.int32
+    assert paddle.to_tensor(3.14).dtype == np.float32
+    assert paddle.to_tensor(np.float64(3.14)).dtype == np.float32
+    assert paddle.to_tensor(np.zeros((2,), np.float64)).dtype == np.float64
+    assert paddle.to_tensor(7).dtype == np.int64
+    assert paddle.full([2], 5).dtype == np.int64
+
+
+def test_manipulation_roundtrips():
+    x = paddle.rand([2, 3, 4])
+    assert x.reshape([4, 6]).shape == [4, 6]
+    assert x.transpose([2, 0, 1]).shape == [4, 2, 3]
+    assert x.flatten().shape == [24]
+    assert x.flatten(1, 2).shape == [2, 12]
+    assert paddle.unsqueeze(x, [0, 2]).shape == [1, 2, 1, 3, 4]
+    assert paddle.squeeze(paddle.ones([1, 3, 1]), axis=0).shape == [3, 1]
+    parts = paddle.split(x, [1, 2], axis=1)
+    assert parts[0].shape == [2, 1, 4] and parts[1].shape == [2, 2, 4]
+    assert paddle.stack([x, x], axis=1).shape == [2, 2, 3, 4]
+    assert paddle.tile(paddle.ones([2]), [3, 2]).shape == [3, 4]
+    assert paddle.flip(x, [0]).shape == [2, 3, 4]
+    assert paddle.roll(x, 1, 0).shape == [2, 3, 4]
+
+
+def test_indexing_gather_scatter():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    g = paddle.gather(x, paddle.to_tensor([0, 2]), axis=0)
+    np.testing.assert_allclose(g.numpy(), x.numpy()[[0, 2]])
+    u = paddle.scatter(x, paddle.to_tensor([0]), paddle.ones([1, 4]), overwrite=True)
+    assert u.numpy()[0].tolist() == [1, 1, 1, 1]
+    ta = paddle.take_along_axis(x, paddle.to_tensor([[0, 1, 2, 0]]), axis=0)
+    np.testing.assert_allclose(ta.numpy(), np.take_along_axis(x.numpy(), np.array([[0, 1, 2, 0]]), 0))
+    nd = paddle.gather_nd(x, paddle.to_tensor([[0, 1], [2, 3]]))
+    np.testing.assert_allclose(nd.numpy(), [1.0, 11.0])
+
+
+def test_search_sort():
+    x = np.random.randn(4, 6).astype("float32")
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.sort(t, axis=1).numpy(), np.sort(x, 1))
+    assert paddle.argsort(t, axis=1).numpy().tolist() == np.argsort(x, 1, kind="stable").tolist()
+    vals, idx = paddle.topk(t, 3, axis=1)
+    np.testing.assert_allclose(vals.numpy(), -np.sort(-x, 1)[:, :3], rtol=1e-6)
+    assert paddle.argmax(t, axis=1).numpy().tolist() == x.argmax(1).tolist()
+
+
+def test_inplace_and_autograd_interplay():
+    a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    b = a * 3
+    b.add_(paddle.ones([2]))
+    loss = b.sum()
+    loss.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [3.0, 3.0])
+
+
+def test_grad_accumulation_and_clear():
+    a = paddle.to_tensor([2.0], stop_gradient=False)
+    (a * a).backward()
+    (a * a).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [8.0])  # 4 + 4
+    a.clear_grad()
+    assert a.grad is None
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x * x
+    (g,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(g.numpy(), [27.0])
+    assert x.grad is None  # .grad untouched
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_multi_output_grad():
+    x = paddle.to_tensor(np.array([[3.0, 1.0], [2.0, 4.0]], np.float32), stop_gradient=False)
+    a, b = paddle.split(x, 2, axis=0)
+    loss = (a * 2).sum() + (b * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[2, 2], [3, 3]])
+
+
+def test_pylayer():
+    class Cube(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor
+            return dy * 3 * x * x
+
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = Cube.apply(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_linalg():
+    a = np.random.randn(4, 4).astype("float32")
+    spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+    t = paddle.to_tensor(spd)
+    np.testing.assert_allclose(paddle.linalg.cholesky(t).numpy(),
+                               np.linalg.cholesky(spd), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(paddle.linalg.inv(t).numpy(), np.linalg.inv(spd),
+                               rtol=1e-3, atol=1e-4)
+    u, s, v = paddle.linalg.svd(t)
+    np.testing.assert_allclose(s.numpy(), np.linalg.svd(spd, compute_uv=False), rtol=1e-4)
+
+
+def test_random_reproducibility():
+    paddle.seed(42)
+    a = paddle.rand([4]).numpy()
+    paddle.seed(42)
+    b = paddle.rand([4]).numpy()
+    np.testing.assert_array_equal(a, b)
+    c = paddle.randint(0, 10, [100])
+    assert int(c.max()) < 10 and int(c.min()) >= 0
+    p = paddle.randperm(16).numpy()
+    assert sorted(p.tolist()) == list(range(16))
+
+
+def test_save_load(tmp_path):
+    sd = {"w": paddle.rand([3, 3]), "nested": {"b": paddle.ones([2], dtype="bfloat16")}}
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(sd, path)
+    back = paddle.load(path)
+    np.testing.assert_allclose(np.asarray(back["w"].numpy()), sd["w"].numpy())
+    assert str(back["nested"]["b"]._value.dtype) == "bfloat16"
+
+
+def test_inplace_multiply_chain_rule():
+    # regression: in-place ops must route cotangents through their vjp,
+    # not just alias the handle (caught in round-1 code review)
+    a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    b = a * 3
+    b.multiply_(paddle.to_tensor([2.0, 2.0]))
+    b.sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [6.0, 6.0])
+
+
+def test_grad_api_no_side_effects_on_params():
+    w = paddle.Parameter(paddle.to_tensor([3.0]))
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    (g,) = paddle.grad((w * x).sum(), [x])
+    np.testing.assert_allclose(g.numpy(), [3.0])
+    assert w.grad is None  # paddle.grad must not pollute other leaves
+
+
+def test_topk_backward_int_output():
+    x = paddle.to_tensor(np.random.randn(4, 6).astype("float32"), stop_gradient=False)
+    vals, idx = paddle.topk(x, 3)
+    vals.sum().backward()
+    assert int((x.grad.numpy() != 0).sum()) == 12
+
+
+def test_split_indivisible_raises():
+    with pytest.raises(ValueError):
+        paddle.split(paddle.arange(5), 2)
