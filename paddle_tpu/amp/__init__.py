@@ -1,0 +1,200 @@
+"""AMP: auto_cast + GradScaler (reference: python/paddle/amp/).
+
+bf16-first on TPU: bfloat16 needs no loss scaling (same exponent range as
+f32), so ``GradScaler(enable=True)`` with bf16 becomes a near-no-op that
+still checks for inf/nan.  float16 keeps full dynamic loss scaling for
+parity.  O1 casts white-list ops (MXU ops: matmul/conv/einsum) to the amp
+dtype at the dispatch layer; O2 casts everything except the black list.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..framework import dtypes as _dt
+from ..framework import state as _state
+from ..tensor.tensor import Tensor
+
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "addmm", "conv1d", "conv2d", "conv3d", "linear",
+    "einsum", "mha", "scaled_dot_product_attention", "flash_attention",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "cross_entropy", "nll_loss", "mse_loss", "l1_loss",
+    "bce_with_logits", "binary_cross_entropy", "kl_div", "sum", "mean", "norm",
+    "logsumexp", "layer_norm", "batch_norm", "group_norm", "cumsum", "var", "std",
+    "sigmoid_focal_loss", "softmax_with_cross_entropy",
+}
+
+
+class AmpState:
+    __slots__ = ("level", "dtype", "white", "black", "enable")
+
+    def __init__(self, level, dtype, white, black, enable=True):
+        self.level = level
+        self.dtype = dtype
+        self.white = white
+        self.black = black
+        self.enable = enable
+
+    def cast_args(self, op_name, vals):
+        """Called from tensor.dispatch.apply before executing an op."""
+        if not self.enable:
+            return vals
+        amp_dt = _dt.to_jax(self.dtype)
+        if op_name in self.black:
+            tgt = jnp.float32
+        elif op_name in self.white or self.level == "O2":
+            tgt = amp_dt
+        else:
+            return vals
+        out = []
+        for v in vals:
+            if hasattr(v, "dtype") and v.dtype in (jnp.float32, jnp.float16, jnp.bfloat16) \
+                    and v.dtype != tgt:
+                out.append(v.astype(tgt))
+            else:
+                out.append(v)
+        return out
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16", use_promote=True):
+    if level not in ("O0", "O1", "O2", "OD"):
+        raise ValueError(f"bad amp level {level!r}")
+    white = set(WHITE_LIST) | set(custom_white_list or ())
+    black = (set(BLACK_LIST) | set(custom_black_list or ())) - set(custom_white_list or ())
+    st = AmpState(level, dtype, white, black, enable=enable and level != "O0")
+    prev = _state.set_amp_state(st)
+    try:
+        yield
+    finally:
+        _state.set_amp_state(prev)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None,
+             save_dtype=None):
+    """O2 decoration: cast model float params to the amp dtype (reference
+    amp.decorate). Master weights: the optimizer keeps f32 state; on TPU
+    bf16 params + f32 optimizer states is the standard recipe."""
+    single = not isinstance(models, (list, tuple))
+    ms = [models] if single else list(models)
+    if level == "O2":
+        jd = _dt.to_jax(dtype)
+        for m in ms:
+            for p in m.parameters():
+                if p._value.dtype == jnp.float32:
+                    # f32 master copy: Optimizer.step runs the update rule on
+                    # _master and re-derives the low-precision working copy
+                    p._master = p._value
+                    p._value = p._value.astype(jd)
+    if optimizers is None:
+        return models if single else ms
+    return (models if single else ms), optimizers
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=2000, decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / self._scale
+        nonfinite = None  # accumulate on device; ONE host sync at the end
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad._value.astype(jnp.float32) * inv
+                cnt = jnp.sum(~jnp.isfinite(g))
+                nonfinite = cnt if nonfinite is None else nonfinite + cnt
+                p.grad._value = g.astype(p.grad.dtype) if p.grad.dtype != jnp.float32 else g
+        self._found_inf = bool(nonfinite > 0) if nonfinite is not None else False
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            self._unscaled = False
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._unscaled = False
+        self._found_inf = False
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "incr_count": self._good_steps,
+                "decr_count": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("incr_count", 0)
+        self._bad_steps = sd.get("decr_count", 0)
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True

@@ -1,0 +1,194 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+
+One jnp/jax.nn call each; XLA fuses them into surrounding matmuls on TPU, so
+there are no "fused activation" variants to maintain (the reference's
+phi/kernels/fusion/ equivalents are unnecessary by construction).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.dispatch import apply, unwrap
+
+
+def relu(x, name=None):
+    return apply(jax.nn.relu, x, op_name="relu")
+
+
+def relu_(x):
+    return x._inplace_unary(jax.nn.relu, "relu_")
+
+
+def relu6(x, name=None):
+    return apply(jax.nn.relu6, x, op_name="relu6")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.elu(v, alpha), x, op_name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x, op_name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.celu(v, alpha), x, op_name="celu")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda v: jax.nn.gelu(v, approximate=approximate), x, op_name="gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda v: jax.nn.leaky_relu(v, negative_slope), x, op_name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v > 0, v, w.reshape(()) * v)
+        ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+        shape = [1] * v.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(v > 0, v, w.reshape(shape) * v)
+
+    return apply(fn, x, weight, op_name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.333, training=True, name=None):
+    from ...framework import random as _rng
+
+    def fn(v):
+        if training:
+            a = jax.random.uniform(_rng.next_key(), v.shape, minval=lower, maxval=upper, dtype=v.dtype)
+        else:
+            a = (lower + upper) / 2.0
+        return jnp.where(v >= 0, v, a * v)
+
+    return apply(fn, x, op_name="rrelu")
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x, op_name="sigmoid")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), x, op_name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return apply(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x, op_name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda v: jnp.clip(v, min, max), x, op_name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0).astype(v.dtype),
+                 x, op_name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.sign(v) * jnp.maximum(jnp.abs(v) - threshold, 0.0),
+                 x, op_name="softshrink")
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, x, op_name="log_sigmoid")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework import dtypes as _dt
+
+    def fn(v):
+        if dtype is not None:
+            v = v.astype(_dt.to_jax(dtype))
+        return jax.nn.softmax(v, axis=axis)
+
+    return apply(fn, x, op_name="softmax")
+
+
+softmax_ = softmax
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework import dtypes as _dt
+
+    def fn(v):
+        if dtype is not None:
+            v = v.astype(_dt.to_jax(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return apply(fn, x, op_name="log_softmax")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(lambda v: jnp.where(beta * v > threshold, v,
+                                     (1.0 / beta) * jnp.log1p(jnp.exp(beta * jnp.minimum(v, threshold / beta)))),
+                 x, op_name="softplus")
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, x, op_name="softsign")
+
+
+def swish(x, name=None):
+    return apply(jax.nn.silu, x, op_name="swish")
+
+
+silu = swish
+
+
+def mish(x, name=None):
+    return apply(lambda v: v * jnp.tanh(jax.nn.softplus(v)), x, op_name="mish")
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda v: v - jnp.tanh(v), x, op_name="tanhshrink")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v, value).astype(v.dtype),
+                 x, op_name="thresholded_relu")
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, x, op_name="tanh")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+
+    return apply(fn, x, op_name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    def fn(v):
+        a, b = jnp.split(v, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+
+    return apply(fn, x, op_name="glu")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as _rng
+
+    def fn(v):
+        g = jax.random.gumbel(_rng.next_key(), v.shape, dtype=v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            # straight-through estimator
+            y = y_hard + y - jax.lax.stop_gradient(y)
+        return y
+
+    return apply(fn, x, op_name="gumbel_softmax")

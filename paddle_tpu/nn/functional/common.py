@@ -1,0 +1,337 @@
+"""Common functionals: linear, dropout, embedding, interpolate, etc.
+(reference: python/paddle/nn/functional/common.py, input.py, vision.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import random as _rng
+from ...framework import state as _state
+from ...tensor.dispatch import apply, unwrap
+from ...tensor.tensor import Tensor
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle's (in_features, out_features) weight layout."""
+
+    def fn(v, w, *b):
+        out = jnp.matmul(v, w)
+        if b:
+            out = out + b[0]
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(fn, *args, op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = _rng.next_key()
+
+    def fn(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return apply(fn, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = _rng.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    a_prime = -alpha * scale
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = ((1 - p) * (1 + p * a_prime ** 2)) ** -0.5
+        b = -a * a_prime * p
+        return (a * jnp.where(keep, v, a_prime) + b).astype(v.dtype)
+
+    return apply(fn, x, op_name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def fn(w, ids):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply(fn, weight, x, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return Tensor(jax.nn.one_hot(unwrap(x).astype(jnp.int32), num_classes, dtype=jnp.float32))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l, *pd):
+        k = l.shape[-1]
+        if pd:
+            return (1 - epsilon) * l + epsilon * pd[0]
+        return (1 - epsilon) * l + epsilon / k
+
+    args = (label,) if prior_dist is None else (label, prior_dist)
+    return apply(fn, *args, op_name="label_smooth")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return apply(fn, x1, x2, op_name="cosine_similarity")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return apply(fn, x, y, op_name="pairwise_distance")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...tensor import manipulation
+
+    return manipulation.pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    """jax.image.resize-backed; methods: nearest|bilinear|bicubic|trilinear|area|linear."""
+
+    def fn(v):
+        nd = v.ndim
+        if data_format.startswith("NC"):
+            spatial = list(range(2, nd))
+        else:
+            spatial = list(range(1, nd - 1))
+        if size is not None:
+            tgt = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+            tgt = [int(v.shape[ax] * f) for ax, f in zip(spatial, sf)]
+        new_shape = list(v.shape)
+        for ax, t in zip(spatial, tgt):
+            new_shape[ax] = t
+        m = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        if align_corners and m != "nearest":
+            # resize with endpoint-aligned sampling grid
+            out = v
+            for ax, t in zip(spatial, tgt):
+                n_in = out.shape[ax]
+                if t == 1 or n_in == 1:
+                    idx = jnp.zeros((t,), jnp.float32)
+                else:
+                    idx = jnp.linspace(0, n_in - 1, t)
+                lo = jnp.floor(idx).astype(jnp.int32)
+                hi = jnp.clip(lo + 1, 0, n_in - 1)
+                w = (idx - lo).astype(v.dtype)
+                shape = [1] * out.ndim
+                shape[ax] = t
+                wb = w.reshape(shape)
+                out = jnp.take(out, lo, axis=ax) * (1 - wb) + jnp.take(out, hi, axis=ax) * wb
+            return out
+        return jax.image.resize(v, new_shape, method=m)
+
+    return apply(fn, x, op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            out = v.reshape(n, c // (r * r), r, r, h, w)
+            out = out.transpose(0, 1, 4, 2, 5, 3)
+            return out.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        out = v.reshape(n, h, w, r, r, c // (r * r))
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply(fn, x, op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            out = v.reshape(n, c, h // r, r, w // r, r)
+            out = out.transpose(0, 1, 3, 5, 2, 4)
+            return out.reshape(n, c * r * r, h // r, w // r)
+        raise NotImplementedError
+
+    return apply(fn, x, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(v):
+        n, c, h, w = v.shape
+        out = v.reshape(n, groups, c // groups, h, w)
+        return out.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+    return apply(fn, x, op_name="channel_shuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: F.unfold). Output (N, C*kh*kw, L)."""
+    from .conv import _norm_tuple
+
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    d = _norm_tuple(dilations, 2)
+    if isinstance(paddings, int):
+        p = [(paddings, paddings)] * 2
+    else:
+        pl = list(paddings)
+        p = [(pl[0], pl[0]), (pl[1], pl[1])] if len(pl) == 2 else [(pl[0], pl[2]), (pl[1], pl[3])]
+
+    def fn(v):
+        n, c, h, w = v.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            v, filter_shape=k, window_strides=s, padding=p, rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: (N, C*kh*kw, H', W')
+        return patches.reshape(n, patches.shape[1], -1)
+
+    return apply(fn, x, op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import _norm_tuple
+
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    d = _norm_tuple(dilations, 2)
+    osz = _norm_tuple(output_sizes, 2)
+    if isinstance(paddings, int):
+        p = (paddings, paddings)
+    else:
+        pl = list(paddings)
+        p = (pl[0], pl[1]) if len(pl) == 2 else (pl[0], pl[1])
+
+    def fn(v):
+        n, ckk, L = v.shape
+        c = ckk // (k[0] * k[1])
+        oh = (osz[0] + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (osz[1] + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = v.reshape(n, c, k[0], k[1], oh, ow)
+        out = jnp.zeros((n, c, osz[0] + 2 * p[0], osz[1] + 2 * p[1]), v.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                hi = i * d[0]
+                wi = j * d[1]
+                out = out.at[:, :, hi:hi + oh * s[0]:s[0], wi:wi + ow * s[1]:s[1]].add(cols[:, :, i, j])
+        return out[:, :, p[0]:p[0] + osz[0], p[1]:p[1] + osz[1]]
+
+    return apply(fn, x, op_name="fold")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = (x1, x2, weight) if bias is None else (x1, x2, weight, bias)
+    return apply(fn, *args, op_name="bilinear")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    """Bilinear grid sampling (reference F.grid_sample; used by detection)."""
+
+    def fn(v, g):
+        n, c, h, w = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            ix = (gx + 1) * (w - 1) / 2
+            iy = (gy + 1) * (h - 1) / 2
+        else:
+            ix = ((gx + 1) * w - 1) / 2
+            iy = ((gy + 1) * h - 1) / 2
+        x0 = jnp.floor(ix)
+        y0 = jnp.floor(iy)
+        x1, y1 = x0 + 1, y0 + 1
+
+        def sample(xi, yi):
+            xi_c = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            yi_c = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            out = v[jnp.arange(n)[:, None, None], :, yi_c, xi_c]  # (n, gh, gw, c)
+            if padding_mode == "zeros":
+                valid = ((xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1))[..., None]
+                out = jnp.where(valid, out, 0.0)
+            return out
+
+        wa = ((x1 - ix) * (y1 - iy))[..., None]
+        wb = ((x1 - ix) * (iy - y0))[..., None]
+        wc = ((ix - x0) * (y1 - iy))[..., None]
+        wd = ((ix - x0) * (iy - y0))[..., None]
+        out = (sample(x0, y0) * wa + sample(x0, y1) * wb +
+               sample(x1, y0) * wc + sample(x1, y1) * wd)
+        return jnp.moveaxis(out, -1, 1)  # (n, c, gh, gw)
+
+    return apply(fn, x, grid, op_name="grid_sample")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def fn(th):
+        n, _, h, w = [int(s) for s in out_shape] if len(out_shape) == 4 else (int(out_shape[0]), None, int(out_shape[2]), int(out_shape[3]))
+        if align_corners:
+            xs = jnp.linspace(-1, 1, w)
+            ys = jnp.linspace(-1, 1, h)
+        else:
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # (h, w, 3)
+        return jnp.einsum("hwk,nik->nhwi", base, th)
+
+    return apply(fn, theta, op_name="affine_grid")
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    from ...framework import dtypes as _dt
+
+    lens = unwrap(lengths)
+    m = int(maxlen) if maxlen is not None else int(jnp.max(lens))
+    mask = jnp.arange(m) < lens[..., None]
+    return Tensor(mask.astype(_dt.to_jax(dtype)))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: PS-style sparse path out of TPU scope (SURVEY §2.1)")

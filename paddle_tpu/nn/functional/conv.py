@@ -1,0 +1,168 @@
+"""Convolutions (reference: python/paddle/nn/functional/conv.py).
+
+All convs lower to a single ``lax.conv_general_dilated`` — XLA tiles these
+onto the MXU; there is no kernel zoo to pick from (the reference's
+phi/kernels/gpu/conv_*cudnn* selection logic has no analog here).
+Paddle's NCHW is the API default; NHWC is accepted and is the
+layout-friendly choice on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.dispatch import apply, unwrap
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int,)):
+        return (v,) * n
+    v = tuple(int(i) for i in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _norm_padding(padding, n, strides=None):
+    """paddle padding: int | pair-list | 'SAME' | 'VALID' -> lax padding."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # may include batch/channel dims (paddle 4-elem form); take last n
+        pads = [tuple(p) for p in padding]
+        return pads[-n:]
+    raise ValueError(f"bad padding {padding!r}")
+
+
+def _dim_numbers(data_format, n):
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        lhs = "NC" + "DHW"[3 - n:]
+        out = lhs
+    else:
+        lhs = "N" + "DHW"[3 - n:] + "C"
+        out = lhs
+    rhs = "OI" + "DHW"[3 - n:]
+    return (lhs, rhs, out)
+
+
+def _convnd(x, weight, bias, stride, padding, dilation, groups, data_format, n,
+            op_name):
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+    dn = _dim_numbers(data_format, n)
+
+    def fn(v, w, *b):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if b:
+            ch_axis = 1 if data_format.startswith("NC") else out.ndim - 1
+            shape = [1] * out.ndim
+            shape[ch_axis] = b[0].size
+            out = out + b[0].reshape(shape)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(fn, *args, op_name=op_name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, data_format, 1, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, data_format, 2, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, data_format, 3, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, data_format, n, output_size, op_name):
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    opad = _norm_tuple(output_padding, n)
+    pad = _norm_padding(padding, n)
+    dn = _dim_numbers(data_format, n)
+
+    def fn(v, w, *b):
+        # paddle weight layout for transpose conv: (in, out/groups, *k).
+        # conv_transpose via gradient trick: lhs_dilation implements stride.
+        kshape = w.shape[2:]
+        if isinstance(pad, str):
+            pads = None
+        else:
+            pads = pad
+        # effective kernel
+        eff = [dil[i] * (kshape[i] - 1) + 1 for i in range(n)]
+        if pads is None:
+            if pad == "VALID":
+                lo_hi = [(eff[i] - 1, eff[i] - 1 + opad[i]) for i in range(n)]
+            else:  # SAME
+                lo_hi = [(eff[i] // 2, eff[i] - 1 - eff[i] // 2 + opad[i]) for i in range(n)]
+        else:
+            lo_hi = [(eff[i] - 1 - pads[i][0], eff[i] - 1 - pads[i][1] + opad[i]) for i in range(n)]
+        # weight (I, O/g, *k) -> (O, I/g, *k) flipped
+        wt = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            i_total = wt.shape[0]
+            og = wt.shape[1]
+            wt = wt.reshape((groups, i_total // groups, og) + kshape)
+            wt = jnp.moveaxis(wt, 2, 1).reshape((groups * og, i_total // groups) + kshape)
+        else:
+            wt = jnp.swapaxes(wt, 0, 1)
+        out = jax.lax.conv_general_dilated(
+            v, wt, window_strides=(1,) * n, padding=lo_hi,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b:
+            ch_axis = 1 if data_format.startswith("NC") else out.ndim - 1
+            shape = [1] * out.ndim
+            shape[ch_axis] = b[0].size
+            out = out + b[0].reshape(shape)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    out = apply(fn, *args, op_name=op_name)
+    if output_size is not None:
+        # crop/verify to requested spatial size
+        v = out._value if hasattr(out, "_value") else out
+        spatial_off = 2 if data_format.startswith("NC") else 1
+        tgt = _norm_tuple(output_size, n)
+        cur = v.shape[spatial_off:spatial_off + n]
+        if tuple(cur) != tuple(tgt):
+            raise ValueError(f"conv_transpose output_size {tgt} incompatible with computed {cur}")
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, data_format, 1, output_size, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, data_format, 2, output_size, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, data_format, 3, output_size, "conv3d_transpose")

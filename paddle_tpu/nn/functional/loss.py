@@ -1,0 +1,293 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.dispatch import apply, unwrap
+from ...tensor.tensor import Tensor
+
+
+def _reduce(out, reduction, weight_sum=None):
+    if reduction == "mean":
+        if weight_sum is not None:
+            return jnp.sum(out) / weight_sum
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """Softmax cross-entropy. int labels or soft labels, ignore_index,
+    class weights, label smoothing — matching the reference's contract."""
+
+    def fn(logits, lab, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+        k = logits.shape[axis]
+        if soft_label:
+            soft = lab
+            if label_smoothing > 0:
+                soft = (1 - label_smoothing) * soft + label_smoothing / k
+            out = -jnp.sum(soft * logp, axis=axis)
+            if w:
+                cls_w = jnp.sum(soft * w[0], axis=axis)
+                out = out * cls_w
+            return _reduce(out, reduction)
+        ids = lab.astype(jnp.int32)
+        squeeze = False
+        if ids.ndim == logp.ndim:  # (N, ..., 1) int form
+            ids = jnp.squeeze(ids, axis=axis)
+            squeeze = True
+        valid = ids != ignore_index
+        safe = jnp.where(valid, ids, 0)
+        if label_smoothing > 0:
+            nll = -(jnp.take_along_axis(logp, safe[..., None] if axis in (-1, logp.ndim - 1)
+                                        else jnp.expand_dims(safe, axis), axis=axis).squeeze(axis)
+                    * (1 - label_smoothing) + label_smoothing / k * jnp.sum(logp, axis=axis))
+        else:
+            nll = -jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis if axis >= 0 else logp.ndim + axis), axis=axis
+            ).squeeze(axis if axis >= 0 else logp.ndim + axis)
+        if w:
+            cw = jnp.take(w[0], safe, axis=0)
+            nll = nll * cw
+            wsum = jnp.sum(jnp.where(valid, cw, 0.0))
+        else:
+            wsum = jnp.sum(valid.astype(nll.dtype))
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(wsum, 1e-12)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply(fn, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction), input, label,
+                 op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label, op_name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        out = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(out, reduction)
+
+    return apply(fn, input, label, op_name="smooth_l1_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def fn(logp, lab, *w):
+        ids = lab.astype(jnp.int32)
+        valid = ids != ignore_index
+        safe = jnp.where(valid, ids, 0)
+        nll = -jnp.take_along_axis(logp, safe[:, None] if logp.ndim == 2 else jnp.expand_dims(safe, 1), axis=1)
+        nll = nll.squeeze(1)
+        if w:
+            cw = jnp.take(w[0], safe, axis=0)
+            nll = nll * cw
+            wsum = jnp.sum(jnp.where(valid, cw, 0.0))
+        else:
+            wsum = jnp.sum(valid.astype(nll.dtype))
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(wsum, 1e-12)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply(fn, *args, op_name="nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        out = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            out = out * w[0]
+        return _reduce(out, reduction)
+
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply(fn, *args, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, y, *rest):
+        i = 0
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+            # -[pw * y * log σ(z) + (1-y) * log σ(-z)], in stable log form
+            base = -(pw * y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        else:
+            # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+            base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if weight is not None:
+            base = base * rest[i]
+        return _reduce(base, reduction)
+
+    args = [logit, label]
+    if pos_weight is not None:
+        args.append(pos_weight)
+    if weight is not None:
+        args.append(weight)
+    return apply(fn, *args, op_name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(logp, t):
+        if log_target:
+            out = jnp.exp(t) * (t - logp)
+        else:
+            out = t * (jnp.log(jnp.clip(t, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(out) / logp.shape[0]
+        return _reduce(out, reduction)
+
+    return apply(fn, input, label, op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply(lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+                 input, other, label, op_name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply(lambda x, y: _reduce(jnp.where(y == 1, x, jnp.maximum(0.0, margin - x)), reduction),
+                 input, label, op_name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        out = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(out, reduction)
+
+    return apply(fn, input1, input2, label, op_name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p + epsilon, -1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p + epsilon, -1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p + epsilon, -1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply(fn, input, positive, negative, op_name="triplet_margin_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    """Focal loss (reference F.sigmoid_focal_loss; PP-YOLOE/RetinaNet head)."""
+
+    def fn(z, y, *nrm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        mod = (1 - p_t) ** gamma
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        out = a_t * mod * ce
+        if nrm:
+            out = out / nrm[0]
+        return _reduce(out, reduction)
+
+    args = (logit, label) if normalizer is None else (logit, label, normalizer)
+    return apply(fn, *args, op_name="sigmoid_focal_loss")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), input, label, op_name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply(lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+                 input, label, op_name="log_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean",
+             norm_by_times=False):
+    """CTC via the standard dynamic program in log space, vmapped over batch
+    and scanned over time (compiler-friendly: no data-dependent Python)."""
+
+    def single(lp, lab, T, L):
+        # lp: (Tmax, C) log-softmax already applied by caller contract
+        Lmax = lab.shape[0]
+        ext = jnp.full((2 * Lmax + 1,), blank, dtype=lab.dtype)
+        ext = ext.at[1::2].set(lab)
+        S = ext.shape[0]
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        alpha0 = jnp.full((S,), neg_inf).at[0].set(lp[0, blank])
+        alpha0 = alpha0.at[1].set(jnp.where(L > 0, lp[0, ext[1]], neg_inf))
+
+        def step(alpha, t):
+            lpt = lp[t]
+            shift1 = jnp.concatenate([jnp.array([neg_inf], lp.dtype), alpha[:-1]])
+            shift2 = jnp.concatenate([jnp.array([neg_inf, neg_inf], lp.dtype), alpha[:-2]])
+            allow2 = (ext != blank) & (ext != jnp.roll(ext, 2))
+            cand = jnp.logaddexp(alpha, shift1)
+            cand = jnp.where(allow2, jnp.logaddexp(cand, shift2), cand)
+            new = cand + lpt[ext]
+            new = jnp.where(t < T, new, alpha)
+            return new, None
+
+        alphaT, _ = jax.lax.scan(step, alpha0, jnp.arange(1, lp.shape[0]))
+        end = 2 * L
+        a = alphaT[end]
+        b = jnp.where(L > 0, alphaT[jnp.maximum(end - 1, 0)], neg_inf)
+        return -jnp.logaddexp(a, b)
+
+    def fn(lp, lab, il, ll):
+        # paddle layout: logits (Tmax, B, C); normalize then go batch-major
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        lpb = jnp.moveaxis(lp, 0, 1)  # (B, Tmax, C)
+        losses = jax.vmap(single)(lpb, lab, il, ll)
+        if norm_by_times:
+            losses = losses / il.astype(losses.dtype)
+        if reduction == "mean":
+            return jnp.mean(losses / ll.astype(losses.dtype))
+        if reduction == "sum":
+            return jnp.sum(losses)
+        return losses
+
+    return apply(fn, log_probs, labels, input_lengths, label_lengths, op_name="ctc_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def fn(p, y):
+        yf = jax.nn.one_hot(y.squeeze(-1).astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+        inter = 2 * jnp.sum(p * yf, axis=-1)
+        union = jnp.sum(p, axis=-1) + jnp.sum(yf, axis=-1)
+        return jnp.mean(1 - (inter + epsilon) / (union + epsilon))
+
+    return apply(fn, input, label, op_name="dice_loss")

@@ -1,0 +1,172 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+batch_norm keeps the reference's running-stat convention:
+``running = momentum * running + (1 - momentum) * batch`` (momentum=0.9).
+Running stats update by rebinding the buffer tensors — captured by
+``Layer.bind`` for the functional/jit path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.dispatch import apply, unwrap
+from ...tensor.tensor import Tensor
+
+
+def _ch_axis(ndim, data_format):
+    return 1 if data_format.startswith("NC") else ndim - 1
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    nd = unwrap(x).ndim
+    ch = _ch_axis(nd, data_format)
+    reduce_axes = tuple(i for i in range(nd) if i != ch)
+    use_batch = training and not use_global_stats
+    shape = [1] * nd
+    shape[ch] = -1
+    mean_used = None if use_batch else unwrap(running_mean)
+    var_used = None if use_batch else unwrap(running_var)
+
+    def fn(v, *wb):
+        # stats computed INSIDE the op (grads flow through them); the op
+        # also returns them so the running update reuses the same values
+        if use_batch:
+            m = jnp.mean(v, axis=reduce_axes)
+            var = jnp.var(v, axis=reduce_axes)
+        else:
+            m, var = mean_used, var_used
+        out = (v - m.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+        if wb:
+            out = out * wb[0].reshape(shape)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(shape)
+        return out, jax.lax.stop_gradient(m), jax.lax.stop_gradient(var)
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    out, batch_mean, batch_var = apply(fn, *args, op_name="batch_norm")
+    if use_batch and isinstance(running_mean, Tensor):
+        running_mean._value = (momentum * running_mean._value
+                               + (1 - momentum) * batch_mean._value.astype(running_mean.dtype))
+        running_var._value = (momentum * running_var._value
+                              + (1 - momentum) * batch_var._value.astype(running_var.dtype))
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n = len(tuple(normalized_shape))
+
+    def fn(v, *wb):
+        axes = tuple(range(v.ndim - n, v.ndim))
+        m = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - m) * jax.lax.rsqrt(var + epsilon)
+        if wb:
+            out = out * wb[0]
+            if len(wb) > 1:
+                out = out + wb[1]
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply(fn, *args, op_name="layer_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def fn(v, *wb):
+        ch = _ch_axis(v.ndim, data_format)
+        vm = jnp.moveaxis(v, ch, 1) if ch != 1 else v
+        N, C = vm.shape[0], vm.shape[1]
+        rest = vm.shape[2:]
+        g = vm.reshape((N, num_groups, C // num_groups) + rest)
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) * jax.lax.rsqrt(var + epsilon)).reshape(vm.shape)
+        if wb:
+            shape = [1, C] + [1] * len(rest)
+            out = out * wb[0].reshape(shape)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(shape)
+        return jnp.moveaxis(out, 1, ch) if ch != 1 else out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply(fn, *args, op_name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    def fn(v, *wb):
+        ch = _ch_axis(v.ndim, data_format)
+        axes = tuple(i for i in range(v.ndim) if i not in (0, ch))
+        m = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - m) * jax.lax.rsqrt(var + eps)
+        if wb:
+            shape = [1] * v.ndim
+            shape[ch] = -1
+            out = out * wb[0].reshape(shape)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply(fn, *args, op_name="instance_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return apply(lambda v: v / jnp.maximum(
+        jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p), epsilon),
+        x, op_name="normalize")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def fn(v):
+        ch = _ch_axis(v.ndim, data_format)
+        sq = jnp.square(v)
+        vm = jnp.moveaxis(sq, ch, -1)
+        pad = [(0, 0)] * (vm.ndim - 1) + [(size // 2, (size - 1) // 2)]
+        pd = jnp.pad(vm, pad)
+        win = jax.lax.reduce_window(pd, 0.0, jax.lax.add,
+                                    (1,) * (vm.ndim - 1) + (size,),
+                                    (1,) * vm.ndim, "VALID")
+        win = jnp.moveaxis(win, -1, ch)
+        return v / jnp.power(k + alpha * win, beta)
+
+    return apply(fn, x, op_name="local_response_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (modern-LLM staple; reference has fused_rms_norm in incubate)."""
+
+    def fn(v, *w):
+        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (v.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    args = (x,) if weight is None else (x, weight)
+    return apply(fn, *args, op_name="rms_norm")

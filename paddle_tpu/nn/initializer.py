@@ -1,0 +1,168 @@
+"""Weight initializers (reference: python/paddle/nn/initializer/).
+
+Each initializer is a callable ``(shape, dtype) -> jax array`` drawing from
+the framework RNG (so ``paddle_tpu.seed`` reproduces inits).  fan_in/fan_out
+follow the reference's conv-aware convention (receptive field included).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtypes as _dt
+from ..framework import random as _rng
+
+
+def _fans(shape):
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    rf = 1
+    for s in shape[2:]:
+        rf *= s
+    return shape[1] * rf, shape[0] * rf
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(shape, self.value, dtype=_dt.to_jax(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        jd = _dt.to_jax(dtype)
+        return self.mean + self.std * jax.random.normal(_rng.next_key(), tuple(shape), dtype=jd)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype=None):
+        jd = _dt.to_jax(dtype)
+        z = jax.random.truncated_normal(_rng.next_key(), self.a, self.b, tuple(shape), dtype=jd)
+        return self.mean + self.std * z
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        jd = _dt.to_jax(dtype)
+        return jax.random.uniform(_rng.next_key(), tuple(shape), dtype=jd,
+                                  minval=self.low, maxval=self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        fi, fo = _fans(shape)
+        fi, fo = self.fan_in or fi, self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return Normal(0.0, std)(shape, dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        fi, fo = _fans(shape)
+        fi, fo = self.fan_in or fi, self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, shape, dtype=None):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2)) if self.nonlinearity in ("relu", "leaky_relu") else 1.0
+        return Normal(0.0, gain / math.sqrt(fi))(shape, dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, shape, dtype=None):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2)) if self.nonlinearity in ("relu", "leaky_relu") else 1.0
+        limit = gain * math.sqrt(3.0 / fi)
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        import numpy as np
+
+        from ..tensor.tensor import Tensor
+
+        v = self.value._value if isinstance(self.value, Tensor) else jnp.asarray(np.asarray(self.value))
+        return v.astype(_dt.to_jax(dtype)).reshape(shape)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        import numpy as np
+
+        out = np.zeros(shape, dtype="float32")
+        oc, ic = shape[0], shape[1]
+        mins = min(oc // self.groups, ic)
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(mins):
+                out[(g * (oc // self.groups) + i, i) + tuple(centers)] = 1.0
+        return jnp.asarray(out, dtype=_dt.to_jax(dtype))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        jd = _dt.to_jax(dtype)
+        return self.gain * jax.nn.initializers.orthogonal()(_rng.next_key(), tuple(shape), jd)
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    return 1.0

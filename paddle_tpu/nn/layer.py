@@ -1,0 +1,460 @@
+"""nn.Layer — the module base class (reference: python/paddle/nn/layer/layers.py).
+
+Reference semantics kept: named parameters/buffers/sublayers, hooks,
+state_dict round-trips, train/eval flags, ``create_parameter`` with
+initializer attrs.  TPU-native addition: every Layer is *functionalizable* —
+:meth:`bind` temporarily swaps a pytree of jax arrays into the parameters
+(and buffers), so a jitted training step can call the SAME model object
+purely: ``with layer.bind(params, buffers): out = layer(x)``.  That bridge
+is what lets one model definition serve eager mode, `to_static`, and
+pjit/shard_map distribution without a separate "functional model" rewrite.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+
+import jax.numpy as jnp
+
+from ..framework import dtypes as _dt
+from ..framework import state as _state
+from ..tensor.tensor import Parameter, Tensor
+from . import initializer as I
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        d = self.__dict__
+        d["_parameters"] = OrderedDict()
+        d["_sub_layers"] = OrderedDict()
+        d["_buffers"] = OrderedDict()
+        d["_non_persistable_buffer_names_set"] = set()
+        d["_forward_pre_hooks"] = OrderedDict()
+        d["_forward_post_hooks"] = OrderedDict()
+        d["training"] = True
+        d["_dtype"] = _dt.canonical_name(dtype)
+        d["_name_scope"] = name_scope or self.__class__.__name__.lower()
+
+    # ------------------------------------------------------------ forward
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError(f"{type(self).__name__}.forward not implemented")
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, args)
+            if result is not None:
+                args = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, args, out)
+            if result is not None:
+                out = result
+        return out
+
+    def register_forward_pre_hook(self, hook):
+        h = _HookRemoveHelper(self._forward_pre_hooks, hook)
+        return h
+
+    def register_forward_post_hook(self, hook):
+        h = _HookRemoveHelper(self._forward_post_hooks, hook)
+        return h
+
+    # ------------------------------------------------------- construction
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        dtype = dtype or self._dtype
+        init = default_initializer
+        name = None
+        trainable = True
+        if attr is not None and attr is not False:
+            from .param_attr import ParamAttr
+
+            if isinstance(attr, ParamAttr):
+                init = attr.initializer or init
+                name = attr.name
+                trainable = attr.trainable
+            elif isinstance(attr, str):
+                name = attr
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        p = Parameter(init(tuple(shape), dtype), name=name, trainable=trainable)
+        return p
+
+    def create_tensor(self, attr=None, dtype=None, name=None):
+        return Tensor(jnp.zeros([], dtype=_dt.to_jax(dtype or self._dtype)), name=name)
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names_set.add(name)
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def add_parameter(self, name, parameter):
+        self._parameters[str(name)] = parameter
+        return parameter
+
+    # ------------------------------------------------------ attr routing
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__() before assigning parameters")
+            for store in (layers, buffers):
+                if store is not None:
+                    store.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__() before assigning sublayers")
+            for store in (params, buffers):
+                if store is not None:
+                    store.pop(name, None)
+            layers[name] = value
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            else:
+                raise TypeError(f"cannot assign non-Parameter to parameter {name!r}")
+        elif buffers is not None and name in buffers:
+            buffers[name] = value if (value is None or isinstance(value, Tensor)) else Tensor(value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + list(self._sub_layers) + list(self._buffers)
+
+    # -------------------------------------------------------- traversal
+    def named_parameters(self, prefix="", include_sublayers=True):
+        for name, p in self._parameters.items():
+            if p is not None:
+                yield (prefix + name if not prefix else f"{prefix}.{name}"), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_parameters(prefix=sub_prefix)
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_buffers(prefix=sub_prefix)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def children(self):
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        for name, l in self._sub_layers.items():
+            if l is not None:
+                yield name, l
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        layers_set = layers_set if layers_set is not None else set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, l in self._sub_layers.items():
+            if l is None or id(l) in layers_set:
+                continue
+            layers_set.add(id(l))
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, l
+            yield from l.named_sublayers(prefix=sub_prefix, include_self=False, layers_set=layers_set)
+
+    def apply(self, fn):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------- mode / dtype
+    def train(self):
+        self.training = True
+        for l in self.children():
+            l.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.children():
+            l.eval()
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        def cast(t):
+            new = t
+            if dtype is not None and jnp.issubdtype(t._value.dtype, jnp.floating):
+                new._value = t._value.astype(_dt.to_jax(dtype))
+            if device is not None:
+                new._value = new._to_device(device)._value
+            return new
+
+        for p in self.parameters():
+            cast(p)
+        for b in self.buffers():
+            cast(b)
+        if dtype is not None:
+            self._dtype = _dt.canonical_name(dtype)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    # ------------------------------------------------------- state dicts
+    def state_dict(self, destination=None, include_sublayers=True, structured_name_prefix="",
+                   use_hook=True, include_non_persistable_buffer=False):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self._parameters.items():
+            if p is not None:
+                dest[structured_name_prefix + name] = p
+        for name, b in self._buffers.items():
+            if b is None:
+                continue
+            if name in self._non_persistable_buffer_names_set and not include_non_persistable_buffer:
+                continue
+            dest[structured_name_prefix + name] = b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is not None:
+                    layer.state_dict(dest, True, structured_name_prefix + lname + ".")
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k in own:
+                val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                own[k]._value = val.astype(own[k].dtype).reshape(own[k]._value.shape)
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ------------------------------------- functional bridge (TPU-native)
+    def raw_state(self, trainable_only=False):
+        """Pytree of jax arrays: {name: value} for params (and buffers)."""
+        params = OrderedDict((k, p._value) for k, p in self.named_parameters()
+                             if not trainable_only or not p.stop_gradient)
+        buffers = OrderedDict((k, b._value) for k, b in self.named_buffers())
+        return params, buffers
+
+    @contextlib.contextmanager
+    def bind(self, params=None, buffers=None):
+        """Temporarily swap jax arrays into parameters/buffers.
+
+        Inside the context the layer computes with the given arrays (which
+        may be jit tracers or sharded arrays); on exit originals are
+        restored.  Buffer mutations during forward (e.g. BN running stats)
+        are captured in ``captured_buffers`` before restore.
+        """
+        named_p = dict(self.named_parameters())
+        named_b = dict(self.named_buffers())
+        saved_p = {k: t._value for k, t in named_p.items()}
+        saved_b = {k: t._value for k, t in named_b.items()}
+        saved_nodes = {k: (t._grad_node, t.stop_gradient) for k, t in named_p.items()}
+        if params:
+            for k, v in params.items():
+                named_p[k]._value = v
+        if buffers:
+            for k, v in buffers.items():
+                named_b[k]._value = v
+        self._captured_buffers = None
+        try:
+            yield self
+        finally:
+            self._captured_buffers = {k: t._value for k, t in named_b.items()}
+            for k, t in named_p.items():
+                t._value = saved_p[k]
+                t._grad_node, t.stop_gradient = saved_nodes[k]
+            for k, t in named_b.items():
+                t._value = saved_b[k]
+
+    # -------------------------------------------------------------- misc
+    def full_name(self):
+        return self._name_scope
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self._sub_layers.items():
+            mod_str = repr(l)
+            mod_str = _addindent(mod_str, 2)
+            lines.append(f"({name}): {mod_str}")
+        main = self.__class__.__name__ + "("
+        if extra:
+            main += extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+    def extra_repr(self):
+        return ""
+
+
+class _HookRemoveHelper:
+    _next_id = [0]
+
+    def __init__(self, hooks_dict, hook):
+        self._hooks = hooks_dict
+        self._id = self._next_id[0]
+        self._next_id[0] += 1
+        hooks_dict[self._id] = hook
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+def _addindent(s, n):
+    lines = s.split("\n")
+    if len(lines) == 1:
+        return s
+    return lines[0] + "\n" + "\n".join(" " * n + l for l in lines[1:])
+
+
+class Sequential(Layer):
+    """reference: paddle.nn.Sequential."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and (
+            len(layers[0]) == 0 or isinstance(layers[0][0], (list, tuple))
+        ):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                if isinstance(layer, tuple):
+                    self.add_sublayer(layer[0], layer[1])
+                else:
+                    self.add_sublayer(str(i), layer)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        keys = list(self._sub_layers)
+        return self._sub_layers[keys[idx]]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for i, l in enumerate(sublayers or []):
+            self.add_sublayer(str(i), l)
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def insert(self, index, layer):
+        layers = list(self._sub_layers.values())
+        layers.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(layers):
+            self._sub_layers[str(i)] = l
+
+    def extend(self, layers):
+        for l in layers:
+            self.append(l)
+        return self
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        return self._sub_layers[str(idx if idx >= 0 else idx + len(self))]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx if idx >= 0 else idx + len(self))]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())

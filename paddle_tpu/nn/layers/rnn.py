@@ -1,0 +1,357 @@
+"""RNN layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native design: the time loop is ``lax.scan`` (one compiled loop, no
+per-step Python dispatch — contrast the reference's cudnn RNN kernels or
+its Python while-op lowering).  Cells are pure step functions; multi-layer
+and bidirectional wrappers compose scans.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.dispatch import apply, unwrap
+from ...tensor.tensor import Tensor
+from .. import functional as F
+from .. import initializer as I
+from ..layer import Layer, LayerList
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32", init_value=0.0,
+                           batch_dim_idx=0):
+        b = unwrap(batch_ref).shape[batch_dim_idx]
+        shape = shape or getattr(self, "state_shape")
+        if isinstance(shape, (list, tuple)) and isinstance(shape[0], (list, tuple)):
+            return tuple(Tensor(jnp.full((b,) + tuple(s), init_value, jnp.float32)) for s in shape)
+        return Tensor(jnp.full((b,) + tuple(shape), init_value, jnp.float32))
+
+
+def _uniform_std(hidden_size):
+    return I.Uniform(-1.0 / math.sqrt(hidden_size), 1.0 / math.sqrt(hidden_size))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        self.activation = activation
+        std = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], weight_ih_attr,
+                                               default_initializer=std)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], weight_hh_attr,
+                                               default_initializer=std)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True, default_initializer=std)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True, default_initializer=std)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wi, wh, *biases):
+            z = x @ wi.T + h @ wh.T
+            for b in biases:
+                z = z + b
+            return act(z)
+
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args += [self.bias_ih, self.bias_hh]
+        h = apply(fn, *args, op_name="simple_rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=0, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        std = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], weight_ih_attr,
+                                               default_initializer=std)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], weight_hh_attr,
+                                               default_initializer=std)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=std)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=std)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        h0, c0 = states
+
+        def fn(x, h, c, wi, wh, *biases):
+            z = x @ wi.T + h @ wh.T
+            for b in biases:
+                z = z + b
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        args = [inputs, h0, c0, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args += [self.bias_ih, self.bias_hh]
+        h, c = apply(fn, *args, op_name="lstm_cell")
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        std = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], weight_ih_attr,
+                                               default_initializer=std)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], weight_hh_attr,
+                                               default_initializer=std)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=std)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=std)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, wi, wh, *biases):
+            gi = x @ wi.T
+            gh = h @ wh.T
+            if biases:
+                gi = gi + biases[0]
+                gh = gh + biases[1]
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (h - c) * z + c
+
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args += [self.bias_ih, self.bias_hh]
+        h = apply(fn, *args, op_name="gru_cell")
+        return h, h
+
+
+class RNN(Layer):
+    """Runs a cell over time with lax.scan (reference: paddle.nn.RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            batch_ref = inputs
+            initial_states = self.cell.get_initial_states(
+                batch_ref, getattr(self.cell, "state_shape"),
+                batch_dim_idx=1 if self.time_major else 0)
+        # collect cell params for a pure scan body
+        named = list(self.cell.named_parameters())
+        pvals = [p._value for _, p in named]
+        is_lstm = isinstance(initial_states, (tuple, list))
+        s_vals = tuple(unwrap(s) for s in initial_states) if is_lstm else unwrap(initial_states)
+        seq_axis = 0 if self.time_major else 1
+        seq_lens = unwrap(sequence_length) if sequence_length is not None else None
+        cell = self.cell
+        reverse = self.is_reverse
+
+        def fn(x, *flat):
+            n_states = len(s_vals) if is_lstm else 1
+            states0 = tuple(flat[:n_states]) if is_lstm else flat[0]
+            params = flat[n_states if is_lstm else 1:]
+            xs = jnp.moveaxis(x, seq_axis, 0)
+            if reverse:
+                xs = jnp.flip(xs, 0)
+
+            def step(carry, xt):
+                t, st = carry
+                with cell.bind({k: v for (k, _), v in zip(named, params)}):
+                    out, new_st = _pure_cell_step(cell, xt, st, is_lstm)
+                if seq_lens is not None:
+                    m = (t < seq_lens)[:, None]
+                    if is_lstm:
+                        new_st = tuple(jnp.where(m, ns, s) for ns, s in zip(new_st, st))
+                        out = jnp.where(m, out, jnp.zeros_like(out))
+                    else:
+                        new_st = jnp.where(m, new_st, st)
+                        out = jnp.where(m, out, jnp.zeros_like(out))
+                return (t + 1, new_st), out
+
+            (_, final), ys = jax.lax.scan(step, (jnp.asarray(0), states0), xs)
+            if reverse:
+                ys = jnp.flip(ys, 0)
+            ys = jnp.moveaxis(ys, 0, seq_axis)
+            if is_lstm:
+                return (ys,) + tuple(final)
+            return ys, final
+
+        args = [inputs] + (list(initial_states) if is_lstm else [initial_states]) + \
+               [p for _, p in named]
+        outs = apply(fn, *args, op_name="rnn_scan")
+        if is_lstm:
+            return outs[0], tuple(outs[1:])
+        return outs[0], outs[1]
+
+
+def _pure_cell_step(cell, xt, st, is_lstm):
+    """Call the cell's pure math on raw arrays (cell params already bound).
+    Grad recording is off — the outer scan op is the single tape node."""
+    from ...framework.state import no_grad_ctx
+    from ...tensor.tensor import Tensor as T
+
+    with no_grad_ctx():
+        x_t = T(xt)
+        s_t = tuple(T(s) for s in st) if is_lstm else T(st)
+        out, new_state = cell.forward(x_t, s_t)
+    if is_lstm:
+        return out._value, tuple(s._value for s in new_state)
+    return out._value, new_state._value
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw, states_bw = (initial_states if initial_states is not None else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length)
+        from ...tensor import manipulation as M
+
+        return M.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirect else 1
+        cell_cls = {"RNN_TANH": SimpleRNNCell, "RNN_RELU": SimpleRNNCell,
+                    "LSTM": LSTMCell, "GRU": GRUCell}[mode]
+        kw = {}
+        if mode == "RNN_RELU":
+            kw["activation"] = "relu"
+        self._rnns = LayerList()
+        for layer in range(num_layers):
+            isz = input_size if layer == 0 else hidden_size * num_dir
+            if self.bidirect:
+                self._rnns.append(BiRNN(cell_cls(isz, hidden_size, **kw),
+                                        cell_cls(isz, hidden_size, **kw), time_major))
+            else:
+                self._rnns.append(RNN(cell_cls(isz, hidden_size, **kw), False, time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        finals = []
+        for i, rnn in enumerate(self._rnns):
+            st = None
+            if initial_states is not None:
+                st = _slice_states(initial_states, i, self.bidirect, self.mode == "LSTM")
+            out, fs = rnn(out, st, sequence_length)
+            finals.append(fs)
+            if self.dropout and i < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        return out, _stack_states(finals, self.bidirect, self.mode == "LSTM")
+
+
+def _slice_states(states, layer, bidirect, is_lstm):
+    from ...tensor import manipulation
+
+    def pick(s, idx):
+        return s[idx]
+
+    if is_lstm:
+        h, c = states
+        if bidirect:
+            return ((pick(h, 2 * layer), pick(c, 2 * layer)),
+                    (pick(h, 2 * layer + 1), pick(c, 2 * layer + 1)))
+        return (pick(h, layer), pick(c, layer))
+    h = states
+    if bidirect:
+        return (pick(h, 2 * layer), pick(h, 2 * layer + 1))
+    return pick(h, layer)
+
+
+def _stack_states(finals, bidirect, is_lstm):
+    from ...tensor import manipulation as M
+
+    if is_lstm:
+        hs, cs = [], []
+        for f in finals:
+            if bidirect:
+                (h1, c1), (h2, c2) = f
+                hs += [h1, h2]
+                cs += [c1, c2]
+            else:
+                h, c = f
+                hs.append(h)
+                cs.append(c)
+        return M.stack(hs, 0), M.stack(cs, 0)
+    hs = []
+    for f in finals:
+        if bidirect:
+            hs += [f[0], f[1]]
+        else:
+            hs.append(f)
+    return M.stack(hs, 0)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kw):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)

@@ -1,0 +1,35 @@
+"""ParamAttr / regularizers (reference: python/paddle/base/param_attr.py,
+python/paddle/regularizer.py)."""
+
+from __future__ import annotations
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+    def __call__(self, param_value):
+        import jax.numpy as jnp
+
+        return self.coeff * jnp.sign(param_value)
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+    def __call__(self, param_value):
+        return self.coeff * param_value

@@ -1,0 +1,112 @@
+"""nn.utils (reference: python/paddle/nn/utils/): clip_grad helpers, weight
+norm, parameter vector utilities."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...tensor import manipulation as M
+
+    return M.concat([p.reshape([-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    off = 0
+    v = vec._value
+    for p in parameters:
+        n = p.size
+        p._value = v[off:off + n].reshape(p._value.shape).astype(p.dtype)
+        off += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros([]))
+    total = jnp.linalg.norm(jnp.stack([jnp.linalg.norm(g._value.reshape(-1), norm_type)
+                                       for g in grads]), norm_type)
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for g in grads:
+        g._value = g._value * clip_coef
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -clip_value, clip_value)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize layer.weight = g * v/||v|| (computed on access)."""
+    import jax
+
+    w = getattr(layer, name)
+    v = w._value
+    if dim is None:
+        g0 = jnp.linalg.norm(v.reshape(-1))
+    else:
+        axes = tuple(i for i in range(v.ndim) if i != dim)
+        g0 = jnp.sqrt(jnp.sum(jnp.square(v), axis=axes))
+    from ...tensor.tensor import Parameter
+
+    layer.add_parameter(name + "_g", Parameter(g0))
+    layer.add_parameter(name + "_v", Parameter(v))
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        from ...tensor.dispatch import apply
+
+        def fn(g, vv):
+            if dim is None:
+                return g * vv / jnp.linalg.norm(vv.reshape(-1))
+            axes2 = tuple(i for i in range(vv.ndim) if i != dim)
+            norm = jnp.sqrt(jnp.sum(jnp.square(vv), axis=axes2, keepdims=True))
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            return g.reshape(shape) * vv / norm
+
+        new_w = apply(fn, getattr(l, name + "_g"), getattr(l, name + "_v"), op_name="weight_norm")
+        l._buffers[name] = new_w
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    from ...tensor.tensor import Parameter
+
+    w = layer._buffers.pop(name)
+    for suffix in ("_g", "_v"):
+        layer._parameters.pop(name + suffix, None)
+    layer.add_parameter(name, Parameter(w._value))
+    layer._forward_pre_hooks.clear()
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    from ..layers.norm import SpectralNorm
+
+    w = getattr(layer, name)
+    sn = SpectralNorm(w.shape, dim=dim or 0, power_iters=n_power_iterations, eps=eps)
+    layer.add_sublayer(name + "_sn", sn)
+
+    def hook(l, inputs):
+        orig = l._parameters.get(name + "_orig") or l._parameters.get(name)
+        if name + "_orig" not in l._parameters:
+            l._parameters[name + "_orig"] = l._parameters.pop(name)
+            orig = l._parameters[name + "_orig"]
+        l._buffers[name] = sn(orig)
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer

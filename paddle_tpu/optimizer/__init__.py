@@ -1,0 +1,8 @@
+"""paddle.optimizer namespace (reference: python/paddle/optimizer/)."""
+
+from . import lr  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .optimizer import (  # noqa: F401
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, Optimizer,
+    RMSProp, Rprop,
+)

@@ -1,0 +1,76 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py — ClipGradBy*).
+
+Clip classes are callables over [(param, grad)] pairs (eager path) and
+expose ``tree_clip`` for the compiled pytree path — same math both ways.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if getattr(p, "need_clip", True):
+                g = Tensor(jnp.clip(g._value, self.min, self.max))
+            out.append((p, g))
+        return out
+
+    def tree_clip(self, grad_tree):
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, self.min, self.max), grad_tree)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _clip_one(self, g):
+        norm = jnp.linalg.norm(g.reshape(-1))
+        scale = jnp.where(norm > self.clip_norm, self.clip_norm / norm, 1.0)
+        return g * scale
+
+    def __call__(self, params_grads):
+        return [(p, Tensor(self._clip_one(g._value)) if getattr(p, "need_clip", True) else g)
+                for p, g in params_grads]
+
+    def tree_clip(self, grad_tree):
+        return jax.tree_util.tree_map(self._clip_one, grad_tree)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        gs = [g._value for p, g in params_grads if getattr(p, "need_clip", True)]
+        if not gs:
+            return params_grads
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gs))
+        scale = jnp.minimum(self.clip_norm / (global_norm + 1e-6), 1.0)
+        out = []
+        for p, g in params_grads:
+            if getattr(p, "need_clip", True):
+                g = Tensor((g._value.astype(jnp.float32) * scale).astype(g.dtype))
+            out.append((p, g))
+        return out
+
+    def tree_clip(self, grad_tree):
+        leaves = jax.tree_util.tree_leaves(grad_tree)
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+        scale = jnp.minimum(self.clip_norm / (global_norm + 1e-6), 1.0)
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grad_tree)

@@ -1,0 +1,431 @@
+"""Optimizers (reference: python/paddle/optimizer/).
+
+Architecture: each optimizer defines a PURE update rule
+``_rule(p, g, state, lr, hyper) -> (new_p, new_state)`` over jax arrays.
+Eager ``.step()`` folds the rule over parameters (reading ``.grad`` set by
+the tape, honoring grad clip + weight decay ordering like the reference:
+clip first, then decoupled/coupled decay, then the rule).  The SAME rule
+powers the compiled training path (hapi.Model / jit trainers / pjit
+distribution), so optimizer math exists exactly once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.state import no_grad_ctx
+from ..tensor.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    _hyper_defaults: dict = {}
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **hyper):
+        self._lr = learning_rate
+        self._groups = self._build_groups(parameters, weight_decay, hyper)
+        self._grad_clip = grad_clip
+        self._states: dict[int, dict] = {}
+        self._step_count = 0
+
+    # ------------------------------------------------------------- groups
+    def _build_groups(self, parameters, weight_decay, hyper):
+        base = dict(self._hyper_defaults)
+        base.update(hyper)
+        wd = weight_decay
+        if wd is None:
+            wd = 0.0
+        if hasattr(wd, "coeff"):  # L2Decay / L1Decay object
+            wd = wd.coeff
+        groups = []
+        if parameters is None:
+            parameters = []
+        plist = list(parameters)
+        if plist and isinstance(plist[0], dict):
+            for g in plist:
+                gh = dict(base)
+                gwd = g.get("weight_decay", wd)
+                if hasattr(gwd, "coeff"):
+                    gwd = gwd.coeff
+                groups.append({
+                    "params": list(g["params"]),
+                    "weight_decay": gwd,
+                    "lr_scale": g.get("learning_rate", 1.0),
+                    "hyper": gh,
+                })
+        else:
+            groups.append({"params": plist, "weight_decay": wd, "lr_scale": 1.0, "hyper": base})
+        return groups
+
+    @property
+    def _parameter_list(self):
+        return [p for g in self._groups for p in g["params"]]
+
+    # ----------------------------------------------------------------- lr
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    # --------------------------------------------------------------- step
+    @jax.named_scope("optimizer_step")
+    def step(self):
+        with no_grad_ctx():
+            lr = self.get_lr()
+            for group in self._groups:
+                pg = [(p, p.grad) for p in group["params"]
+                      if p.grad is not None and not getattr(p, "stop_gradient", False)]
+                if not pg:
+                    continue
+                if self._grad_clip is not None:
+                    pg = self._grad_clip(pg)
+                for p, g in pg:
+                    # master-weight path: O2/amp keeps an f32 copy, the rule
+                    # runs in f32, the bf16/f16 working copy is re-derived
+                    master = getattr(p, "_master", None)
+                    pv = master if master is not None else p._value
+                    state = self._states.get(id(p))
+                    if state is None:
+                        state = self.init_state(pv)
+                        self._states[id(p)] = state
+                    gv = g._value.astype(pv.dtype) if isinstance(g, Tensor) else g
+                    wd = self._param_weight_decay(p, group)
+                    if getattr(p, "regularizer", None) is not None:
+                        gv = gv + p.regularizer(pv)
+                        wd = 0.0
+                    new_p, new_state = self._rule(
+                        pv, gv, state, lr * group["lr_scale"],
+                        group["hyper"], wd)
+                    if master is not None:
+                        p._master = new_p
+                        p._value = new_p.astype(p._value.dtype)
+                    else:
+                        p._value = new_p
+                    self._states[id(p)] = new_state
+            self._step_count += 1
+
+    def _param_weight_decay(self, p, group):
+        return group["weight_decay"]
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        raise NotImplementedError
+
+    def init_state(self, p_value):
+        return {}
+
+    # ------------------------------------------------------------- utils
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        flat = {}
+        for i, p in enumerate(self._parameter_list):
+            st = self._states.get(id(p))
+            if st:
+                flat[str(i)] = {k: Tensor(v) if isinstance(v, jax.Array) else v
+                                for k, v in st.items()}
+        out = {"states": flat, "step": self._step_count}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, sd):
+        params = self._parameter_list
+        for k, st in sd.get("states", {}).items():
+            p = params[int(k)]
+            self._states[id(p)] = {
+                kk: (vv._value if isinstance(vv, Tensor) else vv) for kk, vv in st.items()
+            }
+        self._step_count = sd.get("step", 0)
+        if "LR_Scheduler" in sd and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(sd["LR_Scheduler"])
+
+    # ------------------------------------------- functional API (jit path)
+    def functional_init(self, param_tree):
+        """Per-leaf optimizer state pytree for the compiled trainer."""
+        return jax.tree_util.tree_map(lambda p: self.init_state(p), param_tree)
+
+    def functional_update(self, param_tree, grad_tree, state_tree, lr):
+        """Pure pytree update — usable under jit/pjit/shard_map.
+        Grad clip (global-norm class) is applied tree-wide first."""
+        if self._grad_clip is not None and hasattr(self._grad_clip, "tree_clip"):
+            grad_tree = self._grad_clip.tree_clip(grad_tree)
+        wd = self._groups[0]["weight_decay"]
+        hyper = self._groups[0]["hyper"]
+
+        def upd(p, g, s):
+            return self._rule(p, g.astype(p.dtype), s, lr, hyper, wd)
+
+        leaves_p, treedef = jax.tree_util.tree_flatten(param_tree)
+        leaves_g = treedef.flatten_up_to(grad_tree)
+        leaves_s = treedef.flatten_up_to(state_tree)
+        new_p, new_s = [], []
+        for p, g, s in zip(leaves_p, leaves_g, leaves_s):
+            np_, ns_ = upd(p, g, s)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return treedef.unflatten(new_p), treedef.unflatten(new_s)
+
+    def _apply_optimize(self, loss, startup_program=None, params_grads=None):
+        self.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        if wd:
+            g = g + wd * p
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    _hyper_defaults = {"momentum": 0.9, "use_nesterov": False}
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         momentum=momentum, use_nesterov=use_nesterov)
+
+    def init_state(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        if wd:
+            g = g + wd * p
+        v = hyper["momentum"] * state["velocity"] + g
+        if hyper["use_nesterov"]:
+            new_p = p - lr * (g + hyper["momentum"] * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    _hyper_defaults = {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8, "lazy_mode": False,
+                       "amsgrad": False}
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None, amsgrad=False, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         beta1=beta1, beta2=beta2, epsilon=epsilon, lazy_mode=lazy_mode,
+                         amsgrad=amsgrad)
+
+    def init_state(self, p):
+        s = {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p),
+             "t": jnp.zeros([], jnp.float32)}
+        return s
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        if wd:  # reference Adam applies coupled L2 (weight_decay as regularizer)
+            g = g + wd * p
+        b1, b2, eps = hyper["beta1"], hyper["beta2"], hyper["epsilon"]
+        t = state["t"] + 1
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        if hyper.get("amsgrad"):
+            vmax = jnp.maximum(state.get("vmax", jnp.zeros_like(v)), vhat)
+            new_p = p - lr * mhat / (jnp.sqrt(vmax) + eps)
+            return new_p.astype(p.dtype), {"m": m, "v": v, "t": t, "vmax": vmax}
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p.astype(p.dtype), {"m": m, "v": v, "t": t}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision, name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        b1, b2, eps = hyper["beta1"], hyper["beta2"], hyper["epsilon"]
+        t = state["t"] + 1
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        new_p = p * (1 - lr * wd) - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p.astype(p.dtype), {"m": m, "v": v, "t": t}
+
+    def _param_weight_decay(self, p, group):
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name or ""):
+            return 0.0
+        return group["weight_decay"]
+
+
+class Adamax(Optimizer):
+    _hyper_defaults = {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8}
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         beta1=beta1, beta2=beta2, epsilon=epsilon)
+
+    def init_state(self, p):
+        return {"m": jnp.zeros_like(p), "u": jnp.zeros_like(p), "t": jnp.zeros([], jnp.float32)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        if wd:
+            g = g + wd * p
+        b1, b2, eps = hyper["beta1"], hyper["beta2"], hyper["epsilon"]
+        t = state["t"] + 1
+        m = b1 * state["m"] + (1 - b1) * g
+        u = jnp.maximum(b2 * state["u"], jnp.abs(g))
+        new_p = p - lr / (1 - b1 ** t) * m / (u + eps)
+        return new_p.astype(p.dtype), {"m": m, "u": u, "t": t}
+
+
+class Adagrad(Optimizer):
+    _hyper_defaults = {"epsilon": 1e-6, "initial_accumulator_value": 0.0}
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         epsilon=epsilon, initial_accumulator_value=initial_accumulator_value)
+
+    def init_state(self, p):
+        return {"moment": jnp.full_like(p, self._groups[0]["hyper"]["initial_accumulator_value"])}
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        if wd:
+            g = g + wd * p
+        mom = state["moment"] + jnp.square(g)
+        new_p = p - lr * g / (jnp.sqrt(mom) + hyper["epsilon"])
+        return new_p.astype(p.dtype), {"moment": mom}
+
+
+class RMSProp(Optimizer):
+    _hyper_defaults = {"rho": 0.95, "epsilon": 1e-6, "momentum": 0.0, "centered": False}
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         rho=rho, epsilon=epsilon, momentum=momentum, centered=centered)
+
+    def init_state(self, p):
+        return {"mean_square": jnp.zeros_like(p), "mean_grad": jnp.zeros_like(p),
+                "momentum": jnp.zeros_like(p)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        if wd:
+            g = g + wd * p
+        rho, eps = hyper["rho"], hyper["epsilon"]
+        ms = rho * state["mean_square"] + (1 - rho) * jnp.square(g)
+        if hyper["centered"]:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + eps)
+        mom = hyper["momentum"] * state["momentum"] + lr * g / denom
+        return (p - mom).astype(p.dtype), {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Adadelta(Optimizer):
+    _hyper_defaults = {"rho": 0.95, "epsilon": 1e-6}
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         rho=rho, epsilon=epsilon)
+
+    def init_state(self, p):
+        return {"avg_sq_grad": jnp.zeros_like(p), "avg_sq_update": jnp.zeros_like(p)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        if wd:
+            g = g + wd * p
+        rho, eps = hyper["rho"], hyper["epsilon"]
+        asg = rho * state["avg_sq_grad"] + (1 - rho) * jnp.square(g)
+        update = jnp.sqrt(state["avg_sq_update"] + eps) / jnp.sqrt(asg + eps) * g
+        asu = rho * state["avg_sq_update"] + (1 - rho) * jnp.square(update)
+        return (p - lr * update).astype(p.dtype), {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class Lamb(Optimizer):
+    _hyper_defaults = {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-6, "lamb_weight_decay": 0.01}
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         beta1=beta1, beta2=beta2, epsilon=epsilon,
+                         lamb_weight_decay=lamb_weight_decay)
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_state(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p), "t": jnp.zeros([], jnp.float32)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        b1, b2, eps = hyper["beta1"], hyper["beta2"], hyper["epsilon"]
+        lwd = hyper["lamb_weight_decay"]
+        t = state["t"] + 1
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + eps) + lwd * p
+        w_norm = jnp.linalg.norm(p.reshape(-1))
+        r_norm = jnp.linalg.norm(r.reshape(-1))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p - lr * trust * r).astype(p.dtype), {"m": m, "v": v, "t": t}
+
+
+class Rprop(Optimizer):
+    _hyper_defaults = {"etas": (0.5, 1.2), "sizes": (1e-6, 50.0)}
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         etas=etas, sizes=learning_rate_range)
+
+    def init_state(self, p):
+        return {"prev": jnp.zeros_like(p), "step_size": jnp.full_like(p, self.get_lr())}
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        em, ep = hyper["etas"]
+        smin, smax = hyper["sizes"]
+        sign = jnp.sign(g * state["prev"])
+        factor = jnp.where(sign > 0, ep, jnp.where(sign < 0, em, 1.0))
+        step = jnp.clip(state["step_size"] * factor, smin, smax)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = p - jnp.sign(g_eff) * step
+        return new_p.astype(p.dtype), {"prev": g_eff, "step_size": step}

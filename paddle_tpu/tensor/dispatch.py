@@ -40,6 +40,14 @@ def apply(fn, *args, op_name: str = "", n_outs: int = 1, **kwargs):
     """
     from .tensor import Tensor
 
+    amp = state.amp_state()
+    if amp is not None and op_name:
+        inner = fn
+
+        def fn(*vs, **kw):  # cast inside the recorded fn so vjp matches fwd
+            return inner(*amp.cast_args(op_name, vs), **kw)
+
+        fn.__name__ = getattr(inner, "__name__", op_name)
     vals = [unwrap(a) for a in args]
     out_val = fn(*vals, **kwargs)
 

@@ -32,7 +32,7 @@ _bool = bool  # guarded against the paddle-style module-level `bool` dtype alias
 
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "grad", "_grad_node", "_retain_grads",
-                 "name", "persistable", "__weakref__")
+                 "name", "persistable", "_master", "__weakref__")
 
     # let Tensor.__r*__ win over np.ndarray ops
     __array_priority__ = 100
@@ -51,6 +51,7 @@ class Tensor:
         self._retain_grads = False
         self.name = name
         self.persistable = False
+        self._master = None  # f32 master weight under amp O2 (see amp.decorate)
 
     # ------------------------------------------------------------ basics
     @property

@@ -1,0 +1,275 @@
+"""Layer/optimizer/AMP/io tests (SURVEY.md §4: API/layer test conventions)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.io import DataLoader, Dataset, TensorDataset, DistributedBatchSampler
+
+
+def test_linear_matches_numpy():
+    paddle.seed(1)
+    lin = nn.Linear(5, 3)
+    x = np.random.randn(4, 5).astype("float32")
+    ref = x @ np.asarray(lin.weight.numpy()) + lin.bias.numpy()
+    np.testing.assert_allclose(lin(paddle.to_tensor(x)).numpy(), ref, rtol=1e-5)
+
+
+def test_conv2d_matches_torch_semantics():
+    import torch
+    import torch.nn.functional as tF
+
+    x = np.random.randn(2, 3, 8, 8).astype("float32")
+    w = np.random.randn(4, 3, 3, 3).astype("float32")
+    b = np.random.randn(4).astype("float32")
+    ours = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b),
+                    stride=2, padding=1).numpy()
+    ref = tF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=2, padding=1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_transpose_matches_torch():
+    import torch
+    import torch.nn.functional as tF
+
+    x = np.random.randn(2, 4, 5, 5).astype("float32")
+    w = np.random.randn(4, 3, 3, 3).astype("float32")  # (in, out, kh, kw)
+    ours = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w), stride=2, padding=1).numpy()
+    ref = tF.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=2, padding=1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_grouped_and_depthwise_conv():
+    import torch
+    import torch.nn.functional as tF
+
+    x = np.random.randn(1, 6, 8, 8).astype("float32")
+    w = np.random.randn(6, 1, 3, 3).astype("float32")
+    ours = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), groups=6, padding=1).numpy()
+    ref = tF.conv2d(torch.tensor(x), torch.tensor(w), groups=6, padding=1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.to_tensor(np.random.randn(8, 3, 4, 4).astype("float32"))
+    bn.train()
+    y = bn(x)
+    m = y.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+    assert abs(float(bn._mean.abs().sum())) > 0
+    bn.eval()
+    y2 = bn(x)  # uses running stats now
+    assert not np.allclose(y2.numpy(), y.numpy())
+
+
+def test_layernorm_matches_torch():
+    import torch
+
+    x = np.random.randn(2, 5, 8).astype("float32")
+    ln = nn.LayerNorm(8)
+    ref = torch.nn.functional.layer_norm(torch.tensor(x), (8,)).numpy()
+    np.testing.assert_allclose(ln(paddle.to_tensor(x)).numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pool_matches_torch():
+    import torch
+    import torch.nn.functional as tF
+
+    x = np.random.randn(2, 3, 8, 8).astype("float32")
+    np.testing.assert_allclose(
+        F.max_pool2d(paddle.to_tensor(x), 2, 2).numpy(),
+        tF.max_pool2d(torch.tensor(x), 2, 2).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1).numpy(),
+        tF.avg_pool2d(torch.tensor(x), 3, 2, 1, count_include_pad=False).numpy(),
+        rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        F.adaptive_avg_pool2d(paddle.to_tensor(x), 3).numpy(),
+        tF.adaptive_avg_pool2d(torch.tensor(x), 3).numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_cross_entropy_matches_torch():
+    import torch
+
+    logits = np.random.randn(6, 10).astype("float32")
+    labels = np.random.randint(0, 10, (6,))
+    ours = float(F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels)))
+    ref = float(torch.nn.functional.cross_entropy(torch.tensor(logits), torch.tensor(labels)))
+    assert abs(ours - ref) < 1e-5
+    # ignore_index + weight
+    w = np.random.rand(10).astype("float32") + 0.5
+    labels2 = labels.copy()
+    labels2[0] = -100
+    ours2 = float(F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels2),
+                                  weight=paddle.to_tensor(w)))
+    ref2 = float(torch.nn.functional.cross_entropy(torch.tensor(logits), torch.tensor(labels2),
+                                                   weight=torch.tensor(w)))
+    assert abs(ours2 - ref2) < 1e-4
+
+
+def test_sdpa_matches_reference():
+    q = np.random.randn(2, 6, 4, 8).astype("float32")
+    out = F.scaled_dot_product_attention(paddle.to_tensor(q), paddle.to_tensor(q),
+                                         paddle.to_tensor(q), is_causal=True)
+    assert out.shape == [2, 6, 4, 8]
+    # causal: first position attends only to itself -> equals v[0]
+    np.testing.assert_allclose(out.numpy()[:, 0], q[:, 0], rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_shapes_and_grad():
+    lstm = nn.LSTM(4, 8, num_layers=1)
+    x = paddle.to_tensor(np.random.randn(3, 7, 4).astype("float32"), stop_gradient=False)
+    out, (h, c) = lstm(x)
+    assert out.shape == [3, 7, 8] and h.shape == [1, 3, 8]
+    out.sum().backward()
+    assert all(p.grad is not None for p in lstm.parameters())
+
+
+def test_sgd_momentum_adam_adamw_converge():
+    for opt_cls, kw in [(paddle.optimizer.SGD, {}),
+                        (paddle.optimizer.Momentum, {"momentum": 0.9}),
+                        (paddle.optimizer.Adam, {}),
+                        (paddle.optimizer.AdamW, {"weight_decay": 0.0})]:
+        paddle.seed(0)
+        lin = nn.Linear(3, 1)
+        opt = opt_cls(learning_rate=0.1, parameters=lin.parameters(), **kw)
+        X = paddle.to_tensor(np.random.randn(32, 3).astype("float32"))
+        y = (X.numpy() @ np.array([[1.0], [2.0], [-1.0]], np.float32))
+        yt = paddle.to_tensor(y)
+        for _ in range(150):
+            loss = F.mse_loss(lin(X), yt)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss) < 0.05, f"{opt_cls.__name__} failed to converge: {float(loss)}"
+
+
+def test_adam_matches_torch_trajectory():
+    import torch
+
+    w0 = np.random.randn(4, 2).astype("float32")
+    g = np.random.randn(4, 2).astype("float32")
+    p = paddle.Parameter(paddle.to_tensor(w0))
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p])
+    tp = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.Adam([tp], lr=0.01)
+    for _ in range(5):
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        tp.grad = torch.tensor(g)
+        topt.step()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_lr_schedulers():
+    lr = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    vals = [lr()]
+    for _ in range(4):
+        lr.step()
+        vals.append(lr())
+    assert vals == [0.1, 0.1, 0.05, 0.05, 0.025]
+    cos = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(cos() - 1.0) < 1e-9
+    warm = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0, end_lr=0.1)
+    for _ in range(5):
+        warm.step()
+    assert abs(warm() - 0.1) < 1e-9
+
+
+def test_grad_clip_global_norm():
+    p = paddle.Parameter(paddle.ones([4]))
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p],
+                               grad_clip=paddle.optimizer.ClipGradByGlobalNorm(1.0))
+    p.grad = paddle.to_tensor(np.array([10.0, 0, 0, 0], np.float32))
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.0, 1, 1, 1], atol=1e-4)
+
+
+def test_amp_autocast_bf16():
+    import jax.numpy as jnp
+
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        a = paddle.rand([4, 4])
+        b = paddle.rand([4, 4])
+        c = a @ b
+        assert c.dtype == jnp.bfloat16
+        s = F.softmax(c)
+        assert s.dtype == jnp.float32  # black list promotes
+
+
+def test_grad_scaler_skips_on_inf():
+    p = paddle.Parameter(paddle.ones([2]))
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+    p.grad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(p.numpy(), [1.0, 1.0])  # step skipped
+    assert scaler._scale == 1.0  # decreased
+
+
+def test_dataloader_batching_and_shuffle():
+    class Sq(Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return np.float32(i), np.float32(i * i)
+
+    dl = DataLoader(Sq(), batch_size=4, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 2
+    x, y = batches[0]
+    assert x.shape == [4] and y.shape == [4]
+    np.testing.assert_allclose(y.numpy(), x.numpy() ** 2)
+
+
+def test_distributed_batch_sampler_shards():
+    class Ten(Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+    ds = Ten()
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 5
+    assert set(i0) | set(i1) == set(range(10))
+
+
+def test_metric_accuracy():
+    m = paddle.metric.Accuracy()
+    pred = paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32))
+    lab = paddle.to_tensor(np.array([[0], [0]]))
+    correct = m.compute(pred, lab)
+    m.update(correct)
+    assert abs(m.accumulate() - 0.5) < 1e-6
+
+
+def test_sequential_state_dict_roundtrip(tmp_path):
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = model.state_dict()
+    path = str(tmp_path / "m.pdparams")
+    paddle.save(sd, path)
+    model2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model2.set_state_dict(paddle.load(path))
+    x = paddle.rand([2, 4])
+    np.testing.assert_allclose(model(x).numpy(), model2(x).numpy(), rtol=1e-6)
+
+
+def test_initializers_shapes():
+    from paddle_tpu.nn import initializer as I
+
+    for init in [I.XavierUniform(), I.XavierNormal(), I.KaimingNormal(), I.KaimingUniform(),
+                 I.Normal(0, 0.1), I.Uniform(-1, 1), I.Constant(3.0), I.TruncatedNormal()]:
+        v = init((8, 4), "float32")
+        assert v.shape == (8, 4)
+    o = I.Orthogonal()((4, 4), "float32")
+    np.testing.assert_allclose(np.asarray(o) @ np.asarray(o).T, np.eye(4), atol=1e-5)
