@@ -27,6 +27,8 @@ def linear(x, weight, bias=None, name=None):
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
     if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training and p != 0.0:
+            return apply(lambda v: (v * (1.0 - p)).astype(v.dtype), x, op_name="dropout")
         return x if isinstance(x, Tensor) else Tensor(x)
     key = _rng.next_key()
 
@@ -140,21 +142,36 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
         m = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
         if align_corners and m != "nearest":
-            # resize with endpoint-aligned sampling grid
+            # resize with endpoint-aligned sampling grid, separable per axis
             out = v
             for ax, t in zip(spatial, tgt):
                 n_in = out.shape[ax]
                 if t == 1 or n_in == 1:
                     idx = jnp.zeros((t,), jnp.float32)
                 else:
-                    idx = jnp.linspace(0, n_in - 1, t)
-                lo = jnp.floor(idx).astype(jnp.int32)
-                hi = jnp.clip(lo + 1, 0, n_in - 1)
-                w = (idx - lo).astype(v.dtype)
+                    idx = jnp.linspace(0, n_in - 1, t, dtype=jnp.float32)
                 shape = [1] * out.ndim
                 shape[ax] = t
-                wb = w.reshape(shape)
-                out = jnp.take(out, lo, axis=ax) * (1 - wb) + jnp.take(out, hi, axis=ax) * wb
+                if m == "cubic":
+                    # 4-tap Keys kernel, A=-0.75 (reference/OpenCV convention)
+                    A = -0.75
+                    base = jnp.floor(idx).astype(jnp.int32)
+                    frac = (idx - base).astype(v.dtype)
+                    acc = 0.0
+                    for tap in (-1, 0, 1, 2):
+                        d = jnp.abs(frac - tap)
+                        w = jnp.where(
+                            d <= 1, ((A + 2) * d - (A + 3)) * d * d + 1,
+                            jnp.where(d < 2, ((A * d - 5 * A) * d + 8 * A) * d - 4 * A, 0.0))
+                        src = jnp.clip(base + tap, 0, n_in - 1)
+                        acc = acc + jnp.take(out, src, axis=ax) * w.reshape(shape)
+                    out = acc
+                else:
+                    lo = jnp.floor(idx).astype(jnp.int32)
+                    hi = jnp.clip(lo + 1, 0, n_in - 1)
+                    w = (idx - lo).astype(v.dtype)
+                    wb = w.reshape(shape)
+                    out = jnp.take(out, lo, axis=ax) * (1 - wb) + jnp.take(out, hi, axis=ax) * wb
             return out
         return jax.image.resize(v, new_shape, method=m)
 
@@ -311,11 +328,11 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
     def fn(th):
         n, _, h, w = [int(s) for s in out_shape] if len(out_shape) == 4 else (int(out_shape[0]), None, int(out_shape[2]), int(out_shape[3]))
         if align_corners:
-            xs = jnp.linspace(-1, 1, w)
-            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w, dtype=th.dtype)
+            ys = jnp.linspace(-1, 1, h, dtype=th.dtype)
         else:
-            xs = (jnp.arange(w) * 2 + 1) / w - 1
-            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = ((jnp.arange(w) * 2 + 1) / w - 1).astype(th.dtype)
+            ys = ((jnp.arange(h) * 2 + 1) / h - 1).astype(th.dtype)
         gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
         ones = jnp.ones_like(gx)
         base = jnp.stack([gx, gy, ones], axis=-1)  # (h, w, 3)

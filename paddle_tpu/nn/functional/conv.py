@@ -110,6 +110,25 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
             pads = pad
         # effective kernel
         eff = [dil[i] * (kshape[i] - 1) + 1 for i in range(n)]
+        opad = list(_norm_tuple(output_padding, n))
+        if output_size is not None:
+            # reference semantics: output_size implies output_padding in
+            # [0, stride); derive it from the zero-opad result size
+            spatial_off = 2 if data_format.startswith("NC") else 1
+            tgt = _norm_tuple(output_size, n)
+            for i in range(n):
+                in_sz = v.shape[spatial_off + i]
+                if pads is None:
+                    base = ((in_sz - 1) * strides[i] + eff[i] if pad == "VALID"
+                            else (in_sz - 1) * strides[i] + 1)
+                else:
+                    base = (in_sz - 1) * strides[i] + eff[i] - pads[i][0] - pads[i][1]
+                extra = int(tgt[i]) - base
+                if not (0 <= extra < strides[i]) and extra != 0:
+                    raise ValueError(
+                        f"conv_transpose output_size {tuple(tgt)} incompatible: dim {i} "
+                        f"needs output_padding {extra} outside [0, {strides[i]})")
+                opad[i] = extra
         if pads is None:
             if pad == "VALID":
                 lo_hi = [(eff[i] - 1, eff[i] - 1 + opad[i]) for i in range(n)]

@@ -40,17 +40,14 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
         else:
             m, var = mean_used, var_used
         out = (v - m.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
-        if wb:
-            out = out * wb[0].reshape(shape)
-            if len(wb) > 1:
-                out = out + wb[1].reshape(shape)
+        wb = list(wb)
+        if weight is not None:
+            out = out * wb.pop(0).reshape(shape)
+        if bias is not None:
+            out = out + wb.pop(0).reshape(shape)
         return out, jax.lax.stop_gradient(m), jax.lax.stop_gradient(var)
 
-    args = [x]
-    if weight is not None:
-        args.append(weight)
-        if bias is not None:
-            args.append(bias)
+    args = [x] + [t for t in (weight, bias) if t is not None]
     out, batch_mean, batch_var = apply(fn, *args, op_name="batch_norm")
     if use_batch and isinstance(running_mean, Tensor):
         running_mean._value = (momentum * running_mean._value
@@ -70,17 +67,14 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
         m = jnp.mean(v, axis=axes, keepdims=True)
         var = jnp.var(v, axis=axes, keepdims=True)
         out = (v - m) * jax.lax.rsqrt(var + epsilon)
-        if wb:
-            out = out * wb[0]
-            if len(wb) > 1:
-                out = out + wb[1]
+        wb = list(wb)
+        if weight is not None:
+            out = out * wb.pop(0)
+        if bias is not None:
+            out = out + wb.pop(0)
         return out
 
-    args = [x]
-    if weight is not None:
-        args.append(weight)
-        if bias is not None:
-            args.append(bias)
+    args = [x] + [t for t in (weight, bias) if t is not None]
     return apply(fn, *args, op_name="layer_norm")
 
 
@@ -96,18 +90,15 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
         m = jnp.mean(g, axis=axes, keepdims=True)
         var = jnp.var(g, axis=axes, keepdims=True)
         out = ((g - m) * jax.lax.rsqrt(var + epsilon)).reshape(vm.shape)
-        if wb:
-            shape = [1, C] + [1] * len(rest)
-            out = out * wb[0].reshape(shape)
-            if len(wb) > 1:
-                out = out + wb[1].reshape(shape)
+        wb = list(wb)
+        shape = [1, C] + [1] * len(rest)
+        if weight is not None:
+            out = out * wb.pop(0).reshape(shape)
+        if bias is not None:
+            out = out + wb.pop(0).reshape(shape)
         return jnp.moveaxis(out, 1, ch) if ch != 1 else out
 
-    args = [x]
-    if weight is not None:
-        args.append(weight)
-        if bias is not None:
-            args.append(bias)
+    args = [x] + [t for t in (weight, bias) if t is not None]
     return apply(fn, *args, op_name="group_norm")
 
 
@@ -120,19 +111,16 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None
         m = jnp.mean(v, axis=axes, keepdims=True)
         var = jnp.var(v, axis=axes, keepdims=True)
         out = (v - m) * jax.lax.rsqrt(var + eps)
-        if wb:
-            shape = [1] * v.ndim
-            shape[ch] = -1
-            out = out * wb[0].reshape(shape)
-            if len(wb) > 1:
-                out = out + wb[1].reshape(shape)
+        wb = list(wb)
+        shape = [1] * v.ndim
+        shape[ch] = -1
+        if weight is not None:
+            out = out * wb.pop(0).reshape(shape)
+        if bias is not None:
+            out = out + wb.pop(0).reshape(shape)
         return out
 
-    args = [x]
-    if weight is not None:
-        args.append(weight)
-        if bias is not None:
-            args.append(bias)
+    args = [x] + [t for t in (weight, bias) if t is not None]
     return apply(fn, *args, op_name="instance_norm")
 
 

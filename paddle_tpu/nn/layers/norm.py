@@ -35,8 +35,11 @@ class _BatchNormBase(Layer):
             self.bias = self.create_parameter([num_features], attr=bias_attr, is_bias=True)
         else:
             self.bias = None
-        self.register_buffer("_mean", Tensor(jnp.zeros([num_features])))
-        self.register_buffer("_variance", Tensor(jnp.ones([num_features])))
+        from ...framework import dtypes as _dt
+
+        jd = _dt.to_jax(self._dtype)  # x64 mode makes dtype-less zeros f64
+        self.register_buffer("_mean", Tensor(jnp.zeros([num_features], dtype=jd)))
+        self.register_buffer("_variance", Tensor(jnp.ones([num_features], dtype=jd)))
 
     def forward(self, x):
         return F.batch_norm(x, self._mean, self._variance, self.weight, self.bias,

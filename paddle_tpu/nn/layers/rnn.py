@@ -198,7 +198,10 @@ class RNN(Layer):
                 with cell.bind({k: v for (k, _), v in zip(named, params)}):
                     out, new_st = _pure_cell_step(cell, xt, st, is_lstm)
                 if seq_lens is not None:
-                    m = (t < seq_lens)[:, None]
+                    # scan step t maps to original time T-1-t when reversed,
+                    # so valid steps are the LAST seq_len flipped positions
+                    T = xs.shape[0]
+                    m = ((t >= T - seq_lens) if reverse else (t < seq_lens))[:, None]
                     if is_lstm:
                         new_st = tuple(jnp.where(m, ns, s) for ns, s in zip(new_st, st))
                         out = jnp.where(m, out, jnp.zeros_like(out))

@@ -52,8 +52,8 @@ class MultiHeadAttention(Layer):
             v = self._shape(self.v_proj(value if value is not None else key))
             return self.StaticCache(k, v)
         b = key.shape[0]
-        k = Tensor(jnp.zeros([b, 0, self.num_heads, self.head_dim]))
-        v = Tensor(jnp.zeros([b, 0, self.num_heads, self.head_dim]))
+        k = Tensor(jnp.zeros([b, 0, self.num_heads, self.head_dim], jnp.float32))
+        v = Tensor(jnp.zeros([b, 0, self.num_heads, self.head_dim], jnp.float32))
         return self.Cache(k, v)
 
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
@@ -294,4 +294,4 @@ class Transformer(Layer):
 
     @staticmethod
     def generate_square_subsequent_mask(length):
-        return Tensor((jnp.tril(jnp.ones((length, length))) - 1) * 1e9)
+        return Tensor((jnp.tril(jnp.ones((length, length), jnp.float32)) - 1) * 1e9)

@@ -28,7 +28,7 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=Fals
         parameters = [parameters]
     grads = [p.grad for p in parameters if p.grad is not None]
     if not grads:
-        return Tensor(jnp.zeros([]))
+        return Tensor(jnp.zeros([], jnp.float32))
     total = jnp.linalg.norm(jnp.stack([jnp.linalg.norm(g._value.reshape(-1), norm_type)
                                        for g in grads]), norm_type)
     clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)

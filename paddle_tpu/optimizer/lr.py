@@ -256,6 +256,12 @@ class OneCycleLR(LRScheduler):
         up = int(self.phase_pct * self.total_steps)
         if step <= up:
             return self._interp(self.initial_lr, self.max_lr, step / max(up, 1))
+        if self.three_phase:
+            # up → symmetric down to initial_lr → anneal to end_lr
+            if step <= 2 * up:
+                return self._interp(self.max_lr, self.initial_lr, (step - up) / max(up, 1))
+            return self._interp(self.initial_lr, self.end_lr,
+                                (step - 2 * up) / max(self.total_steps - 2 * up, 1))
         return self._interp(self.max_lr, self.end_lr, (step - up) / max(self.total_steps - up, 1))
 
 

@@ -164,18 +164,30 @@ class Optimizer:
         Grad clip (global-norm class) is applied tree-wide first."""
         if self._grad_clip is not None and hasattr(self._grad_clip, "tree_clip"):
             grad_tree = self._grad_clip.tree_clip(grad_tree)
-        wd = self._groups[0]["weight_decay"]
-        hyper = self._groups[0]["hyper"]
-
-        def upd(p, g, s):
-            return self._rule(p, g.astype(p.dtype), s, lr, hyper, wd)
 
         leaves_p, treedef = jax.tree_util.tree_flatten(param_tree)
         leaves_g = treedef.flatten_up_to(grad_tree)
         leaves_s = treedef.flatten_up_to(state_tree)
+
+        # per-leaf group settings: with multiple param groups the tree is
+        # expected to enumerate params in _parameter_list order (the order
+        # Layer.raw_state / named_parameters produces when the optimizer was
+        # built from the same layer); fall back to group 0 otherwise.
+        plist = self._parameter_list
+        if len(self._groups) > 1 and len(leaves_p) == len(plist):
+            leaf_groups = []
+            for p in plist:
+                for g in self._groups:
+                    if any(q is p for q in g["params"]):
+                        leaf_groups.append(g)
+                        break
+        else:
+            leaf_groups = [self._groups[0]] * len(leaves_p)
+
         new_p, new_s = [], []
-        for p, g, s in zip(leaves_p, leaves_g, leaves_s):
-            np_, ns_ = upd(p, g, s)
+        for p, g, s, grp in zip(leaves_p, leaves_g, leaves_s, leaf_groups):
+            np_, ns_ = self._rule(p, g.astype(p.dtype), s, lr * grp["lr_scale"],
+                                  grp["hyper"], grp["weight_decay"])
             new_p.append(np_)
             new_s.append(ns_)
         return treedef.unflatten(new_p), treedef.unflatten(new_s)

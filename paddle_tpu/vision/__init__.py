@@ -1,0 +1,27 @@
+"""paddle_tpu.vision — models, transforms, datasets, ops.
+
+Reference analog: python/paddle/vision/ (models/resnet.py etc.).  The models
+here are the in-repo zoo the baseline configs name (ResNet-50 is baseline
+config #1, SURVEY.md §2.3); they are plain ``nn.Layer`` stacks, so the same
+definition runs eagerly, under ``@to_static`` (jax.jit), and sharded on a
+mesh.  NCHW is the default data format, matching the reference; XLA lays
+tensors out for the MXU regardless of the logical order.
+"""
+
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import ops  # noqa: F401
+
+from .models import *  # noqa: F401,F403
+
+def set_image_backend(backend):
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    global _IMAGE_BACKEND
+    _IMAGE_BACKEND = backend
+
+def get_image_backend():
+    return _IMAGE_BACKEND
+
+_IMAGE_BACKEND = "pil"

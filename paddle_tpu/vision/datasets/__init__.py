@@ -1,0 +1,14 @@
+"""Vision datasets (reference analog: python/paddle/vision/datasets/).
+
+No network egress in this environment, so `download=True` raises with
+instructions; all datasets load from local files.  `DatasetFolder` /
+`ImageFolder` work on any local directory tree.
+"""
+
+from .folder import DatasetFolder, ImageFolder  # noqa: F401
+from .mnist import MNIST, FashionMNIST  # noqa: F401
+from .cifar import Cifar10, Cifar100  # noqa: F401
+from .flowers import Flowers  # noqa: F401
+
+__all__ = ["DatasetFolder", "ImageFolder", "MNIST", "FashionMNIST", "Cifar10",
+           "Cifar100", "Flowers"]

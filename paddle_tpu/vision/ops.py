@@ -1,0 +1,269 @@
+"""Detection ops (reference analog: python/paddle/vision/ops.py).
+
+TPU-first formulations: everything here is static-shape so it jits.
+- ``roi_align``: bilinear sampling via gather — vectorized, no dynamic loops.
+- ``nms``: fixed-iteration suppression loop (lax.fori_loop over a score-sorted
+  box list) returning padded indices — the XLA-friendly analog of the
+  reference's dynamic-output CUDA NMS.  Callers mask on ``valid``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.dispatch import apply as _apply
+from ..tensor.tensor import Tensor
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# --------------------------------------------------------------- roi_align
+def _roi_align_impl(x, boxes, boxes_num, output_size, spatial_scale, sampling_ratio,
+                    aligned):
+    """x: (N,C,H,W); boxes: (R,4) xyxy in input coords; boxes_num: (N,) int."""
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    ph, pw = output_size
+    offset = 0.5 if aligned else 0.0
+
+    # map each roi to its batch image
+    batch_idx = jnp.repeat(jnp.arange(N), R, total_repeat_length=R) if N == 1 else (
+        jnp.searchsorted(jnp.cumsum(boxes_num), jnp.arange(R), side="right"))
+
+    x1 = boxes[:, 0] * spatial_scale - offset
+    y1 = boxes[:, 1] * spatial_scale - offset
+    x2 = boxes[:, 2] * spatial_scale - offset
+    y2 = boxes[:, 3] * spatial_scale - offset
+    roi_w = x2 - x1
+    roi_h = y2 - y1
+    if not aligned:
+        roi_w = jnp.maximum(roi_w, 1.0)
+        roi_h = jnp.maximum(roi_h, 1.0)
+
+    bin_h = roi_h / ph
+    bin_w = roi_w / pw
+    sr = sampling_ratio if sampling_ratio > 0 else 2  # static sample grid
+
+    # sample point grid: (R, ph, sr) y coords and (R, pw, sr) x coords
+    iy = (jnp.arange(sr) + 0.5) / sr
+    ys = (y1[:, None, None] + (jnp.arange(ph)[None, :, None] + iy[None, None, :])
+          * bin_h[:, None, None])
+    xs = (x1[:, None, None] + (jnp.arange(pw)[None, :, None] + iy[None, None, :])
+          * bin_w[:, None, None])
+
+    def bilinear(img, yy, xx):
+        # img: (C,H,W); yy: (ph,sr); xx: (pw,sr) → (C, ph, sr, pw, sr)
+        yy = jnp.clip(yy, 0.0, H - 1.0)
+        xx = jnp.clip(xx, 0.0, W - 1.0)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1_ = jnp.minimum(y0 + 1, H - 1)
+        x1_ = jnp.minimum(x0 + 1, W - 1)
+        wy = yy - y0
+        wx = xx - x0
+        g = lambda yi, xi: img[:, yi, :][:, :, :, xi]  # (C,ph,sr,pw,sr)
+        out = (g(y0, x0) * ((1 - wy)[None, :, :, None, None] * (1 - wx)[None, None, None, :, :])
+               + g(y0, x1_) * ((1 - wy)[None, :, :, None, None] * wx[None, None, None, :, :])
+               + g(y1_, x0) * (wy[None, :, :, None, None] * (1 - wx)[None, None, None, :, :])
+               + g(y1_, x1_) * (wy[None, :, :, None, None] * wx[None, None, None, :, :]))
+        return out.mean(axis=(2, 4))  # average the sr×sr samples → (C,ph,pw)
+
+    imgs = x[batch_idx]  # (R,C,H,W)
+    return jax.vmap(bilinear)(imgs, ys, xs)
+
+
+def roi_align(x, boxes, boxes_num, output_size=1, spatial_scale=1.0, sampling_ratio=-1,
+              aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _apply(
+        lambda xv, bv, nv: _roi_align_impl(xv, bv, nv, output_size, spatial_scale,
+                                           sampling_ratio, aligned),
+        x, boxes, boxes_num, op_name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Max-pool RoI: approximated with a dense sample grid + max (static shapes)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+
+    def impl(xv, bv, nv):
+        # sample a 4x4 grid per bin and take max — jit-stable approximation
+        out = _roi_align_impl(xv, bv, nv, (output_size[0] * 4, output_size[1] * 4),
+                              spatial_scale, 1, False)
+        R, C = out.shape[0], out.shape[1]
+        out = out.reshape(R, C, output_size[0], 4, output_size[1], 4)
+        return out.max(axis=(3, 5))
+
+    return _apply(impl, x, boxes, boxes_num, op_name="roi_pool")
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+# --------------------------------------------------------------------- iou/nms
+def box_iou(boxes1, boxes2):
+    """(M,4) x (N,4) xyxy → (M,N) IoU matrix."""
+    def impl(b1, b2):
+        area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+        area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter + 1e-10)
+
+    return _apply(impl, boxes1, boxes2, op_name="box_iou")
+
+
+def _nms_impl(boxes, scores, iou_threshold, max_out):
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_sorted = boxes[order]
+    iou = jnp.asarray(_v(box_iou(Tensor(boxes_sorted), Tensor(boxes_sorted))))
+
+    def body(i, keep):
+        # suppressed if any higher-scored kept box overlaps > threshold
+        sup = jnp.any(jnp.where(jnp.arange(n) < i, (iou[i] > iou_threshold) & keep, False))
+        return keep.at[i].set(~sup)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones(n, dtype=bool))
+    kept_sorted_idx = jnp.where(keep, jnp.arange(n), n)  # n = sentinel
+    kept_sorted_idx = jnp.sort(kept_sorted_idx)[:max_out]
+    valid = kept_sorted_idx < n
+    orig = jnp.where(valid, order[jnp.minimum(kept_sorted_idx, n - 1)], -1)
+    return orig, valid
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None, name=None):
+    """NMS with a static output: returns kept indices (sorted by score).
+
+    Unlike the reference's dynamic-length CUDA op, the jit-friendly core
+    returns ``top_k`` (default: all boxes) padded with -1; the eager wrapper
+    strips the padding so user-facing behavior matches the reference.
+    """
+    bv, sv = _v(boxes), None
+    n = bv.shape[0]
+    if scores is None:
+        sv = jnp.arange(n, 0, -1, dtype=jnp.float32)  # keep input order
+    else:
+        sv = _v(scores).astype(jnp.float32)
+    max_out = int(top_k) if top_k is not None else n
+
+    if category_idxs is not None:
+        # category-aware: offset boxes per category so cross-class boxes never overlap
+        cv = _v(category_idxs)
+        offs = (cv.astype(jnp.float32) * (bv.max() + 1.0))[:, None]
+        bv = bv + offs
+
+    idx, valid = _nms_impl(bv, sv, iou_threshold, max_out)
+    import numpy as np
+
+    idx = np.asarray(idx)[np.asarray(valid)]
+    return Tensor(jnp.asarray(idx, dtype=jnp.int64))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0., nms_top_k=400,
+               keep_top_k=200, use_gaussian=False, gaussian_sigma=2., background_label=0,
+               normalized=True, return_index=False, return_rois_num=True, name=None):
+    raise NotImplementedError("matrix_nms: use vision.ops.nms per class; "
+                              "full matrix_nms lands with the detection zoo")
+
+
+# --------------------------------------------------------------- yolo / boxes
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """Decode YOLO head output (N, A*(5+C), H, W) → boxes (N, A*H*W, 4), scores."""
+    def impl(xv, imgv):
+        N, _, H, W = xv.shape
+        A = len(anchors) // 2
+        anc = jnp.asarray(anchors, dtype=xv.dtype).reshape(A, 2)
+        p = xv.reshape(N, A, 5 + class_num, H, W)
+        gx = (jax.nn.sigmoid(p[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+              + jnp.arange(W)[None, None, None, :]) / W
+        gy = (jax.nn.sigmoid(p[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+              + jnp.arange(H)[None, None, :, None]) / H
+        gw = jnp.exp(p[:, :, 2]) * anc[None, :, 0, None, None] / (W * downsample_ratio)
+        gh = jnp.exp(p[:, :, 3]) * anc[None, :, 1, None, None] / (H * downsample_ratio)
+        conf = jax.nn.sigmoid(p[:, :, 4])
+        probs = jax.nn.sigmoid(p[:, :, 5:]) * conf[:, :, None]
+        probs = jnp.where(conf[:, :, None] > conf_thresh, probs, 0.0)
+        imw = imgv[:, 1].astype(xv.dtype)[:, None, None, None]
+        imh = imgv[:, 0].astype(xv.dtype)[:, None, None, None]
+        x1 = (gx - gw / 2) * imw
+        y1 = (gy - gh / 2) * imh
+        x2 = (gx + gw / 2) * imw
+        y2 = (gy + gh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+        scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+        return boxes, scores
+
+    return _apply(impl, x, img_size, op_name="yolo_box", n_outs=2)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    def impl(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        px = pb[:, 0] + pw / 2
+        py = pb[:, 1] + ph / 2
+        if pbv is None:
+            var = jnp.ones((1, 4), dtype=pb.dtype)
+        elif pbv.ndim == 1:
+            var = pbv[None, :]
+        else:
+            var = pbv
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tx = tb[:, 0] + tw / 2
+            ty = tb[:, 1] + th / 2
+            ox = (tx[:, None] - px[None, :]) / pw[None, :]
+            oy = (ty[:, None] - py[None, :]) / ph[None, :]
+            ow = jnp.log(tw[:, None] / pw[None, :])
+            oh = jnp.log(th[:, None] / ph[None, :])
+            out = jnp.stack([ox, oy, ow, oh], axis=-1) / var[None, :, :]
+            return out
+        # decode_center_size; tb: (N, M, 4) deltas
+        if axis == 0:
+            px_, py_, pw_, ph_ = px[None, :], py[None, :], pw[None, :], ph[None, :]
+            var = var[None, :, :]
+        else:
+            px_, py_, pw_, ph_ = px[:, None], py[:, None], pw[:, None], ph[:, None]
+            var = var[:, None, :]
+        d = tb * var
+        cx = d[..., 0] * pw_ + px_
+        cy = d[..., 1] * ph_ + py_
+        w = jnp.exp(d[..., 2]) * pw_
+        h = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2 - norm, cy + h / 2 - norm],
+                         axis=-1)
+
+    return _apply(impl, prior_box, prior_box_var, target_box, op_name="box_coder")

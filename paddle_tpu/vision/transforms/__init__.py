@@ -1,0 +1,19 @@
+"""Image transforms (reference analog: python/paddle/vision/transforms/).
+
+Host-side preprocessing: operates on numpy HWC uint8/float arrays (or PIL
+images if available) and produces numpy; the DataLoader feeds device via a
+single jax.device_put per batch — keeping per-sample work off the TPU, which
+only sees fixed-shape batches (XLA-friendly input pipeline).
+"""
+
+from .transforms import (  # noqa: F401
+    BaseTransform, Compose, ToTensor, Normalize, Transpose, Resize, RandomResizedCrop,
+    CenterCrop, RandomCrop, RandomHorizontalFlip, RandomVerticalFlip, Pad,
+    BrightnessTransform, ContrastTransform, SaturationTransform, HueTransform,
+    ColorJitter, Grayscale, RandomRotation, RandomErasing,
+)
+from . import functional  # noqa: F401
+from .functional import (  # noqa: F401
+    to_tensor, normalize, resize, crop, center_crop, hflip, vflip, pad, to_grayscale,
+    adjust_brightness, adjust_contrast, adjust_saturation, adjust_hue, rotate, erase,
+)

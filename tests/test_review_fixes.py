@@ -1,0 +1,106 @@
+"""Regression tests for review findings (norm bias, dropout infer-scale,
+reversed-RNN masking, conv_transpose output_size, per-group functional
+update, OneCycleLR three_phase, bicubic align_corners)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+def test_batch_norm_bias_without_weight():
+    bn = nn.BatchNorm2D(3, weight_attr=False)
+    bn.bias.set_value(np.full(3, 2.0, dtype="float32"))
+    bn.eval()
+    x = paddle.to_tensor(np.zeros((1, 3, 2, 2), dtype="float32"))
+    out = bn(x)
+    np.testing.assert_allclose(out.numpy(), 2.0, atol=1e-5)
+
+
+def test_layer_norm_bias_without_weight():
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 4).astype("float32"))
+    bias = paddle.to_tensor(np.full(4, 1.5, dtype="float32"))
+    out = F.layer_norm(x, 4, weight=None, bias=bias)
+    ref = F.layer_norm(x, 4)
+    np.testing.assert_allclose(out.numpy(), ref.numpy() + 1.5, atol=1e-5)
+
+
+def test_dropout_downscale_in_infer():
+    x = paddle.to_tensor(np.ones((4, 4), dtype="float32"))
+    out = F.dropout(x, p=0.5, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(out.numpy(), 0.5)
+    # upscale_in_train returns x untouched at inference
+    out2 = F.dropout(x, p=0.5, training=False, mode="upscale_in_train")
+    np.testing.assert_allclose(out2.numpy(), 1.0)
+
+
+def test_reversed_rnn_respects_sequence_length():
+    paddle.seed(7)
+    rnn = nn.SimpleRNN(3, 4, direction="bidirect")
+    T = 5
+    x = paddle.to_tensor(np.random.RandomState(1).rand(2, T, 3).astype("float32"))
+    lens = paddle.to_tensor(np.array([3, 5], dtype="int64"))
+    out, _ = rnn(x, sequence_length=lens)
+    # backward half of sample 0 at t>=3 must be zero (masked padding)
+    back = out.numpy()[0, :, 4:]
+    assert np.allclose(back[3:], 0.0)
+    # and the valid backward outputs must equal running the same net on the
+    # truncated sequence
+    out_trunc, _ = rnn(x[:, :3], sequence_length=paddle.to_tensor(
+        np.array([3, 3], dtype="int64")))
+    np.testing.assert_allclose(back[:3], out_trunc.numpy()[0, :, 4:], atol=1e-5)
+
+
+def test_conv_transpose_output_size_derives_output_padding():
+    x = paddle.to_tensor(np.random.rand(1, 1, 3, 3).astype("float32"))
+    w = paddle.to_tensor(np.random.rand(1, 1, 3, 3).astype("float32"))
+    out = F.conv2d_transpose(x, w, stride=2, padding=0, output_size=[8, 8])
+    assert out.shape == [1, 1, 8, 8]
+    out7 = F.conv2d_transpose(x, w, stride=2, padding=0)
+    assert out7.shape == [1, 1, 7, 7]
+    # the first 7x7 block must agree (extra row/col appended at the end)
+    np.testing.assert_allclose(out.numpy()[..., :7, :7], out7.numpy(), atol=1e-5)
+
+
+def test_functional_update_per_group_weight_decay():
+    import jax.numpy as jnp
+
+    p1 = paddle.Parameter(np.ones(4, dtype="float32"))
+    p2 = paddle.Parameter(np.ones(4, dtype="float32"))
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, parameters=[
+        {"params": [p1], "weight_decay": 0.5},
+        {"params": [p2], "weight_decay": 0.0},
+    ])
+    tree = {"a": p1._value, "b": p2._value}
+    state = opt.functional_init(tree)
+    g = {"a": jnp.zeros(4), "b": jnp.zeros(4)}
+    new_p, _ = opt.functional_update(tree, g, state, 0.1)
+    assert float(new_p["a"][0]) < 1.0  # decayed
+    np.testing.assert_allclose(np.asarray(new_p["b"]), 1.0)  # no decay
+
+
+def test_onecycle_three_phase():
+    sched = paddle.optimizer.lr.OneCycleLR(
+        max_learning_rate=1.0, total_steps=100, phase_pct=0.3, divide_factor=25.0,
+        end_learning_rate=0.001, three_phase=True, anneal_strategy="linear")
+    lrs = []
+    for _ in range(101):
+        lrs.append(sched())
+        sched.step()
+    assert abs(max(lrs) - 1.0) < 1e-6
+    assert abs(lrs[30] - 1.0) < 0.04  # peak at end of phase 1
+    assert abs(lrs[60] - 1.0 / 25.0) < 0.04  # back to initial_lr at end of phase 2
+    assert lrs[-1] <= 0.01  # annealed to end_lr
+
+
+def test_bicubic_align_corners_differs_from_bilinear():
+    x = paddle.to_tensor(np.random.RandomState(2).rand(1, 1, 8, 8).astype("float32"))
+    cub = F.interpolate(x, size=[15, 15], mode="bicubic", align_corners=True)
+    lin = F.interpolate(x, size=[15, 15], mode="bilinear", align_corners=True)
+    assert cub.shape == [1, 1, 15, 15]
+    # endpoint alignment: corners must match the input exactly for both
+    np.testing.assert_allclose(cub.numpy()[0, 0, 0, 0], x.numpy()[0, 0, 0, 0], atol=1e-4)
+    np.testing.assert_allclose(cub.numpy()[0, 0, -1, -1], x.numpy()[0, 0, -1, -1], atol=1e-4)
+    # but the interiors differ (cubic vs linear kernel)
+    assert np.abs(cub.numpy() - lin.numpy()).max() > 1e-4

@@ -1,0 +1,132 @@
+"""Vision zoo tests: model forwards, an end-to-end ResNet train loop (baseline
+config #1 in miniature, SURVEY.md §2.3), transforms, and detection ops."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models, ops, transforms
+
+
+def test_resnet18_forward_shape():
+    m = models.resnet18(num_classes=10)
+    x = paddle.to_tensor(np.random.rand(2, 3, 64, 64).astype("float32"))
+    out = m(x)
+    assert out.shape == [2, 10]
+
+
+@pytest.mark.parametrize("ctor", [
+    lambda: models.LeNet(),
+    lambda: models.mobilenet_v2(scale=0.25, num_classes=7),
+    lambda: models.squeezenet1_1(num_classes=7),
+    lambda: models.shufflenet_v2_x0_25(num_classes=7),
+])
+def test_small_model_forwards(ctor):
+    m = ctor()
+    m.eval()
+    in_ch = 1 if isinstance(m, models.LeNet) else 3
+    size = 28 if isinstance(m, models.LeNet) else 64
+    x = paddle.to_tensor(np.random.rand(2, in_ch, size, size).astype("float32"))
+    out = m(x)
+    assert out.shape[0] == 2
+    assert out.shape[1] in (7, 10)
+
+
+def test_resnet_train_loss_decreases():
+    paddle.seed(0)
+    m = models.ResNet(models.BasicBlock, 18, num_classes=4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    x = paddle.to_tensor(np.random.RandomState(0).rand(8, 3, 32, 32).astype("float32"))
+    y = paddle.to_tensor(np.arange(8) % 4)
+    losses = []
+    for _ in range(6):
+        loss = loss_fn(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_transforms_pipeline():
+    t = transforms.Compose([
+        transforms.Resize(40),
+        transforms.RandomCrop(32),
+        transforms.RandomHorizontalFlip(0.5),
+        transforms.ToTensor(),
+        transforms.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5]),
+    ])
+    img = (np.random.rand(50, 60, 3) * 255).astype(np.uint8)
+    out = t(img)
+    assert out.shape == [3, 32, 32]
+    assert float(out.abs().max()) <= 1.0 + 1e-6
+
+
+def test_resize_bilinear_matches_identity():
+    img = (np.random.rand(16, 16, 3) * 255).astype(np.uint8)
+    assert np.array_equal(transforms.functional.resize(img, (16, 16)), img)
+
+
+def test_nms_matches_numpy_reference():
+    rng = np.random.RandomState(3)
+    xy = rng.rand(30, 2) * 100
+    wh = rng.rand(30, 2) * 30 + 1
+    boxes = np.concatenate([xy, xy + wh], axis=1).astype("float32")
+    scores = rng.rand(30).astype("float32")
+
+    def np_nms(boxes, scores, thresh):
+        order = np.argsort(-scores)
+        keep = []
+        while order.size:
+            i = order[0]
+            keep.append(i)
+            xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+            yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+            xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+            yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+            w = np.maximum(0.0, xx2 - xx1)
+            h = np.maximum(0.0, yy2 - yy1)
+            inter = w * h
+            a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a2 = ((boxes[order[1:], 2] - boxes[order[1:], 0])
+                  * (boxes[order[1:], 3] - boxes[order[1:], 1]))
+            iou = inter / (a1 + a2 - inter + 1e-10)
+            order = order[1:][iou <= 0.4]
+        return np.array(keep)
+
+    expect = np_nms(boxes, scores, 0.4)
+    got = ops.nms(paddle.to_tensor(boxes), 0.4, paddle.to_tensor(scores)).numpy()
+    assert np.array_equal(np.sort(got), np.sort(expect))
+
+
+def test_roi_align_constant_map():
+    # a constant feature map must pool to that constant everywhere
+    x = paddle.to_tensor(np.full((1, 2, 16, 16), 3.5, dtype="float32"))
+    boxes = paddle.to_tensor(np.array([[2.0, 2.0, 10.0, 10.0]], dtype="float32"))
+    num = paddle.to_tensor(np.array([1], dtype="int32"))
+    out = ops.roi_align(x, boxes, num, output_size=4, spatial_scale=1.0)
+    assert out.shape == [1, 2, 4, 4]
+    np.testing.assert_allclose(out.numpy(), 3.5, rtol=1e-5)
+
+
+def test_box_iou_identity():
+    b = paddle.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 15, 15]], dtype="float32"))
+    iou = ops.box_iou(b, b).numpy()
+    np.testing.assert_allclose(np.diag(iou), 1.0, rtol=1e-6)
+    assert 0.1 < iou[0, 1] < 0.2  # 25/175
+
+
+def test_datasetfolder_npy(tmp_path):
+    from paddle_tpu.vision.datasets import DatasetFolder
+
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            np.save(d / f"{i}.npy", np.random.rand(8, 8, 3).astype("float32"))
+    ds = DatasetFolder(str(tmp_path))
+    assert len(ds) == 6
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3)
+    assert label in (0, 1)
