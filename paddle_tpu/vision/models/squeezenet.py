@@ -61,14 +61,22 @@ class SqueezeNet(nn.Layer):
             )
         else:
             raise ValueError(f"unsupported SqueezeNet version {version!r}")
-        final_conv = nn.Conv2D(512, num_classes, 1)
-        self.classifier = nn.Sequential(
-            nn.Dropout(0.5), final_conv, nn.ReLU(), nn.AdaptiveAvgPool2D((1, 1)))
+        if num_classes > 0:
+            final_conv = nn.Conv2D(512, num_classes, 1)
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5), final_conv, nn.ReLU())
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
 
     def forward(self, x):
         x = self.features(x)
-        x = self.classifier(x)
-        return x.flatten(1)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+        return x
 
 
 def squeezenet1_0(pretrained=False, **kwargs):

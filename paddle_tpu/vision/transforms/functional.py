@@ -124,11 +124,14 @@ def pad(img, padding, fill=0, padding_mode="constant"):
 
 
 def to_grayscale(img, num_output_channels=1):
-    img = _as_hwc(img).astype(np.float32)
-    gray = img[..., 0] * 0.299 + img[..., 1] * 0.587 + img[..., 2] * 0.114
+    img = _as_hwc(img)
+    f = img.astype(np.float32)
+    gray = f[..., 0] * 0.299 + f[..., 1] * 0.587 + f[..., 2] * 0.114
     gray = gray[..., None]
     if num_output_channels == 3:
         gray = np.repeat(gray, 3, axis=-1)
+    if img.dtype == np.uint8:  # preserve dtype so ToTensor's /255 still fires
+        gray = np.clip(gray, 0, 255).astype(np.uint8)
     return gray
 
 

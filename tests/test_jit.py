@@ -1,0 +1,121 @@
+"""jit/to_static tests — the dy2static acceptance suite analog (SURVEY.md §4):
+run models both eagerly and under to_static, assert allclose; plus InputSpec
+cache behavior, training through the jit boundary, and save/load via
+StableHLO export."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.static import InputSpec
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def test_to_static_matches_eager():
+    paddle.seed(0)
+    m = MLP()
+    x = paddle.to_tensor(np.random.RandomState(0).rand(3, 8).astype("float32"))
+    eager = m(x).numpy()
+    ms = paddle.jit.to_static(m)
+    np.testing.assert_allclose(ms(x).numpy(), eager, rtol=1e-5)
+    # second call hits the trace cache
+    np.testing.assert_allclose(ms(x).numpy(), eager, rtol=1e-5)
+    assert len(ms.forward._cache) == 1
+    # new shape → new trace entry
+    x2 = paddle.to_tensor(np.random.rand(5, 8).astype("float32"))
+    ms(x2)
+    assert len(ms.forward._cache) == 2
+
+
+def test_to_static_decorator_function():
+    @paddle.jit.to_static
+    def f(a, b):
+        return a * 2 + b
+
+    a = paddle.to_tensor(np.ones((2, 2), dtype="float32"))
+    b = paddle.to_tensor(np.full((2, 2), 3.0, dtype="float32"))
+    np.testing.assert_allclose(f(a, b).numpy(), 5.0)
+
+
+def test_training_through_to_static():
+    paddle.seed(1)
+    m = paddle.jit.to_static(MLP())
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    x = paddle.to_tensor(np.random.RandomState(1).rand(4, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(2).rand(4, 4).astype("float32"))
+    losses = []
+    for _ in range(5):
+        out = m(x)
+        loss = ((out - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_to_static_batchnorm_buffer_update():
+    bn = nn.BatchNorm1D(4)
+    bn.train()
+    sm = paddle.jit.to_static(bn)
+    x = paddle.to_tensor(np.random.RandomState(0).rand(16, 4).astype("float32") + 5.0)
+    before = bn._mean.numpy().copy()
+    sm(x)
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after)  # running stats updated through jit
+
+
+def test_to_static_dropout_varies_across_calls():
+    drop = nn.Dropout(0.5)
+    drop.train()
+    sd = paddle.jit.to_static(drop)
+    x = paddle.to_tensor(np.ones((64,), dtype="float32"))
+    a = sd(x).numpy()
+    b = sd(x).numpy()
+    assert not np.array_equal(a, b)  # rng is a traced input, not baked
+
+
+def test_input_spec_validation():
+    m = paddle.jit.to_static(MLP(), input_spec=[InputSpec([None, 8], "float32")])
+    m(paddle.to_tensor(np.random.rand(2, 8).astype("float32")))
+    with pytest.raises(ValueError):
+        m(paddle.to_tensor(np.random.rand(2, 3, 8).astype("float32")))
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    paddle.seed(3)
+    m = MLP()
+    m.eval()
+    x = paddle.to_tensor(np.random.RandomState(5).rand(2, 8).astype("float32"))
+    expect = m(x).numpy()
+    path = str(tmp_path / "mlp")
+    paddle.jit.save(m, path, input_spec=[InputSpec([2, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(loaded(x).numpy(), expect, rtol=1e-5)
+    # params accessible from the artifact
+    assert "fc1.weight" in loaded.state_dict()
+
+
+def test_static_compat_feed_fetch():
+    import paddle_tpu.static as static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 4], "float32")
+        y = (x * 2.0).sum()
+    exe = static.Executor()
+    out, = exe.run(prog, feed={"x": np.ones((3, 4), dtype="float32")}, fetch_list=[y])
+    np.testing.assert_allclose(out, 24.0)
+    out2, = exe.run(prog, feed={"x": np.full((2, 4), 3.0, dtype="float32")},
+                    fetch_list=[y])
+    np.testing.assert_allclose(out2, 48.0)
