@@ -1,0 +1,98 @@
+"""Process/device environment for distributed runs.
+
+Reference analog: paddle.distributed.ParallelEnv + the env-var contract set
+by the launch CLI (PADDLE_TRAINER_ID, PADDLE_TRAINER_ENDPOINTS,
+PADDLE_CURRENT_ENDPOINT — launch/controllers/collective.py).
+
+TPU-native model (SURVEY.md §3.5): ONE process per host (TPU VM), not one
+per chip; jax.distributed.initialize wires the coordination service (the
+TCPStore analog).  Inside a slice, "ranks" are devices of the global mesh —
+single-controller SPMD — so rank/world_size here report the *process* grid
+while device_count reports chips.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_INITIALIZED = [False]
+
+
+def init_parallel_env():
+    """paddle.distributed.init_parallel_env equivalent.
+
+    Multi-host: reads the coordinator address from env (JAX_COORDINATOR_ADDRESS
+    or the first entry of PADDLE_TRAINER_ENDPOINTS) and joins the jax
+    coordination service.  Single-host: no-op beyond marking init done — all
+    local devices are already visible.
+    """
+    if _INITIALIZED[0]:
+        return ParallelEnv()
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    n_proc = os.environ.get("JAX_NUM_PROCESSES") or os.environ.get("PADDLE_TRAINERS_NUM")
+    pid = os.environ.get("JAX_PROCESS_ID") or os.environ.get("PADDLE_TRAINER_ID")
+    if coord is None and os.environ.get("PADDLE_TRAINER_ENDPOINTS"):
+        coord = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")[0]
+    if coord and n_proc and int(n_proc) > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=int(n_proc),
+                                   process_id=int(pid or 0))
+    _INITIALIZED[0] = True
+    from . import collective as _c
+
+    _c._ensure_default_group()
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _INITIALIZED[0]
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+class ParallelEnv:
+    """Reference: paddle.distributed.ParallelEnv (parallel.py)."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0  # one process drives all local chips
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()
