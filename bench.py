@@ -1,0 +1,56 @@
+"""Driver benchmark: ResNet-50 fused-train-step throughput on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline compares against a pure-JAX hand-written NHWC bf16 ResNet-50
+fwd+bwd measured on the same chip class (2707 imgs/sec on the v5e-1 via the
+axon tunnel, this session) — i.e. value 1.0 means "the framework trains as
+fast as raw JAX on identical hardware", which is the honest single-chip
+ceiling (BASELINE.md has no retrievable reference numbers; the v5e-256-pod
+numbers in BASELINE.json are not measurable on one chip).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+PURE_JAX_BASELINE_IPS = 2707.0  # hand-written jax NHWC bf16 fwd+bwd, same chip
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.vision.models import resnet50
+
+    B = 128
+    paddle.seed(0)
+    m = resnet50(num_classes=1000)
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9, parameters=m.parameters(),
+                     weight_decay=1e-4)
+    step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss(),
+                                amp_level="O2", amp_dtype="bfloat16")
+    x = paddle.to_tensor(np.random.RandomState(0).randn(B, 3, 224, 224).astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 1000, (B,)).astype("int64"))
+
+    loss = step(x, y)  # compile
+    float(loss)
+    n = 15
+    t0 = time.time()
+    for _ in range(n):
+        loss = step(x, y)
+    float(loss)  # host sync
+    dt = (time.time() - t0) / n
+    ips = B / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec",
+        "value": round(ips, 1),
+        "unit": "imgs/sec (bf16 O2, B=128, fused train step, 1 chip)",
+        "vs_baseline": round(ips / PURE_JAX_BASELINE_IPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,52 @@
+"""paddle.distributed — the TPU-native Fleet surface.
+
+Reference analog: python/paddle/distributed/ (communication wrappers,
+parallel env, fleet, launch).  See SURVEY.md §5.8 for the design: in-step
+collectives are XLA collective HLOs over the device mesh; the eager API
+runs one-collective compiled programs; rendezvous is the jax coordination
+service.
+"""
+
+from .env import (  # noqa: F401
+    init_parallel_env, is_initialized, get_rank, get_world_size, ParallelEnv,
+)
+from .collective import (  # noqa: F401
+    Group, ReduceOp, new_group, get_group, get_default_group,
+    destroy_process_group, is_available,
+)
+from .communication import (  # noqa: F401
+    all_reduce, all_gather, all_gather_object, reduce, reduce_scatter,
+    broadcast, scatter, alltoall, alltoall_single, send, recv, isend, irecv,
+    barrier, stream,
+)
+from .parallel import DataParallel, spawn  # noqa: F401
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+    get_hybrid_communicate_group, set_hybrid_communicate_group,
+)
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, shard_tensor, shard_layer, shard_op, Shard, Replicate, Partial,
+    reshard, dtensor_from_fn, unshard_dtensor,
+)
+
+import importlib as _importlib
+
+_LAZY = ("fleet", "launch", "sharding", "auto_parallel", "checkpoint")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = _importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")
+
+
+def get_backend():
+    return "xla"
+
+
+def parallel_device_count():
+    import jax
+
+    return jax.device_count()

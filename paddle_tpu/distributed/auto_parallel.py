@@ -1,0 +1,213 @@
+"""Auto-parallel: shard_tensor / ProcessMesh / placements.
+
+Reference analog: python/paddle/distributed/auto_parallel/ (DistTensor,
+shard_tensor annotations, reshard engine).  SURVEY.md §2.2 notes upstream's
+auto-parallel is its own convergence toward the jax model — so the
+TPU-native mapping is nearly 1:1:
+
+- ``ProcessMesh``            → ``jax.sharding.Mesh``
+- ``Shard(d)/Replicate()``   → ``PartitionSpec`` entries
+- ``shard_tensor``           → ``jax.device_put(x, NamedSharding(...))``
+- reshard engine             → XLA's layout/resharding (device_put again)
+- DistTensor                 → a plain Tensor whose jax.Array is sharded
+  (every op already accepts it; the partitioner handles propagation)
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..tensor.tensor import Tensor
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return True
+
+    def is_partial(self):
+        return False
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement: materialized by the partitioner; accepted
+    for API parity and treated as Replicate at annotation time."""
+
+    def __init__(self, reduce_type=None):
+        self.reduce_type = reduce_type
+
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return "Partial()"
+
+
+class ProcessMesh:
+    """N-d mesh of device ranks with named dims (reference: auto_parallel
+    ProcessMesh). Wraps a jax Mesh over the same shape."""
+
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        self._ids = arr
+        devs = jax.devices()
+        self.jax_mesh = Mesh(np.vectorize(lambda r: devs[int(r)])(arr), tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return list(self._ids.shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return [int(r) for r in self._ids.flatten()]
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    def get_dim_size(self, name):
+        return self._ids.shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, name, index=0):
+        ax = self._dim_names.index(name)
+        sub = np.take(self._ids, index, axis=ax)
+        names = [n for n in self._dim_names if n != name]
+        return ProcessMesh(sub, names if sub.ndim else ["d0"])
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            np.array_equal(self._ids, other._ids) and self._dim_names == other._dim_names
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dims={self._dim_names})"
+
+
+def _spec_from_placements(ndim, mesh: ProcessMesh, placements):
+    entries = [None] * ndim
+    for axis_name, pl in zip(mesh.dim_names, placements):
+        if isinstance(pl, Shard):
+            if entries[pl.dim] is None:
+                entries[pl.dim] = axis_name
+            elif isinstance(entries[pl.dim], tuple):
+                entries[pl.dim] = entries[pl.dim] + (axis_name,)
+            else:
+                entries[pl.dim] = (entries[pl.dim], axis_name)
+    return PartitionSpec(*entries)
+
+
+def shard_tensor(x, process_mesh=None, placements=None, mesh=None, dtype=None,
+                 stop_gradient=None):
+    """Lay ``x`` out over the mesh per placements; returns a Tensor whose
+    jax.Array carries the NamedSharding (the DistTensor)."""
+    pm = process_mesh if process_mesh is not None else mesh
+    if placements is None:
+        placements = [Replicate()] * len(pm.dim_names)
+    v = x._value if isinstance(x, Tensor) else jax.numpy.asarray(x)
+    spec = _spec_from_placements(v.ndim, pm, placements)
+    out_v = jax.device_put(v, NamedSharding(pm.jax_mesh, spec))
+    if isinstance(x, Tensor):
+        x._value = out_v
+        return x
+    return Tensor(out_v, stop_gradient=True if stop_gradient is None else stop_gradient)
+
+
+def reshard(x, process_mesh=None, placements=None, mesh=None):
+    return shard_tensor(x, process_mesh, placements, mesh)
+
+
+def unshard_dtensor(x):
+    v = x._value if isinstance(x, Tensor) else x
+    out = jax.device_put(v, jax.devices()[0])
+    return Tensor(out) if not isinstance(x, Tensor) else Tensor(out, stop_gradient=x.stop_gradient)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None, output_fn=None):
+    """Apply ``shard_fn(name, sublayer, mesh)`` over every sublayer (reference
+    semantics); default replicates every parameter over the mesh."""
+    def default_fn(name, sub, mesh):
+        for p in sub._parameters.values():
+            if p is not None:
+                shard_tensor(p, mesh)
+
+    fn = shard_fn or default_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(lambda lay, args: input_fn(args, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(lambda lay, args, out: output_fn(out, process_mesh))
+    return layer
+
+
+def shard_op(fn, process_mesh=None, in_placements=None, out_placements=None):
+    """Annotate an op call with input/output placements (reference shard_op):
+    inputs are laid out before the call; output placement is left to the
+    partitioner unless given."""
+    def wrapped(*args, **kwargs):
+        if process_mesh is not None and in_placements is not None:
+            args = tuple(
+                shard_tensor(a, process_mesh, pl) if isinstance(a, Tensor) and pl else a
+                for a, pl in zip(args, in_placements))
+        out = fn(*args, **kwargs)
+        if process_mesh is not None and out_placements is not None and isinstance(out, Tensor):
+            out = shard_tensor(out, process_mesh, out_placements)
+        return out
+
+    return wrapped
+
+
+def dtensor_from_fn(fn, process_mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), process_mesh, placements)

@@ -1,0 +1,114 @@
+"""Communication groups over the device mesh.
+
+Reference analog: paddle/fluid/distributed/collective/ProcessGroup (the
+per-group NCCL communicator registry) + python/paddle/distributed/collective.py
+(new_group, default group bookkeeping).
+
+TPU-native model (SURVEY.md §5.8): there is no communicator to initialize —
+a Group is a named 1-D jax.sharding.Mesh over a subset of devices.  In-step
+collectives lower to XLA collective HLOs over ICI/DCN; the eager
+`paddle.distributed.*` API runs one-collective jitted shard_map programs on
+the group's mesh (see communication.py).  Rendezvous / control plane is the
+jax coordination service (joined in env.init_parallel_env), replacing
+TCPStore.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_GROUPS: dict[int, "Group"] = {}
+_NEXT_GID = [0]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A collective group = a 1-D device mesh with a bound axis name.
+
+    ``ranks`` indexes into the global device list (single-controller SPMD:
+    one rank per chip, matching the reference's one-process-per-GPU model).
+    """
+
+    def __init__(self, ranks, gid, axis_name="g", devices=None):
+        all_devs = jax.devices()
+        if ranks is None:
+            ranks = list(range(len(all_devs)))
+        self.ranks = list(ranks)
+        self.id = gid
+        self.axis_name = axis_name
+        devs = devices if devices is not None else [all_devs[r] for r in self.ranks]
+        self.mesh = Mesh(np.asarray(devs), (axis_name,))
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        # single-controller: this process drives every rank; report the
+        # process-level rank for multi-host, 0 otherwise (reference scripts
+        # use this for logging/sharding decisions only)
+        return jax.process_index()
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, axis={self.axis_name!r})"
+
+
+def _ensure_default_group() -> Group:
+    if 0 not in _GROUPS:
+        _GROUPS[0] = Group(None, 0, axis_name="world")
+    return _GROUPS[0]
+
+
+def get_default_group() -> Group:
+    return _ensure_default_group()
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid not in _GROUPS:
+        if gid == 0:
+            return _ensure_default_group()
+        raise ValueError(f"no group with id {gid}")
+    return _GROUPS[gid]
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None) -> Group:
+    """paddle.distributed.new_group: build a group over device ranks."""
+    _ensure_default_group()
+    _NEXT_GID[0] += 1
+    gid = _NEXT_GID[0]
+    g = Group(ranks, gid, axis_name=axis_name or f"g{gid}")
+    _GROUPS[gid] = g
+    return g
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _GROUPS.clear()
+        _NEXT_GID[0] = 0
+    else:
+        _GROUPS.pop(group.id, None)
+
+
+def is_available() -> bool:
+    return True

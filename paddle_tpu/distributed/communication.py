@@ -1,0 +1,347 @@
+"""paddle.distributed.* collectives, TPU-native.
+
+Reference analog: python/paddle/distributed/communication/*.py over
+ProcessGroupNCCL; graph mode uses c_* collective ops (SURVEY.md §2.1).
+
+TPU-native semantics (single-controller SPMD — SURVEY.md §5.8):
+
+- **Inside a traced/SPMD region** (to_static step, shard_map body, pipeline
+  stage): tensors are tracers and the group's mesh axis is bound — the
+  collective lowers directly to the XLA collective HLO (`lax.psum`,
+  `lax.all_gather`, ...), compiler-scheduled over ICI.  This is the compiled
+  path the reference reaches via c_allreduce_sum ops in a Program.
+
+- **Eager, rank-stacked layout**: the paddle API speaks per-rank local
+  tensors; the single-controller equivalent of "each of the N ranks holds a
+  tensor of shape S" is ONE global array of shape [N, *S] laid out over the
+  group.  Eager collectives detect `shape[0] == group.nranks` and run a
+  one-collective jitted `shard_map` on the group's mesh, so the bytes move
+  over ICI exactly like the reference's eager ProcessGroup calls.
+
+- **Eager, replicated**: any other shape means "every rank holds this same
+  value" (the only other consistent single-controller reading): SUM
+  multiplies by nranks, MAX/MIN/AVG return the value unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map_new
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_new(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                              check_vma=False)
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                              check_rep=False)
+
+from ..tensor.tensor import Tensor
+from .collective import Group, ReduceOp, get_default_group
+
+__all__ = [
+    "ReduceOp", "all_reduce", "all_gather", "all_gather_object", "reduce",
+    "reduce_scatter", "broadcast", "scatter", "alltoall", "alltoall_single",
+    "send", "recv", "isend", "irecv", "barrier", "stream",
+]
+
+
+def _group(group) -> Group:
+    return group if group is not None else get_default_group()
+
+
+def _is_traced(v) -> bool:
+    return isinstance(v, jax.core.Tracer)
+
+
+def _unwrap(t):
+    return t._value if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _reduce_traced(v, op, axis):
+    if op == ReduceOp.SUM:
+        return lax.psum(v, axis)
+    if op == ReduceOp.MAX:
+        return lax.pmax(v, axis)
+    if op == ReduceOp.MIN:
+        return lax.pmin(v, axis)
+    if op == ReduceOp.AVG:
+        return lax.pmean(v, axis)
+    if op == ReduceOp.PROD:
+        return jnp.exp(lax.psum(jnp.log(v.astype(jnp.float32)), axis)).astype(v.dtype)
+    raise ValueError(f"bad ReduceOp {op}")
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(gid, kind, op=ReduceOp.SUM, **kw):
+    """One-collective compiled program on group ``gid``'s mesh (built lazily,
+    cached per collective kind / op / static attrs)."""
+    from .collective import get_group
+
+    g = get_group(gid)
+    ax = g.axis_name
+    mesh = g.mesh
+
+    if kind == "all_reduce":
+        def body(x):  # x: [1, *S] block per rank
+            return _reduce_traced(x, op, ax)
+        fn = shard_map(body, mesh=mesh, in_specs=P(ax), out_specs=P(ax))
+    elif kind == "reduce":
+        dst = kw["dst"]
+        def body(x):
+            r = _reduce_traced(x, op, ax)
+            i = lax.axis_index(ax)
+            return jnp.where(i == dst, r, x)
+        fn = shard_map(body, mesh=mesh, in_specs=P(ax), out_specs=P(ax))
+    elif kind == "all_gather":
+        def body(x):  # [1, *S] -> replicated [n, *S]
+            return lax.all_gather(x[0], ax, axis=0)
+        fn = shard_map(body, mesh=mesh, in_specs=P(ax), out_specs=P(None))
+    elif kind == "reduce_scatter":
+        def body(x):  # [1, n, *S] -> [1, *S]
+            return lax.psum_scatter(x, ax, scatter_dimension=1, tiled=False)
+        fn = shard_map(body, mesh=mesh, in_specs=P(ax), out_specs=P(ax))
+    elif kind == "broadcast":
+        src = kw["src"]
+        def body(x):  # [1, *S] -> everyone gets src's block
+            full = lax.all_gather(x[0], ax, axis=0)
+            return full[src][None]
+        fn = shard_map(body, mesh=mesh, in_specs=P(ax), out_specs=P(ax))
+    elif kind == "alltoall":
+        def body(x):  # [1, n, *S] -> [1, n, *S] transposed across ranks
+            return lax.all_to_all(x, ax, split_axis=1, concat_axis=0, tiled=True
+                                  ).reshape(x.shape)
+        fn = shard_map(body, mesh=mesh, in_specs=P(ax), out_specs=P(ax))
+    else:
+        raise ValueError(kind)
+    return jax.jit(fn)
+
+
+def _to_group_sharded(v, g: Group):
+    """Lay a [n, *S] stacked array out over the group's mesh (dim 0)."""
+    return jax.device_put(v, NamedSharding(g.mesh, P(g.axis_name)))
+
+
+def _stacked(v, g: Group) -> bool:
+    return v.ndim >= 1 and v.shape[0] == g.nranks and g.nranks > 1
+
+
+# ------------------------------------------------------------------ public API
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=False):
+    g = _group(group)
+    v = _unwrap(tensor)
+    if _is_traced(v):
+        out = _reduce_traced(v, op, g.axis_name)
+    elif _stacked(v, g):
+        out = _jitted(g.id, "all_reduce", op)(_to_group_sharded(v, g))
+    else:  # replicated single-controller value
+        n = g.nranks
+        out = {ReduceOp.SUM: v * n, ReduceOp.PROD: v ** n}.get(op, v)
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return Tensor(out)
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _group(group)
+    v = _unwrap(tensor)
+    if _is_traced(v):
+        out = _reduce_traced(v, op, g.axis_name)
+    elif _stacked(v, g):
+        out = _jitted(g.id, "reduce", op, dst=g.get_group_rank(dst) if dst in g.ranks else dst)(
+            _to_group_sharded(v, g))
+    else:
+        n = g.nranks
+        out = {ReduceOp.SUM: v * n, ReduceOp.PROD: v ** n}.get(op, v)
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return Tensor(out)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """Per-rank tensors -> every rank's list of all. Eager stacked input
+    [n, *S] appends n Tensors (the per-rank slices, now replicated)."""
+    g = _group(group)
+    v = _unwrap(tensor)
+    if _is_traced(v):
+        out = lax.all_gather(v, g.axis_name, axis=0)
+        if tensor_list is not None:
+            tensor_list.extend(Tensor(out[i]) for i in range(g.nranks))
+        return Tensor(out)
+    if _stacked(v, g):
+        full = _jitted(g.id, "all_gather")(_to_group_sharded(v, g))
+    else:
+        full = jnp.stack([v] * g.nranks)
+    if tensor_list is not None:
+        tensor_list.extend(Tensor(full[i]) for i in range(g.nranks))
+    return Tensor(full)
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = _group(group)
+    object_list.extend([obj] * g.nranks)
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Each rank contributes n pieces; rank i receives the reduced piece i.
+    Eager stacked input: [n, n, *S] -> [n, *S]."""
+    g = _group(group)
+    if isinstance(tensor_list, (list, tuple)):
+        v = jnp.stack([_unwrap(t) for t in tensor_list])
+        if not _is_traced(v) and g.nranks > 1:
+            v = jnp.stack([v] * g.nranks)  # replicated contribution per rank
+    else:
+        v = _unwrap(tensor_list)
+    if _is_traced(v):
+        out = lax.psum_scatter(v, g.axis_name, scatter_dimension=0, tiled=False)
+    elif v.ndim >= 2 and v.shape[0] == g.nranks and v.shape[1] == g.nranks:
+        out = _jitted(g.id, "reduce_scatter", op)(_to_group_sharded(v, g))
+    else:
+        out = v
+    if isinstance(tensor, Tensor):
+        tensor._value = out if not isinstance(out, Tensor) else out._value
+        return tensor
+    return Tensor(out)
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    g = _group(group)
+    v = _unwrap(tensor)
+    src_local = g.get_group_rank(src) if src in g.ranks else src
+    if _is_traced(v):
+        full = lax.all_gather(v, g.axis_name, axis=0)
+        out = full[src_local]
+    elif _stacked(v, g):
+        out = _jitted(g.id, "broadcast", src=src_local)(_to_group_sharded(v, g))
+    else:
+        out = v
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return Tensor(out)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """src's list of n tensors -> one per rank (stacked [n, *S] laid over the
+    group)."""
+    g = _group(group)
+    if tensor_list:
+        v = jnp.stack([_unwrap(t) for t in tensor_list])
+    else:
+        v = _unwrap(tensor)
+    if not _is_traced(v):
+        v = _to_group_sharded(v, g)
+    if isinstance(tensor, Tensor):
+        tensor._value = v[0] if tensor.ndim == v.ndim - 1 else v
+        return tensor
+    return Tensor(v)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Rank j's piece i goes to rank i's slot j. Eager stacked [n, n, *S]."""
+    g = _group(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        v = jnp.stack([_unwrap(t) for t in in_tensor_list])
+    else:
+        v = _unwrap(in_tensor_list)
+    if _is_traced(v):
+        out = lax.all_to_all(v, g.axis_name, split_axis=0, concat_axis=0, tiled=True)
+    elif v.ndim >= 2 and v.shape[0] == g.nranks and v.shape[1] == g.nranks:
+        out = _jitted(g.id, "alltoall")(_to_group_sharded(v, g))
+    else:
+        out = v
+    if isinstance(out_tensor_list, list):
+        out_tensor_list.extend(Tensor(out[i]) for i in range(out.shape[0]))
+    return Tensor(out)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None,
+                    group=None, sync_op=True):
+    g = _group(group)
+    v = _unwrap(in_tensor)
+    n = g.nranks
+    if _is_traced(v):
+        out = lax.all_to_all(v, g.axis_name, split_axis=0, concat_axis=0, tiled=True)
+    elif v.ndim >= 1 and v.shape[0] == n * n:
+        # stacked layout [n*n, ...]: rank j holds rows [j*n, (j+1)*n)
+        v2 = v.reshape((n, n) + tuple(v.shape[1:]))
+        out = _jitted(g.id, "alltoall")(_to_group_sharded(v2, g)).reshape(v.shape)
+    else:
+        out = v
+    if isinstance(out_tensor, Tensor):
+        out_tensor._value = out if not isinstance(out, Tensor) else out._value
+        return out_tensor
+    return Tensor(out)
+
+
+# -------------------------------------------------------------- p2p (eager)
+_MAILBOX: dict = {}
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Eager p2p for API parity (single-controller: a device-to-device copy
+    through a mailbox).  In-step PP p2p uses lax.ppermute (fleet.meta_parallel)."""
+    g = _group(group)
+    src = jax.process_index()
+    _MAILBOX[(src, dst, g.id)] = _unwrap(tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = _group(group)
+    dst = jax.process_index()
+    v = _MAILBOX.pop((src, dst, g.id), None)
+    if v is None:
+        raise RuntimeError(f"recv: nothing sent from rank {src} (eager p2p mailbox)")
+    if isinstance(tensor, Tensor):
+        tensor._value = jax.device_put(v).astype(tensor.dtype)
+        return tensor
+    return Tensor(v)
+
+
+class _Wait:
+    def wait(self):
+        return None
+
+
+def isend(tensor, dst=0, group=None):
+    send(tensor, dst, group)
+    return _Wait()
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src, group)
+    return _Wait()
+
+
+def barrier(group=None):
+    """Device-visible barrier: a tiny psum on the group's mesh, blocked on."""
+    g = _group(group)
+    if g.nranks <= 1:
+        return
+    one = jnp.ones((g.nranks,), jnp.int32)
+    out = _jitted(g.id, "all_reduce", ReduceOp.SUM)(_to_group_sharded(one, g))
+    jax.block_until_ready(out)
+
+
+class stream:
+    """paddle.distributed.stream namespace shim (same ops, sync semantics)."""
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce = staticmethod(reduce)
+    broadcast = staticmethod(broadcast)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(alltoall)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

@@ -1,0 +1,14 @@
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)
+from .pp_layers import (  # noqa: F401
+    LayerDesc, SharedLayerDesc, PipelineLayer, PipelineParallel,
+)
+from .random_ctrl import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)
+from .sharding import (  # noqa: F401
+    group_sharded_parallel, shard_optimizer_states,
+)
+from .pipeline_schedule import spmd_pipeline  # noqa: F401

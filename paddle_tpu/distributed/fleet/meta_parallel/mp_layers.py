@@ -1,0 +1,143 @@
+"""Tensor-parallel layers (reference: fleet/meta_parallel/parallel_layers/
+mp_layers.py — VocabParallelEmbedding, ColumnParallelLinear,
+RowParallelLinear, ParallelCrossEntropy).
+
+TPU-native design: the reference materializes PER-RANK weight shards and
+hand-inserts c_allreduce/c_concat collectives.  Here every layer holds its
+FULL logical parameter annotated with a ``NamedSharding`` over the hybrid
+mesh's 'mp' axis; forward is plain math and XLA's SPMD partitioner splits
+the matmuls and inserts the collectives (allreduce after row-parallel,
+all-gather only when ``gather_output``).  The layers therefore compose with
+eager mode, TrainStep, and to_static unchanged — sharding IS the layer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....nn import functional as F
+from ....nn.layer import Layer
+from ....tensor.dispatch import apply as _apply
+from ....tensor.tensor import Tensor
+from ...topology import get_hybrid_communicate_group
+
+
+def _mp_mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and "mp" in hcg.mesh.axis_names and hcg.get_model_parallel_world_size() > 1:
+        return hcg.mesh
+    return None
+
+
+def _shard_param(p, spec, mesh):
+    if mesh is not None:
+        p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
+    return p
+
+
+def _constrain(t, spec, mesh):
+    """Differentiable sharding annotation on an activation."""
+    if mesh is None:
+        return t
+    sh = NamedSharding(mesh, spec)
+    return _apply(lambda v: jax.lax.with_sharding_constraint(v, sh), t,
+                  op_name="sharding_constraint")
+
+
+class ColumnParallelLinear(Layer):
+    """Y = X W, W sharded on the output (column) dim over 'mp'."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.mesh = _mp_mesh()
+        self.gather_output = gather_output
+        self.in_features, self.out_features = in_features, out_features
+        nranks = self.mesh.shape["mp"] if self.mesh is not None else 1
+        if out_features % max(nranks, 1):
+            raise ValueError(f"out_features {out_features} not divisible by mp degree {nranks}")
+        self.weight = _shard_param(
+            self.create_parameter([in_features, out_features], attr=weight_attr),
+            P(None, "mp"), self.mesh)
+        if has_bias is None or has_bias:
+            self.bias = _shard_param(
+                self.create_parameter([out_features], is_bias=True),
+                P("mp"), self.mesh)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        spec_tail = (None,) * (y.ndim - 1)
+        if self.gather_output:
+            return _constrain(y, P(*spec_tail, None), self.mesh)
+        return _constrain(y, P(*spec_tail, "mp"), self.mesh)
+
+
+class RowParallelLinear(Layer):
+    """Y = X W, W sharded on the input (row) dim over 'mp'; XLA inserts the
+    partial-sum allreduce the reference codes as c_allreduce_sum."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.mesh = _mp_mesh()
+        self.input_is_parallel = input_is_parallel
+        self.in_features, self.out_features = in_features, out_features
+        nranks = self.mesh.shape["mp"] if self.mesh is not None else 1
+        if in_features % max(nranks, 1):
+            raise ValueError(f"in_features {in_features} not divisible by mp degree {nranks}")
+        self.weight = _shard_param(
+            self.create_parameter([in_features, out_features], attr=weight_attr),
+            P("mp", None), self.mesh)
+        if has_bias:
+            self.bias = _shard_param(
+                self.create_parameter([out_features], is_bias=True), P(), self.mesh)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            spec_tail = (None,) * (x.ndim - 1)
+            x = _constrain(x, P(*spec_tail, "mp"), self.mesh)
+        y = F.linear(x, self.weight, self.bias)
+        spec_tail = (None,) * (y.ndim - 1)
+        return _constrain(y, P(*spec_tail, None), self.mesh)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded on the vocab dim over 'mp'."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.mesh = _mp_mesh()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        from ....nn import initializer as I
+
+        self.weight = _shard_param(
+            self.create_parameter([num_embeddings, embedding_dim], attr=weight_attr,
+                                  default_initializer=I.XavierNormal()),
+            P("mp", None), self.mesh)
+
+    def forward(self, x):
+        y = F.embedding(x, self.weight)
+        spec_tail = (None,) * (y.ndim - 1)
+        return _constrain(y, P(*spec_tail, None), self.mesh)
+
+
+class ParallelCrossEntropy(Layer):
+    """CE over mp-sharded logits (reference: c_softmax_with_cross_entropy).
+    Plain softmax-CE here — the partitioner performs the sharded logsumexp."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)

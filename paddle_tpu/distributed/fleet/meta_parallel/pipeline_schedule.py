@@ -1,0 +1,99 @@
+"""SPMD pipeline schedule: stages on a mesh axis, activations rotated with
+``lax.ppermute``.
+
+Reference analog: fleet/meta_parallel/pipeline_parallel.py (1F1B Python
+schedule driving send_v2/recv_v2 p2p ops per rank) + fleet_executor's
+micro-batch task graph (SURVEY.md §2.1).
+
+TPU-native design (SURVEY.md §7 hard-part (a)): all S stages live in ONE
+compiled program.  Each pp rank holds its stage's parameters (stacked
+pytree, leading dim S laid out P('pp')); the schedule is a compile-time
+loop of M + S - 1 ticks; at every tick each rank runs its stage on its
+current micro-batch and the activations rotate one hop over the ICI ring
+via ``ppermute``.  The backward pass is DERIVED BY AD: ppermute's transpose
+is the reverse rotation, so grad-of-pipeline is automatically the mirrored
+pipeline (the schedule the reference hand-codes as 1F1B).  jax.checkpoint
+around the stage body keeps the per-tick activation footprint flat.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                             check_vma=False)
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map as sm
+
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
+def spmd_pipeline(block_fn, stacked_params, x_micro, mesh, axis="pp",
+                  batch_axis=None, remat=True):
+    """Run ``x_micro`` through S pipeline stages living on mesh axis ``axis``.
+
+    Args:
+        block_fn: ``(params_slice, x) -> x`` — one stage's compute.
+            ``params_slice`` is the stage's slice of ``stacked_params`` with
+            the stage dim REMOVED (leading dim L_per_stage kept if the caller
+            stacked several layers per stage).
+        stacked_params: pytree of arrays with leading dim S (= mesh.shape[axis]).
+        x_micro: [M, micro_batch, ...] micro-batches.
+        mesh: the device mesh (may carry more axes, e.g. dp; they stay
+            compiler-partitioned via the batch dims).
+        batch_axis: optional mesh axis name to shard the micro-batch dim over
+            (data parallel inside each stage).
+        remat: checkpoint each stage call (flat activation memory).
+
+    Returns:
+        [M, micro_batch, ...] outputs of the final stage.
+    """
+    S = mesh.shape[axis]
+    M = x_micro.shape[0]
+    if M < S:
+        raise ValueError(f"need micro-batches >= stages ({M} < {S})")
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    if leaves and leaves[0].shape[0] != S:
+        raise ValueError(
+            f"stacked_params leading dim {leaves[0].shape[0]} != pipeline degree {S}; "
+            "stack layers-per-stage into a second leading dim and loop in block_fn")
+    fn = jax.checkpoint(block_fn) if remat else block_fn
+
+    bspec = (None, batch_axis) if batch_axis else (None,)
+    in_param_specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+
+    def body(params_local, xs):
+        # params_local leaves: [1, ...] (stage dim); xs: [M, micro_local, ...]
+        params_here = jax.tree_util.tree_map(lambda v: v[0], params_local)
+        idx = lax.axis_index(axis)
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+        carry = jnp.zeros_like(xs[0])
+        outputs = jnp.zeros((M,) + xs.shape[1:], xs.dtype)
+        for t in range(M + S - 1):
+            mb = min(t, M - 1)
+            inp = jnp.where(idx == 0, xs[mb], carry)
+            out = fn(params_here, inp)
+            # last stage finishes micro-batch t-(S-1) at tick t
+            done = t - (S - 1)
+            if done >= 0:
+                outputs = outputs.at[done].set(out)
+            carry = lax.ppermute(out, axis, fwd_perm)
+        # outputs are valid on the last stage only; mask + psum replicates
+        # them to every rank (ppermute can't fan out one src to many dsts)
+        outputs = jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs))
+        return lax.psum(outputs, axis)
+
+    mapped = _shard_map(
+        body, mesh,
+        in_specs=(in_param_specs, P(*bspec)),
+        out_specs=P(*bspec),
+    )
+    return mapped(stacked_params, x_micro)

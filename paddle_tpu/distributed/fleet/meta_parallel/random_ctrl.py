@@ -1,0 +1,74 @@
+"""TP-aware RNG state tracker.
+
+Reference analog: fleet/meta_parallel/parallel_layers/random.py
+(RNGStatesTracker: named CUDA rng states so dropout inside TP regions can be
+deliberately identical or distinct across mp ranks).
+
+TPU-native: jax keys are values, not device state.  The tracker keeps a
+named base key per state; ``rng_state(name)`` opens an rng_scope whose key
+is the base key — optionally folded with the mesh-axis index inside traced
+SPMD code so mp ranks draw distinct streams (framework.random.fold_in_axis).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ....framework import random as _rng
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.key(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        key = self.states_[name]
+        with _rng.rng_scope(key):
+            yield
+        # advance the stream so successive eager uses differ (traced uses
+        # should fold the step/axis index instead)
+        self.states_[name] = jax.random.fold_in(key, 1)
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+
+    seed = seed if seed is not None else pyrandom.randint(0, 2 ** 31 - 1)
+    global_seed = seed
+    local_seed = seed + 1024
+    _TRACKER.reset()
+    _rng.seed(global_seed)
+    _TRACKER.add(MODEL_PARALLEL_RNG, local_seed)

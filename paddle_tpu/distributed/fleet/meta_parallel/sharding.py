@@ -1,0 +1,103 @@
+"""ZeRO / GroupSharded — optimizer-state (and param) sharding as specs.
+
+Reference analog: fleet/meta_parallel/sharding/ (GroupShardedStage2/3,
+group_sharded_parallel): per-rank ownership of optimizer-state slices,
+hand-coded gather/scatter of grads and params.
+
+TPU-native (SURVEY.md §2.2 sharding row): ZeRO == sharding specs.
+- stage 1: optimizer states laid out over the 'sharding'/'dp' axis.
+- stage 2: + gradients psum_scatter'd (the partitioner derives this from
+  the state shardings — reduce-scatter replaces all-reduce automatically).
+- stage 3: + parameters themselves sharded; XLA all-gathers just-in-time
+  per layer, which is exactly ZeRO-3's schedule.
+
+``shard_optimizer_states``/``group_sharded_parallel`` lay the live arrays
+out; the fused TrainStep keeps shardings (donated buffers preserve layout),
+so the update math runs sharded with no further code.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ....tensor.tensor import Tensor
+from ...topology import get_hybrid_communicate_group
+
+
+def _axis_mesh(axis=None):
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        names = hcg.mesh.axis_names
+        for cand in ([axis] if axis else []) + ["sharding", "dp"]:
+            if cand in names and hcg.mesh.shape[cand] > 1:
+                return hcg.mesh, cand
+    import numpy as np
+
+    devs = jax.devices()
+    return Mesh(np.asarray(devs), ("dp",)), "dp"
+
+
+def _shard_spec_for(v, axis_name, n):
+    """Shard the largest dim divisible by n; replicate when none fits."""
+    dims = sorted(range(v.ndim), key=lambda d: -v.shape[d])
+    for d in dims:
+        if v.shape[d] % n == 0 and v.shape[d] >= n:
+            entries = [None] * v.ndim
+            entries[d] = axis_name
+            return P(*entries)
+    return P()
+
+
+def shard_optimizer_states(train_step, axis=None):
+    """ZeRO-1: lay the fused TrainStep's optimizer-state arrays out over the
+    sharding axis.  Donation keeps the layout across steps."""
+    mesh, ax = _axis_mesh(axis)
+    n = mesh.shape[ax]
+
+    def put(v):
+        if not hasattr(v, "shape") or not hasattr(v, "dtype"):
+            return v
+        return jax.device_put(v, NamedSharding(mesh, _shard_spec_for(v, ax, n)))
+
+    train_step._opt_state = jax.tree_util.tree_map(put, train_step._opt_state)
+    return train_step
+
+
+def shard_parameters(model, axis=None):
+    """ZeRO-3: shard each parameter itself; XLA all-gathers per use site."""
+    mesh, ax = _axis_mesh(axis)
+    n = mesh.shape[ax]
+    for p in model.parameters():
+        spec = _shard_spec_for(p._value, ax, n)
+        p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
+        if p._master is not None:
+            p._master = jax.device_put(p._master, NamedSharding(mesh, spec))
+    return model
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=None,
+                           segment_size=None, sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """reference: paddle.distributed.sharding.group_sharded_parallel.
+
+    level: 'os' (stage1: optimizer states), 'os_g' (stage2: + grads via
+    reduce-scatter — implied by state shardings under XLA), 'p_g_os'
+    (stage3: + params).  Returns (model, optimizer, scaler).
+    """
+    if offload:
+        import warnings
+
+        warnings.warn("offload=True ignored: XLA:TPU owns HBM; use stage 3 "
+                      "param sharding instead")
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"bad group_sharded level {level!r}")
+    if level == "p_g_os":
+        shard_parameters(model)
+    # stage-1/2 state sharding happens lazily: the optimizer's functional
+    # state doesn't exist until a TrainStep is built, so mark the optimizer
+    # and let TrainStep consult it (or the user calls shard_optimizer_states).
+    optimizer._sharded_states_axis = "sharding"
+    return model, optimizer, scaler

@@ -1,0 +1,3 @@
+from . import recompute as recompute_mod  # noqa: F401
+from .recompute import recompute  # noqa: F401
+from . import sequence_parallel_utils  # noqa: F401

@@ -1,0 +1,72 @@
+"""Activation recomputation (reference:
+distributed/fleet/recompute/recompute.py — a PyLayer that stashes RNG state
+and replays forward during backward).
+
+TPU-native: ``jax.checkpoint`` (remat) IS this feature, compiler-integrated:
+the traced segment's activations are dropped and recomputed in the backward
+pass, with RNG replay free because keys are values.  The wrapper keeps the
+reference call shape ``recompute(fn, *args)`` and works both eagerly (tape
+node wrapping the remat'd function) and under to_static/TrainStep traces.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ....tensor.dispatch import apply as _apply
+from ....tensor.tensor import Tensor
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function(*args)`` with activation checkpointing.
+
+    preserve_rng_state / use_reentrant kwargs are accepted for parity; RNG
+    correctness is structural (keys thread through the trace).
+    """
+    kwargs.pop("preserve_rng_state", None)
+    kwargs.pop("use_reentrant", None)
+    policy = kwargs.pop("checkpoint_policy", None)
+
+    import functools
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    consts = {i: a for i, a in enumerate(args) if i not in set(tensor_idx)}
+    ckpt = jax.checkpoint if policy is None else functools.partial(jax.checkpoint,
+                                                                   policy=policy)
+
+    @ckpt
+    def inner(*tvals):
+        call = []
+        it = iter(tvals)
+        for i in range(len(args)):
+            call.append(Tensor(next(it)) if i in set(tensor_idx) else consts[i])
+        out = function(*call, **kwargs)
+        if isinstance(out, Tensor):
+            return out._value
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out
+
+    return _apply(inner, *[args[i] for i in tensor_idx], op_name="recompute",
+                  n_outs=None)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference: recompute_sequential — checkpoint a Sequential span-wise."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    per = max(1, n // max(segments, 1))
+    x = args[0] if len(args) == 1 else args
+    i = 0
+    while i < n:
+        span = layers[i:i + per]
+
+        def run(h, _span=span):
+            for l in _span:
+                h = l(h)
+            return h
+
+        x = recompute(run, x)
+        i += per
+    return x

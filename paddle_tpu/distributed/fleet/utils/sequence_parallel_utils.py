@@ -1,0 +1,102 @@
+"""Megatron-style sequence parallelism (reference:
+fleet/utils/sequence_parallel_utils.py — ScatterOp/GatherOp split
+activations along the sequence dim between the TP collectives;
+AllGatherOp/ReduceScatterOp bracket attention/FFN).
+
+TPU-native (SURVEY.md §5.7 item 1): sequence parallelism is a sharding
+spec — activations between TP regions carry P('mp') on the sequence dim,
+and XLA's partitioner turns the row-parallel matmul's allreduce into
+reduce-scatter + the column-parallel input into all-gather, which is
+EXACTLY the Megatron-SP comm pattern.  The ops below are therefore thin
+sharding-constraint annotations (differentiable; identity when no mesh).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....tensor.dispatch import apply as _apply
+from ....tensor.tensor import Tensor
+from ...topology import get_hybrid_communicate_group
+
+
+def _mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and "mp" in hcg.mesh.axis_names and hcg.mesh.shape["mp"] > 1:
+        return hcg.mesh
+    return None
+
+
+def _constrain_seq(x, shard: bool, seq_axis=1):
+    """Annotate the sequence dim as mp-sharded (scatter) or replicated
+    (gather)."""
+    mesh = _mesh()
+    if mesh is None:
+        return x
+    entries = [None] * x.ndim
+    if shard:
+        entries[seq_axis] = "mp"
+    sh = NamedSharding(mesh, P(*entries))
+    return _apply(lambda v: jax.lax.with_sharding_constraint(v, sh), x,
+                  op_name="sequence_parallel_constraint")
+
+
+class ScatterOp:
+    """Split activations along seq dim across mp ranks."""
+
+    @staticmethod
+    def apply(x, seq_axis=1):
+        return _constrain_seq(x, True, seq_axis)
+
+
+class GatherOp:
+    """Re-assemble full-sequence activations."""
+
+    @staticmethod
+    def apply(x, seq_axis=1):
+        return _constrain_seq(x, False, seq_axis)
+
+
+class AllGatherOp(GatherOp):
+    pass
+
+
+class ReduceScatterOp(ScatterOp):
+    pass
+
+
+def scatter(x, seq_axis=1):
+    return ScatterOp.apply(x, seq_axis)
+
+
+def all_gather(x, seq_axis=1):
+    return GatherOp.apply(x, seq_axis)
+
+
+def mark_as_sequence_parallel_parameter(param: Tensor):
+    """reference: marks params whose grads need mp-allreduce under SP; the
+    partitioner already derives that from shardings — kept as a no-op tag."""
+    param.__dict__ if not hasattr(param, "__slots__") else None
+    return param
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulate_steps=1,
+                                               use_mp=True):
+    return None
+
+
+class ColumnSequenceParallelLinear:
+    """Factory alias: a ColumnParallelLinear whose input is seq-sharded."""
+
+    def __new__(cls, *args, **kwargs):
+        from ..meta_parallel.mp_layers import ColumnParallelLinear
+
+        return ColumnParallelLinear(*args, **kwargs)
+
+
+class RowSequenceParallelLinear:
+    def __new__(cls, *args, **kwargs):
+        from ..meta_parallel.mp_layers import RowParallelLinear
+
+        return RowParallelLinear(*args, **kwargs)

@@ -1,0 +1,108 @@
+"""Data parallelism (reference: python/paddle/parallel.py DataParallel +
+fluid/imperative/reducer.cc).
+
+TPU-native: there is no Reducer — no gradient bucketing, no hook-driven
+fused allreduce, no comm/calc stream overlap to hand-schedule.  A
+DataParallel model shards its *inputs* over the mesh's 'dp' axis and keeps
+parameters replicated; XLA's SPMD partitioner inserts (and latency-hides)
+the grad all-reduce inside the compiled step.  Eager mode works too:
+jax eager ops propagate shardings, so forward/backward on dp-sharded inputs
+run distributed without any wrapper logic.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..nn.layer import Layer
+from ..tensor.tensor import Tensor
+from .env import init_parallel_env  # noqa: F401  (re-export, paddle.distributed.parallel)
+
+
+def _default_dp_mesh(n=None):
+    devs = jax.devices()
+    n = n or len(devs)
+    return Mesh(np.asarray(devs[:n]), ("dp",))
+
+
+class DataParallel(Layer):
+    """paddle.DataParallel — mesh data parallelism.
+
+    ``model = paddle.DataParallel(model)`` then train exactly as before
+    (eager or TrainStep).  Batches are laid out over the 'dp' mesh axis on
+    the way in; parameters are replicated across the mesh once at wrap time.
+    Gradient averaging is XLA's job (psum inserted by the partitioner), so
+    ``find_unused_parameters``/bucketing knobs are accepted and ignored.
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None, mesh=None):
+        super().__init__()
+        self._layers = layers
+        if mesh is None:
+            if group is not None:
+                mesh = Mesh(np.asarray([jax.devices()[r] for r in group.ranks]), ("dp",))
+            else:
+                from .topology import get_hybrid_communicate_group
+
+                hcg = get_hybrid_communicate_group()
+                mesh = hcg.mesh if hcg is not None else _default_dp_mesh()
+        self.mesh = mesh
+        self._replicate_state()
+
+    def _replicate_state(self):
+        rep = NamedSharding(self.mesh, P())
+        for t in list(self._layers.parameters()) + list(self._layers.buffers()):
+            t._value = jax.device_put(t._value, rep)
+            if t._master is not None:
+                t._master = jax.device_put(t._master, rep)
+
+    def scale_loss(self, loss):
+        return loss  # XLA mean-reduces; reference API kept
+
+    def no_sync(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def shard_input(self, x):
+        """Lay a batch tensor out over the dp axis (dim 0)."""
+        spec = P("dp") if "dp" in self.mesh.axis_names else P(self.mesh.axis_names[0])
+        sh = NamedSharding(self.mesh, spec)
+        if isinstance(x, Tensor):
+            x._value = jax.device_put(x._value, sh)
+            return x
+        return jax.device_put(x, sh)
+
+    def forward(self, *args, **kwargs):
+        args = tuple(self.shard_input(a) if isinstance(a, Tensor) else a for a in args)
+        return self._layers(*args, **kwargs)
+
+    # transparent delegation so the wrapper is drop-in
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        out = self._layers.set_state_dict(*a, **k)
+        self._replicate_state()
+        return out
+
+    load_dict = set_state_dict
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn — single-controller SPMD needs no per-device
+    processes: run func once; the mesh covers all local chips.  Multi-host
+    launches use `python -m paddle_tpu.distributed.launch` (one process per
+    host), matching the TPU-VM model (SURVEY.md §3.5)."""
+    func(*args)
+    return None

@@ -1,0 +1,187 @@
+"""Hybrid-parallel topology → jax device mesh.
+
+Reference analog: fleet/base/topology.py (CommunicateTopology,
+HybridCommunicateGroup): factors world_size into (dp, pp, sharding, sep, mp)
+axes and creates a NCCL comm group per axis.
+
+TPU-native: the factoring IS a `jax.sharding.Mesh` over all chips; per-axis
+"comm groups" are just the mesh axis names, consumed by in-step collectives
+(lax.psum('mp') etc.) and PartitionSpecs.  Axis order maps outer→inner onto
+the device list so the innermost axes (mp/sep) land on adjacent chips —
+the ICI-locality design point SURVEY.md §2.2 calls out (dp outermost over
+DCN, mp innermost on the torus).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# canonical outer→inner axis order (reference order: dp, pp, sharding, sep, mp)
+AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        names = list(hybrid_group_names or AXES)
+        dims = list(dims or [1] * len(names))
+        self._names = names
+        self._dims = dims
+        self._world = int(np.prod(dims))
+
+    def get_hybrid_group_names(self):
+        return list(self._names)
+
+    def get_dim(self, name):
+        return self._dims[self._names.index(name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kw):
+        idx = [kw.get(n, 0) for n in self._names]
+        return int(np.ravel_multi_index(idx, self._dims))
+
+    def get_coord(self, rank):
+        return dict(zip(self._names, np.unravel_index(rank, self._dims)))
+
+    def get_axis_list(self, axis_name, index):
+        coords = np.array(np.unravel_index(np.arange(self._world), self._dims)).T
+        ax = self._names.index(axis_name)
+        return [int(r) for r, c in enumerate(coords) if c[ax] == index]
+
+    def get_comm_list(self, axis_name):
+        """All groups along axis_name: list of rank-lists varying that axis."""
+        ax = self._names.index(axis_name)
+        other_dims = [d for i, d in enumerate(self._dims) if i != ax]
+        groups = []
+        for flat in range(int(np.prod(other_dims)) if other_dims else 1):
+            fixed = list(np.unravel_index(flat, other_dims)) if other_dims else []
+            ranks = []
+            for k in range(self._dims[ax]):
+                idx = fixed[:ax] + [k] + fixed[ax:]
+                ranks.append(int(np.ravel_multi_index(idx, self._dims)))
+            groups.append(ranks)
+        return groups
+
+
+class HybridCommunicateGroup:
+    """fleet's hcg, TPU-native: owns THE device mesh of the job.
+
+    ``get_*_parallel_group()`` return Group objects whose axis_name indexes
+    the hybrid mesh, so TP/PP/SP layers can run collectives inside compiled
+    steps (lax.psum over 'mp', ppermute over 'pp', ...).
+    """
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        dims = OrderedDict((n, topology.get_dim(n)) for n in topology.get_hybrid_group_names())
+        devs = jax.devices()
+        n = topology.world_size()
+        if n > len(devs):
+            raise ValueError(
+                f"hybrid topology wants {n} devices, only {len(devs)} visible "
+                "(use XLA_FLAGS=--xla_force_host_platform_device_count=N for tests)")
+        arr = np.asarray(devs[:n]).reshape(tuple(dims.values()))
+        self.mesh = Mesh(arr, tuple(dims.keys()))
+        self._dims = dims
+        from .collective import Group
+
+        self._groups = {}
+        for name in dims:
+            ranks = topology.get_comm_list(name)[0]
+            g = Group.__new__(Group)
+            g.ranks = ranks
+            g.id = hash((id(self), name)) & 0x7FFFFFFF
+            g.axis_name = name
+            g.mesh = self.mesh
+            self._groups[name] = g
+
+    # degrees
+    def get_data_parallel_world_size(self):
+        return self._dims.get("dp", 1)
+
+    def get_model_parallel_world_size(self):
+        return self._dims.get("mp", 1)
+
+    def get_pipe_parallel_world_size(self):
+        return self._dims.get("pp", 1)
+
+    def get_sharding_parallel_world_size(self):
+        return self._dims.get("sharding", 1)
+
+    def get_sep_parallel_world_size(self):
+        return self._dims.get("sep", 1)
+
+    # ranks (single-controller: coordinate of "this process" is 0; scripts use
+    # these for partitioning decisions which the mesh already encodes)
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    def get_global_rank(self):
+        return jax.process_index()
+
+    # groups
+    def get_data_parallel_group(self):
+        return self._groups.get("dp")
+
+    def get_model_parallel_group(self):
+        return self._groups.get("mp")
+
+    def get_pipe_parallel_group(self):
+        return self._groups.get("pp")
+
+    def get_sharding_parallel_group(self):
+        return self._groups.get("sharding")
+
+    def get_sep_parallel_group(self):
+        return self._groups.get("sep")
+
+    def get_check_parallel_group(self, *a, **k):
+        return self._groups.get("mp")
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._dims.get("mp", 1) > 1 or self._dims.get("pp", 1) > 1:
+            return "hybrid"
+        if self._dims.get("sharding", 1) > 1:
+            return "sharding"
+        if self._dims.get("dp", 1) > 1:
+            return "data"
+        return "single"
+
+
+_HCG = [None]
+
+
+def set_hybrid_communicate_group(hcg):
+    _HCG[0] = hcg
+
+
+def get_hybrid_communicate_group():
+    return _HCG[0]

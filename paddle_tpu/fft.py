@@ -1,0 +1,88 @@
+"""paddle.fft (reference: python/paddle/fft.py) — FFT family over jnp.fft
+(XLA lowers these to TPU-native FFT HLOs)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor.dispatch import apply as _apply
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    return norm if norm in ("ortho", "forward") else "backward"
+
+
+def _wrap1(name):
+    jfn = getattr(jnp.fft, name)
+
+    def fn(x, n=None, axis=-1, norm="backward", name_arg=None):
+        return _apply(lambda v: jfn(v, n=n, axis=axis, norm=_norm(norm)), x,
+                      op_name=name)
+
+    fn.__name__ = name
+    return fn
+
+
+def _wrap2(name):
+    jfn = getattr(jnp.fft, name)
+
+    def fn(x, s=None, axes=(-2, -1), norm="backward", name_arg=None):
+        return _apply(lambda v: jfn(v, s=s, axes=axes, norm=_norm(norm)), x,
+                      op_name=name)
+
+    fn.__name__ = name
+    return fn
+
+
+def _wrapn(name):
+    jfn = getattr(jnp.fft, name)
+
+    def fn(x, s=None, axes=None, norm="backward", name_arg=None):
+        return _apply(lambda v: jfn(v, s=s, axes=axes, norm=_norm(norm)), x,
+                      op_name=name)
+
+    fn.__name__ = name
+    return fn
+
+
+fft = _wrap1("fft")
+ifft = _wrap1("ifft")
+rfft = _wrap1("rfft")
+irfft = _wrap1("irfft")
+hfft = _wrap1("hfft")
+ihfft = _wrap1("ihfft")
+fft2 = _wrap2("fft2")
+ifft2 = _wrap2("ifft2")
+rfft2 = _wrap2("rfft2")
+irfft2 = _wrap2("irfft2")
+fftn = _wrapn("fftn")
+ifftn = _wrapn("ifftn")
+rfftn = _wrapn("rfftn")
+irfftn = _wrapn("irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor.tensor import Tensor
+
+    return Tensor(jnp.fft.fftfreq(n, d).astype(jnp.dtype(dtype or "float32")))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor.tensor import Tensor
+
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(jnp.dtype(dtype or "float32")))
+
+
+def fftshift(x, axes=None, name=None):
+    return _apply(lambda v: jnp.fft.fftshift(v, axes=axes), x, op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return _apply(lambda v: jnp.fft.ifftshift(v, axes=axes), x, op_name="ifftshift")

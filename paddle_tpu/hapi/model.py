@@ -1,0 +1,317 @@
+"""paddle.Model — the high-level train/eval/predict engine.
+
+Reference analog: python/paddle/hapi/model.py.  The reference drives either
+a dygraph per-op loop or a static Program; here ``fit`` drives the FUSED
+compiled train step (paddle_tpu.jit.TrainStep): forward + backward + clip +
+optimizer update as one donated XLA program per batch shape — the perf
+contract of SURVEY.md §3.1.  Metrics update from on-device outputs; eval
+and predict run a jitted forward.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..framework import io as _fio
+from ..metric import Metric
+from ..tensor.tensor import Tensor
+from .callbacks import config_callbacks
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self._eval_fn = None
+        self._amp_level = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+            for m in metrics:
+                if not isinstance(m, Metric):
+                    raise TypeError(f"metric {m!r} is not a paddle.metric.Metric")
+            self._metrics = list(metrics)
+        if amp_configs:
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs
+            else:
+                self._amp_level = amp_configs.get("level", "O1")
+        self._train_step = None
+        return self
+
+    def _ensure_train_step(self, accumulate=None):
+        """Build the fused step lazily.  ``accumulate=None`` reuses whatever
+        exists (train_batch must not clobber fit's accumulate setting)."""
+        from ..jit.train_step import TrainStep
+
+        rebuild = (self._train_step is None
+                   or (accumulate is not None
+                       and self._train_step.accumulate_steps != accumulate))
+        if rebuild:
+            if self._optimizer is None or self._loss is None:
+                raise RuntimeError("call prepare(optimizer=..., loss=...) before fit()")
+            self._train_step = TrainStep(
+                self.network, self._optimizer, loss_fn=self._loss,
+                amp_level=self._amp_level, return_outputs=bool(self._metrics),
+                accumulate_steps=accumulate or 1)
+        return self._train_step
+
+    # ------------------------------------------------------------- batches
+    def train_batch(self, inputs, labels=None, update=True):
+        step = self._ensure_train_step()
+        inputs = self._to_tensors(inputs)
+        labels = self._to_tensors(labels) if labels is not None else ()
+        args = (inputs if len(inputs) > 1 else inputs[0],) + tuple(labels)
+        out = step(*args)
+        if step.return_outputs:
+            loss, outs = out
+            self._update_metrics(outs, labels)
+        else:
+            loss = out
+        return [float(loss)]
+
+    def eval_batch(self, inputs, labels=None):
+        fwd = self._ensure_eval_fn()
+        inputs = self._to_tensors(inputs)
+        labels = self._to_tensors(labels) if labels is not None else ()
+        outs = fwd(*inputs)
+        outs_t = outs if isinstance(outs, (tuple, list)) else (outs,)
+        losses = []
+        if self._loss is not None and labels:
+            l = self._loss(outs if not isinstance(outs, (tuple, list)) else outs[0],
+                           *labels)
+            losses = [float(l)]
+        self._update_metrics(outs_t, labels)
+        return losses
+
+    def predict_batch(self, inputs):
+        fwd = self._ensure_eval_fn()
+        inputs = self._to_tensors(inputs)
+        outs = fwd(*inputs)
+        if isinstance(outs, (tuple, list)):
+            return [o.numpy() for o in outs]
+        return [outs.numpy()]
+
+    def _ensure_eval_fn(self):
+        """Jitted eval-mode forward, cached per input signature (the whole
+        inference pass is one compiled module, like the train path)."""
+        if self._eval_fn is None:
+            import jax
+
+            from ..framework import random as _rng
+            from ..framework.state import no_grad_ctx
+
+            net = self.network
+            cache = {}
+
+            def fwd(*xs):
+                named_p = list(net.named_parameters())
+                named_b = list(net.named_buffers())
+                key = tuple((tuple(x.shape), str(x.dtype)) for x in xs)
+                entry = cache.get(key)
+                if entry is None:
+                    pnames = [k for k, _ in named_p]
+                    bnames = [k for k, _ in named_b]
+
+                    def pure(pvals, bvals, rkey, *vals):
+                        was = net.training
+                        net.training = False
+                        try:
+                            with no_grad_ctx(), _rng.rng_scope(rkey), \
+                                    net.bind(dict(zip(pnames, pvals)),
+                                             dict(zip(bnames, bvals))):
+                                out = net(*[Tensor(v) for v in vals])
+                        finally:
+                            net.training = was
+                        leaves, tree = jax.tree_util.tree_flatten(
+                            out, is_leaf=lambda o: isinstance(o, Tensor))
+                        pure._tree = tree
+                        return tuple(o._value if isinstance(o, Tensor) else o
+                                     for o in leaves)
+
+                    entry = (jax.jit(pure), pure)
+                    cache[key] = entry
+                jitted, pure = entry
+                outs = jitted([p._value for _, p in named_p],
+                              [b._value for _, b in named_b],
+                              _rng.next_key(), *[x._value for x in xs])
+                outs_t = [Tensor(o, stop_gradient=True) for o in outs]
+                return jax.tree_util.tree_unflatten(pure._tree, outs_t)
+
+            self._eval_fn = fwd
+        return self._eval_fn
+
+    def _update_metrics(self, outs, labels):
+        outs_t = outs if isinstance(outs, (tuple, list)) else (outs,)
+        for m in self._metrics:
+            res = m.compute(*outs_t, *labels)
+            if not isinstance(res, (tuple, list)):
+                res = (res,)
+            m.update(*[r.numpy() if isinstance(r, Tensor) else r for r in res])
+
+    @staticmethod
+    def _to_tensors(data):
+        if data is None:
+            return ()
+        if isinstance(data, (list, tuple)):
+            return tuple(d if isinstance(d, Tensor) else Tensor(np.asarray(d))
+                         for d in data)
+        return (data if isinstance(data, Tensor) else Tensor(np.asarray(data)),)
+
+    # ----------------------------------------------------------------- fit
+    def _to_loader(self, data, batch_size, shuffle, num_workers, drop_last=False):
+        from ..io import DataLoader, Dataset
+
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=drop_last)
+        return data  # any iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._to_loader(train_data, batch_size, shuffle, num_workers,
+                                 drop_last)
+        eval_loader = self._to_loader(eval_data, batch_size, False, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs, steps=steps,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir, metrics=self._metric_names())
+        self._ensure_train_step(accumulate_grad_batches)
+        self.stop_training = False
+        cbks.on_train_begin()
+        it_count = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            self.network.train()
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step_i, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step_i)
+                inputs, labels = self._split_batch(batch)
+                losses = self.train_batch(inputs, labels)
+                logs = {"loss": losses[0]}
+                for m in self._metrics:
+                    logs[m.name() if not isinstance(m.name(), (list, tuple))
+                         else tuple(m.name())[0]] = m.accumulate()
+                cbks.on_train_batch_end(step_i, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, verbose=verbose, callbacks=cbks.callbacks)
+        cbks.on_train_end(logs if "logs" in dir() else None)
+        return self
+
+    def _metric_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, (list, tuple)) else [n])
+        return names
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return batch[0], batch[1]
+        if isinstance(batch, (list, tuple)):
+            return batch[0], None
+        return batch, None
+
+    # ------------------------------------------------------ evaluate/predict
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._to_loader(eval_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=0,
+                                metrics=self._metric_names())
+        for m in self._metrics:
+            m.reset()
+        self.network.eval()
+        cbks.on_eval_begin()
+        logs = {}
+        losses = []
+        for step_i, batch in enumerate(loader):
+            inputs, labels = self._split_batch(batch)
+            l = self.eval_batch(inputs, labels)
+            if l:
+                losses.append(l[0])
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            name = m.name()
+            logs[name if not isinstance(name, (list, tuple)) else name[0]] = m.accumulate()
+        cbks.on_eval_end(logs)
+        self.network.train()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._to_loader(test_data, batch_size, False, num_workers)
+        self.network.eval()
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(self._to_tensors(inputs)))
+        self.network.train()
+        if not outputs:
+            return []
+        n_out = len(outputs[0])
+        grouped = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        return grouped
+
+    # ------------------------------------------------------------ save/load
+    def save(self, path, training=True):
+        if training:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            _fio.save(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                if self._train_step is not None:
+                    self._train_step.sync()
+                _fio.save(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from .. import jit as _jit
+
+            _jit.save(self.network, path, input_spec=self._inputs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        sd = _fio.load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        opt_path = path + ".pdopt"
+        if self._optimizer is not None and not reset_optimizer and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_fio.load(opt_path))
+        self._train_step = None
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        if input_size is None and self._inputs:
+            input_size = [tuple(s.shape) for s in self._inputs]
+        return summary(self.network, input_size, dtypes=dtype)

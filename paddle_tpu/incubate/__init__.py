@@ -1,0 +1,28 @@
+"""paddle.incubate (reference: python/paddle/incubate/) — the slice the
+TPU rebuild keeps: fused transformer front-ends (SURVEY.md §2.2 incubate
+row: "fused attention/ffn become Pallas kernels") and softmax_mask_fuse.
+"""
+
+from . import nn  # noqa: F401
+from .nn import functional  # noqa: F401
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    from ..tensor.dispatch import apply as _apply
+    import jax
+
+    return _apply(lambda v, m: jax.nn.softmax(v + m, axis=-1), x, mask,
+                  op_name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    from ..tensor.dispatch import apply as _apply
+    import jax
+    import jax.numpy as jnp
+
+    def fn(v):
+        T = v.shape[-1]
+        mask = jnp.triu(jnp.full((T, T), -1e9, v.dtype), k=1)
+        return jax.nn.softmax(v + mask, axis=-1)
+
+    return _apply(fn, x, op_name="softmax_mask_fuse_upper_triangle")

@@ -1,0 +1,2 @@
+from . import functional  # noqa: F401
+from .layer import FusedMultiHeadAttention, FusedFeedForward, FusedLinear  # noqa: F401

@@ -1,0 +1,99 @@
+"""incubate fused-op front-ends (reference:
+python/paddle/incubate/nn/functional/ — fused_multi_head_attention,
+fused_feedforward: single CUDA kernels fusing matmul+bias+residual+norm).
+
+TPU-native: the "fusion" is XLA's job; these compose the same math so one
+compiled region emerges.  The attention core routes through
+nn.functional.scaled_dot_product_attention, which picks the Pallas flash
+kernel when profitable (paddle_tpu.ops.flash_attention).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...tensor.tensor import Tensor
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False, pre_ln_scale=None,
+        pre_ln_bias=None, ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+        qkv_bias=None, linear_bias=None, cache_kv=None, attn_mask=None,
+        dropout_rate=0.5, attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", ring_id=-1, add_residual=True, num_heads=None,
+        name=None):
+    """qkv_weight: [3, n_heads, head_dim, embed_dim] (reference layout)."""
+    residual = x
+    if pre_layer_norm and pre_ln_scale is not None:
+        x = F.layer_norm(x, x.shape[-1:], weight=pre_ln_scale, bias=pre_ln_bias,
+                         epsilon=pre_ln_epsilon)
+    three, n_heads, head_dim, embed = qkv_weight.shape
+    w = qkv_weight.reshape([3 * n_heads * head_dim, embed]).T
+    qkv = F.linear(x, w, qkv_bias.reshape([-1]) if qkv_bias is not None else None)
+    B, T = x.shape[0], x.shape[1]
+    qkv = qkv.reshape([B, T, 3, n_heads, head_dim]).transpose([2, 0, 1, 3, 4])
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate if training else 0.0,
+        training=training)
+    out = out.reshape([B, T, n_heads * head_dim])
+    out = F.linear(out, linear_weight, linear_bias)
+    if training and dropout_rate:
+        out = F.dropout(out, p=dropout_rate, training=True)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm and ln_scale is not None:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    return out
+
+
+def fused_feedforward(
+        x, linear1_weight, linear2_weight, linear1_bias=None, linear2_bias=None,
+        ln1_scale=None, ln1_bias=None, ln2_scale=None, ln2_bias=None,
+        dropout1_rate=0.5, dropout2_rate=0.5, activation="relu",
+        ln1_epsilon=1e-5, ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+        mode="upscale_in_train", ring_id=-1, add_residual=True, name=None):
+    residual = x
+    if pre_layer_norm and ln1_scale is not None:
+        x = F.layer_norm(x, x.shape[-1:], weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    h = F.linear(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    if training and dropout1_rate:
+        h = F.dropout(h, p=dropout1_rate, training=True)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    if training and dropout2_rate:
+        h = F.dropout(h, p=dropout2_rate, training=True)
+    out = residual + h if add_residual else h
+    if not pre_layer_norm and ln2_scale is not None:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln2_scale, bias=ln2_bias,
+                           epsilon=ln2_epsilon)
+    return out
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    w = weight.T if transpose_weight else weight
+    return F.linear(x, w, bias)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, name=None):
+    from ...tensor.dispatch import apply as _apply
+
+    def fn(v, w, *b):
+        var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (v.astype(jnp.float32) * jnp.reciprocal(jnp.sqrt(var + epsilon)))
+        out = out.astype(v.dtype) * w
+        if b:
+            out = out + b[0]
+        return out
+
+    args = (x, norm_weight) if norm_bias is None else (x, norm_weight, norm_bias)
+    return _apply(fn, *args, op_name="rms_norm")

@@ -31,6 +31,35 @@ from ..tensor.tensor import Tensor
 _TO_STATIC = [True]
 
 
+def _freeze_statics(statics):
+    """Hashable, equality-faithful key for the non-tensor leaves of a call.
+
+    Hashable leaves pass through (tuple equality distinguishes hash-colliding
+    values like -1/-2).  Unhashable leaves (numpy arrays, lists that survived
+    flattening) are frozen to a content fingerprint so identical values hit
+    the same cache entry instead of retracing per call.
+    """
+    import numpy as np
+
+    def freeze(leaf):
+        try:
+            hash(leaf)
+            return leaf
+        except TypeError:
+            pass
+        if isinstance(leaf, np.ndarray):
+            return ("__nparr__", leaf.shape, str(leaf.dtype), leaf.tobytes())
+        if isinstance(leaf, (list, tuple)):
+            return ("__seq__", type(leaf).__name__, tuple(freeze(x) for x in leaf))
+        if isinstance(leaf, dict):
+            return ("__dict__", tuple(sorted((k, freeze(v)) for k, v in leaf.items())))
+        if isinstance(leaf, set):
+            return ("__set__", tuple(sorted(map(repr, leaf))))
+        return ("__repr__", type(leaf).__name__, repr(leaf))
+
+    return tuple((i, freeze(leaf)) for i, leaf in statics)
+
+
 def enable_to_static(flag: bool):
     _TO_STATIC[0] = bool(flag)
 
@@ -121,10 +150,11 @@ class StaticFunction:
         self._check_input_spec(tensors)
 
         avals = tuple((tuple(t.shape), str(t.dtype)) for t in tensors)
-        try:
-            static_key = hash(statics)
-        except TypeError:
-            static_key = id(statics)
+        # key on the statics tuple ITSELF (dict compares by equality) — never
+        # on hash(statics): colliding hashes (hash(-1)==hash(-2)) must not
+        # alias traces.  Unhashable leaves are frozen to a content fingerprint
+        # so repeat calls still hit the cache instead of retracing forever.
+        static_key = _freeze_statics(statics)
         key = (treedef, static_key, avals, training)
 
         jitted = self._cache.get(key)
@@ -209,10 +239,7 @@ class StaticFunction:
 
     @staticmethod
     def _static_key_of(statics):
-        try:
-            return hash(statics)
-        except TypeError:
-            return id(statics)
+        return _freeze_statics(statics)
 
     # -------------------------------------------------- introspection API
     @property
@@ -414,6 +441,9 @@ class TranslatedLayer:
         d = dict(self._params)
         d.update(self._buffers)
         return d
+
+
+from .train_step import TrainStep, train_step  # noqa: E402,F401
 
 
 def load(path, **configs):

@@ -159,9 +159,40 @@ class Optimizer:
         """Per-leaf optimizer state pytree for the compiled trainer."""
         return jax.tree_util.tree_map(lambda p: self.init_state(p), param_tree)
 
-    def functional_update(self, param_tree, grad_tree, state_tree, lr):
+    def resolve_leaf_meta(self, param_tree):
+        """Per-leaf (lr_scale, hyper, weight_decay) resolved OUTSIDE any jit.
+
+        Leaves are matched to param groups by identity of the underlying jax
+        array (Parameter._value), the only association that survives arbitrary
+        tree ordering; order-based matching is the fallback.  Tree leaves may
+        be Parameters or their raw arrays.
+        """
+        leaves, _ = jax.tree_util.tree_flatten(
+            param_tree, is_leaf=lambda x: isinstance(x, (Parameter, Tensor)))
+        by_val = {}
+        for g in self._groups:
+            for p in g["params"]:
+                by_val[id(p)] = (p, g)
+                by_val[id(p._value)] = (p, g)
+        plist = self._parameter_list
+        meta = []
+        for i, leaf in enumerate(leaves):
+            hit = by_val.get(id(leaf))
+            if hit is None and i < len(plist):
+                p = plist[i]
+                hit = (p, next(g for g in self._groups if any(q is p for q in g["params"])))
+            if hit is None:
+                meta.append((1.0, self._groups[0]["hyper"], self._groups[0]["weight_decay"]))
+            else:
+                p, g = hit
+                meta.append((g["lr_scale"], g["hyper"], self._param_weight_decay(p, g)))
+        return meta
+
+    def functional_update(self, param_tree, grad_tree, state_tree, lr, leaf_meta=None):
         """Pure pytree update — usable under jit/pjit/shard_map.
-        Grad clip (global-norm class) is applied tree-wide first."""
+        Grad clip (global-norm class) is applied tree-wide first.
+        ``leaf_meta`` (from :meth:`resolve_leaf_meta`, computed outside jit)
+        carries per-leaf group settings; without it every leaf gets group-0."""
         if self._grad_clip is not None and hasattr(self._grad_clip, "tree_clip"):
             grad_tree = self._grad_clip.tree_clip(grad_tree)
 
@@ -169,34 +200,24 @@ class Optimizer:
         leaves_g = treedef.flatten_up_to(grad_tree)
         leaves_s = treedef.flatten_up_to(state_tree)
 
-        # per-leaf group settings: with multiple param groups the tree is
-        # expected to enumerate params in _parameter_list order (the order
-        # Layer.raw_state / named_parameters produces when the optimizer was
-        # built from the same layer); fall back to group 0 otherwise.
-        plist = self._parameter_list
-        if len(self._groups) > 1:
-            if len(leaves_p) != len(plist):
-                import warnings
-
-                warnings.warn(
-                    f"functional_update: param tree has {len(leaves_p)} leaves but the "
-                    f"optimizer tracks {len(plist)} params across {len(self._groups)} "
-                    "groups; applying group-0 settings to every leaf")
-                leaf_groups = [self._groups[0]] * len(leaves_p)
+        if leaf_meta is None:
+            if len(self._groups) > 1 and len(leaves_p) == len(self._parameter_list):
+                leaf_meta = self.resolve_leaf_meta(param_tree)
             else:
-                leaf_groups = []
-                for p in plist:
-                    for g in self._groups:
-                        if any(q is p for q in g["params"]):
-                            leaf_groups.append(g)
-                            break
-        else:
-            leaf_groups = [self._groups[0]] * len(leaves_p)
+                if len(self._groups) > 1:
+                    import warnings
+
+                    warnings.warn(
+                        f"functional_update: param tree has {len(leaves_p)} leaves but "
+                        f"the optimizer tracks {len(self._parameter_list)} params across "
+                        f"{len(self._groups)} groups; applying group-0 settings to every "
+                        "leaf (pass leaf_meta=resolve_leaf_meta(...) to fix)")
+                g0 = self._groups[0]
+                leaf_meta = [(g0["lr_scale"], g0["hyper"], g0["weight_decay"])] * len(leaves_p)
 
         new_p, new_s = [], []
-        for p, g, s, grp in zip(leaves_p, leaves_g, leaves_s, leaf_groups):
-            np_, ns_ = self._rule(p, g.astype(p.dtype), s, lr * grp["lr_scale"],
-                                  grp["hyper"], grp["weight_decay"])
+        for p, g, s, (lr_scale, hyper, wd) in zip(leaves_p, leaves_g, leaves_s, leaf_meta):
+            np_, ns_ = self._rule(p, g.astype(p.dtype), s, lr * lr_scale, hyper, wd)
             new_p.append(np_)
             new_s.append(ns_)
         return treedef.unflatten(new_p), treedef.unflatten(new_s)

@@ -1,0 +1,102 @@
+"""paddle.signal (reference: python/paddle/signal.py) — frame/stft/istft."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor.dispatch import apply as _apply
+from .tensor.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        n = v.shape[ax]
+        n_frames = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[:, None]
+               + hop_length * jnp.arange(n_frames)[None, :])
+        out = jnp.take(v, idx, axis=ax)
+        return out
+
+    return _apply(fn, x, op_name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def fn(v):
+        # frames along the last two dims: (..., frame_length, n_frames)
+        if axis not in (-1, v.ndim - 1):
+            raise NotImplementedError("overlap_add supports axis=-1")
+        frame_length, n_frames = v.shape[-2], v.shape[-1]
+        out_len = frame_length + hop_length * (n_frames - 1)
+        out = jnp.zeros(v.shape[:-2] + (out_len,), v.dtype)
+        for i in range(n_frames):  # static loop; n_frames is compile-time
+            out = out.at[..., i * hop_length:i * hop_length + frame_length].add(v[..., i])
+        return out
+
+    return _apply(fn, x, op_name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = window._value if isinstance(window, Tensor) else (
+        jnp.ones((win_length,)) if window is None else jnp.asarray(window))
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        wv = jnp.pad(wv, (lpad, n_fft - win_length - lpad))
+
+    def fn(v, w):
+        sig = v
+        if center:
+            sig = jnp.pad(sig, [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2, n_fft // 2)],
+                          mode=pad_mode)
+        n = sig.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[:, None] + hop_length * jnp.arange(n_frames)[None, :])
+        frames = jnp.take(sig, idx, axis=-1)  # (..., n_fft, n_frames)
+        frames = frames * w[:, None]
+        spec = jnp.fft.rfft(frames, axis=-2) if onesided else jnp.fft.fft(frames, axis=-2)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return spec
+
+    return _apply(fn, x, Tensor(wv), op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = window._value if isinstance(window, Tensor) else (
+        jnp.ones((win_length,)) if window is None else jnp.asarray(window))
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        wv = jnp.pad(wv, (lpad, n_fft - win_length - lpad))
+
+    def fn(v, w):
+        spec = v
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-2) if onesided else \
+            jnp.real(jnp.fft.ifft(spec, axis=-2))
+        frames = frames * w[:, None]
+        n_frames = frames.shape[-1]
+        out_len = n_fft + hop_length * (n_frames - 1)
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        den = jnp.zeros((out_len,), frames.dtype)
+        for i in range(n_frames):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i])
+            den = den.at[sl].add(jnp.square(w))
+        out = out / jnp.maximum(den, 1e-10)
+        if center:
+            out = out[..., n_fft // 2:out.shape[-1] - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return _apply(fn, x, Tensor(wv), op_name="istft")

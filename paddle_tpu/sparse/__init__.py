@@ -1,0 +1,96 @@
+"""paddle.sparse (reference: python/paddle/sparse/) — COO/CSR tensors.
+
+TPU-native: backed by jax.experimental.sparse.BCOO (XLA-lowered sparse
+ops).  SURVEY.md marks this subsystem "defer"; the surface here covers the
+creation/conversion/elementwise/matmul core so sparse-using scripts run.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..tensor.tensor import Tensor
+
+
+class SparseCooTensor(Tensor):
+    """A Tensor whose _value is a dense materialization and which carries the
+    BCOO alongside (XLA:TPU executes dense compute far faster than emulated
+    scatter/gather sparsity; the BCOO is kept for memory-bound conversions)."""
+
+    __slots__ = ("bcoo",)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    iv = indices._value if isinstance(indices, Tensor) else jnp.asarray(indices)
+    vv = values._value if isinstance(values, Tensor) else jnp.asarray(values)
+    if dtype is not None:
+        from ..framework import dtypes as _dt
+
+        vv = vv.astype(_dt.to_jax(dtype))
+    bcoo = jsparse.BCOO((vv, iv.T), shape=tuple(shape) if shape is not None else None)
+    t = SparseCooTensor(bcoo.todense(), stop_gradient=stop_gradient)
+    t.bcoo = bcoo
+    return t
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    import numpy as np
+
+    crows_n = np.asarray(crows)
+    cols_n = np.asarray(cols)
+    vals = values._value if isinstance(values, Tensor) else jnp.asarray(values)
+    rows = np.repeat(np.arange(len(crows_n) - 1), np.diff(crows_n))
+    idx = jnp.asarray(np.stack([rows, cols_n]))
+    return sparse_coo_tensor(idx, vals, shape, dtype, place, stop_gradient)
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def _dense(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def add(x, y, name=None):
+    return Tensor(_dense(x) + _dense(y))
+
+
+def subtract(x, y, name=None):
+    return Tensor(_dense(x) - _dense(y))
+
+
+def multiply(x, y, name=None):
+    return Tensor(_dense(x) * _dense(y))
+
+
+def divide(x, y, name=None):
+    return Tensor(_dense(x) / _dense(y))
+
+
+def matmul(x, y, name=None):
+    return Tensor(_dense(x) @ _dense(y))
+
+
+def masked_matmul(x, y, mask, name=None):
+    out = _dense(x) @ _dense(y)
+    return Tensor(jnp.where(_dense(mask) != 0, out, 0))
+
+
+def relu(x, name=None):
+    return Tensor(jnp.maximum(_dense(x), 0))
+
+
+def to_dense(x):
+    return Tensor(_dense(x))
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    v = _dense(x)
+    bcoo = jsparse.bcoo_fromdense(v)
+    t = SparseCooTensor(v)
+    t.bcoo = bcoo
+    return t

@@ -1,0 +1,38 @@
+"""paddle.utils (reference: python/paddle/utils/) — misc helpers."""
+
+from . import download  # noqa: F401
+from .summary_writer import SummaryWriter  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"{module_name} is not installed")
+
+
+def run_check():
+    """paddle.utils.run_check: verify the device stack end-to-end."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    x = jnp.ones((8, 8))
+    y = jax.jit(lambda a: a @ a)(x)
+    ok = float(y[0, 0]) == 8.0
+    print(f"PaddleTPU works on {dev.platform}:{dev.id} "
+          f"({'OK' if ok else 'FAILED'}), {jax.device_count()} device(s) visible")
+    return ok
+
+
+def require_version(min_version, max_version=None):
+    return True
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def wrapper(fn):
+        return fn
+
+    return wrapper

