@@ -31,6 +31,17 @@ def _mp_mesh():
     return None
 
 
+def _in_manual_mp() -> bool:
+    """True inside a shard_map body where the 'mp' axis is bound (the SPMD
+    pipeline runs blocks fully manual; TP layers then compute on local
+    shards and insert the psum themselves — the reference's c_allreduce)."""
+    try:
+        jax.lax.axis_index("mp")
+        return True
+    except Exception:
+        return False
+
+
 def _shard_param(p, spec, mesh):
     if mesh is not None:
         p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
@@ -69,6 +80,14 @@ class ColumnParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        if _in_manual_mp():
+            # manual region: weight/bias are the local column shards
+            y = F.linear(x, self.weight, self.bias)
+            if self.gather_output:
+                y = _apply(lambda v: jax.lax.all_gather(v, "mp", axis=v.ndim - 1,
+                                                        tiled=True),
+                           y, op_name="mp_all_gather")
+            return y
         y = F.linear(x, self.weight, self.bias)
         spec_tail = (None,) * (y.ndim - 1)
         if self.gather_output:
@@ -100,6 +119,23 @@ class RowParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        if _in_manual_mp():
+            # manual region: local partial matmul, explicit allreduce, THEN
+            # bias (reference row-parallel ordering: c_allreduce_sum + bias)
+            if not self.input_is_parallel:
+                # full-width input: scatter this rank's slice first
+                k = self.weight.shape[0]
+
+                def scatter(v):
+                    start = jax.lax.axis_index("mp") * k
+                    return jax.lax.dynamic_slice_in_dim(v, start, k, axis=v.ndim - 1)
+
+                x = _apply(scatter, x, op_name="mp_scatter")
+            y = F.linear(x, self.weight)
+            y = _apply(lambda v: jax.lax.psum(v, "mp"), y, op_name="mp_allreduce")
+            if self.bias is not None:
+                y = y + self.bias
+            return y
         if self.input_is_parallel:
             spec_tail = (None,) * (x.ndim - 1)
             x = _constrain(x, P(*spec_tail, "mp"), self.mesh)
@@ -117,6 +153,10 @@ class VocabParallelEmbedding(Layer):
         self.mesh = _mp_mesh()
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
+        nranks = self.mesh.shape["mp"] if self.mesh is not None else 1
+        if num_embeddings % max(nranks, 1):
+            raise ValueError(
+                f"num_embeddings {num_embeddings} not divisible by mp degree {nranks}")
         from ....nn import initializer as I
 
         self.weight = _shard_param(
@@ -125,6 +165,20 @@ class VocabParallelEmbedding(Layer):
             P("mp", None), self.mesh)
 
     def forward(self, x):
+        if _in_manual_mp():
+            # manual region: local vocab rows [V/mp, H]; mask + gather + psum
+            # (the reference's c_embedding kernel)
+            def fn(ids, w):
+                n_local = w.shape[0]
+                start = jax.lax.axis_index("mp") * n_local
+                local = ids - start
+                ok = (local >= 0) & (local < n_local)
+                safe = jnp.clip(local, 0, n_local - 1)
+                out = jnp.take(w, safe, axis=0)
+                out = jnp.where(ok[..., None], out, 0)
+                return jax.lax.psum(out, "mp")
+
+            return _apply(fn, x, self.weight, op_name="vocab_parallel_embedding")
         y = F.embedding(x, self.weight)
         spec_tail = (None,) * (y.ndim - 1)
         return _constrain(y, P(*spec_tail, None), self.mesh)

@@ -25,6 +25,9 @@ from jax.sharding import PartitionSpec as P
 
 
 def _shard_map(f, mesh, in_specs, out_specs):
+    """Fully manual over the mesh: hybrid parallelism inside the body is
+    explicit — pp via ppermute here, mp via the TP layers' own psum
+    (mp_layers manual mode), dp via the batch specs."""
     try:
         return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                              check_vma=False)
@@ -36,7 +39,7 @@ def _shard_map(f, mesh, in_specs, out_specs):
 
 
 def spmd_pipeline(block_fn, stacked_params, x_micro, mesh, axis="pp",
-                  batch_axis=None, remat=True):
+                  batch_axis=None, remat=True, param_specs=None):
     """Run ``x_micro`` through S pipeline stages living on mesh axis ``axis``.
 
     Args:
@@ -67,7 +70,8 @@ def spmd_pipeline(block_fn, stacked_params, x_micro, mesh, axis="pp",
     fn = jax.checkpoint(block_fn) if remat else block_fn
 
     bspec = (None, batch_axis) if batch_axis else (None,)
-    in_param_specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    in_param_specs = param_specs if param_specs is not None else \
+        jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
 
     def body(params_local, xs):
         # params_local leaves: [1, ...] (stage dim); xs: [M, micro_local, ...]

@@ -159,7 +159,13 @@ class TrainStep:
                             args = jax.tree_util.tree_unflatten(
                                 treedef, [Tensor(v) for v in vals])
                             if loss_fn is None:
-                                loss = model(*args)
+                                # single-dict batches call as kwargs, so models
+                                # with (input_ids, ..., labels=None) signatures
+                                # route by name: step({"input_ids": x, "labels": y})
+                                if len(args) == 1 and isinstance(args[0], dict):
+                                    loss = model(**args[0])
+                                else:
+                                    loss = model(*args)
                                 outs = ()
                             else:
                                 x = args[0]

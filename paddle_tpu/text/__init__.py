@@ -1,0 +1,17 @@
+"""paddle_tpu.text — NLP model zoo + tokenization.
+
+Reference analog: PaddleNLP's model zoo (ernie-3.0 / bert / gpt) which the
+baseline configs name but which lives outside the core Paddle repo
+(SURVEY.md §2.3).  The rebuild carries an in-repo equivalent: BERT/ERNIE
+encoders (baseline config #2, fine-tune via to_static/TrainStep) and a GPT
+decoder LM whose blocks are TP-sharded through fleet's parallel layers and
+homogeneous for the SPMD pipeline engine (config #5).
+"""
+
+from . import models  # noqa: F401
+from .models import (  # noqa: F401
+    BertModel, BertForSequenceClassification, BertForPretraining,
+    ErnieModel, ErnieForSequenceClassification,
+    GPTModel, GPTForCausalLM,
+)
+from .tokenizer import SimpleTokenizer, BertTokenizer  # noqa: F401

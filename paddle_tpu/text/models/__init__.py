@@ -1,0 +1,8 @@
+from .bert import (  # noqa: F401
+    BertModel, BertForSequenceClassification, BertForPretraining,
+    BertPretrainingCriterion, ErnieModel, ErnieForSequenceClassification,
+)
+from .gpt import (  # noqa: F401
+    GPTModel, GPTForCausalLM, GPTForCausalLMPipe, GPTDecoderLayer,
+    stack_block_params, block_fn_for, pipeline_forward,
+)

@@ -1,0 +1,197 @@
+"""BERT / ERNIE encoders (reference analog: PaddleNLP
+paddlenlp/transformers/bert/modeling.py and ernie/modeling.py — the
+ERNIE-3.0-base fine-tune is baseline config #2, SURVEY.md §2.3).
+
+TPU-first: the whole encoder is trace-friendly (static shapes, no Python
+control flow on values), so a fine-tune step through TrainStep/to_static is
+one fused XLA program.  ERNIE-3.0-base is architecturally a BERT encoder
+(relative task heads aside), so ErnieModel shares the implementation with
+its own defaults.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn.layer import Layer
+from ...nn.layers.common import Dropout, Embedding, Linear
+from ...nn.layers.norm import LayerNorm
+from ...nn.layers.transformer import TransformerEncoder, TransformerEncoderLayer
+from ...tensor.dispatch import apply as _apply
+from ...tensor.tensor import Tensor
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, vocab_size, hidden_size, hidden_dropout_prob,
+                 max_position_embeddings, type_vocab_size, pad_token_id=0):
+        super().__init__()
+        self.word_embeddings = Embedding(vocab_size, hidden_size,
+                                         padding_idx=pad_token_id)
+        self.position_embeddings = Embedding(max_position_embeddings, hidden_size)
+        self.token_type_embeddings = Embedding(type_vocab_size, hidden_size)
+        self.layer_norm = LayerNorm(hidden_size, 1e-12)
+        self.dropout = Dropout(hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        if position_ids is None:
+            seq = input_ids.shape[1]
+            position_ids = Tensor(jnp.arange(seq, dtype=jnp.int64)[None, :])
+        if token_type_ids is None:
+            position_vals = input_ids._value
+            token_type_ids = Tensor(jnp.zeros_like(position_vals))
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(Layer):
+    def __init__(self, hidden_size):
+        super().__init__()
+        self.dense = Linear(hidden_size, hidden_size)
+
+    def forward(self, hidden_states):
+        return F.tanh(self.dense(hidden_states[:, 0]))
+
+
+class BertModel(Layer):
+    """reference: BertModel(vocab_size, hidden_size=768, ...) returning
+    (sequence_output, pooled_output)."""
+
+    def __init__(self, vocab_size=30522, hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02, pad_token_id=0, pool_act="tanh"):
+        super().__init__()
+        self.pad_token_id = pad_token_id
+        self.embeddings = BertEmbeddings(vocab_size, hidden_size,
+                                         hidden_dropout_prob,
+                                         max_position_embeddings, type_vocab_size,
+                                         pad_token_id)
+        enc_layer = TransformerEncoderLayer(
+            hidden_size, num_attention_heads, intermediate_size,
+            dropout=hidden_dropout_prob, activation=hidden_act,
+            attn_dropout=attention_probs_dropout_prob, act_dropout=0.0,
+            normalize_before=False, layer_norm_eps=1e-12)
+        self.encoder = TransformerEncoder(enc_layer, num_hidden_layers)
+        self.pooler = BertPooler(hidden_size)
+
+    def _attn_mask(self, input_ids, attention_mask):
+        if attention_mask is None:
+            attention_mask = _apply(
+                lambda ids: (ids != self.pad_token_id).astype(jnp.float32),
+                input_ids, op_name="pad_mask")
+        # [B, S] -> additive [B, 1, 1, S]
+        return _apply(
+            lambda m: ((1.0 - m.astype(jnp.float32)) * -1e4)[:, None, None, :],
+            attention_mask, op_name="extend_mask")
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        mask = self._attn_mask(input_ids, attention_mask)
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        seq_out = self.encoder(emb, mask)
+        return seq_out, self.pooler(seq_out)
+
+
+class ErnieModel(BertModel):
+    """ERNIE-3.0-base shape defaults (BERT-base-compatible encoder)."""
+
+    def __init__(self, vocab_size=40000, hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=2048, type_vocab_size=4,
+                 initializer_range=0.02, pad_token_id=0, **kw):
+        super().__init__(vocab_size, hidden_size, num_hidden_layers,
+                         num_attention_heads, intermediate_size, hidden_act,
+                         hidden_dropout_prob, attention_probs_dropout_prob,
+                         max_position_embeddings, type_vocab_size,
+                         initializer_range, pad_token_id)
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, bert=None, num_classes=2, dropout=None, **bert_kwargs):
+        super().__init__()
+        self.bert = bert if bert is not None else BertModel(**bert_kwargs)
+        hidden = self.bert.pooler.dense.weight.shape[0]
+        self.dropout = Dropout(dropout if dropout is not None else 0.1)
+        self.classifier = Linear(hidden, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class ErnieForSequenceClassification(BertForSequenceClassification):
+    def __init__(self, ernie=None, num_classes=2, dropout=None, **kw):
+        super().__init__(bert=ernie if ernie is not None else ErnieModel(**kw),
+                         num_classes=num_classes, dropout=dropout)
+
+
+class BertLMPredictionHead(Layer):
+    def __init__(self, hidden_size, vocab_size, activation="gelu",
+                 embedding_weights=None):
+        super().__init__()
+        self.transform = Linear(hidden_size, hidden_size)
+        self.activation = getattr(F, activation)
+        self.layer_norm = LayerNorm(hidden_size, 1e-12)
+        from ...nn import initializer as I
+
+        if embedding_weights is None:
+            self.decoder_weight = self.create_parameter(
+                [vocab_size, hidden_size], default_initializer=I.XavierNormal())
+        else:
+            # tied to the embedding table: must NOT register as a second
+            # parameter (double registration would double-apply optimizer
+            # updates eagerly and break bind() under TrainStep) — keep a
+            # plain reference, read at forward time like GPT's tied head
+            object.__setattr__(self, "_tied_weight", embedding_weights)
+        self.decoder_bias = self.create_parameter([vocab_size], is_bias=True)
+
+    @property
+    def _weight(self):
+        tied = self.__dict__.get("_tied_weight")
+        return tied if tied is not None else self.decoder_weight
+
+    def forward(self, hidden_states):
+        h = self.layer_norm(self.activation(self.transform(hidden_states)))
+        return _apply(lambda hv, w, b: hv @ w.T + b, h, self._weight,
+                      self.decoder_bias, op_name="matmul")
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (reference BertForPretraining)."""
+
+    def __init__(self, bert=None, **bert_kwargs):
+        super().__init__()
+        self.bert = bert if bert is not None else BertModel(**bert_kwargs)
+        hidden = self.bert.pooler.dense.weight.shape[0]
+        vocab = self.bert.embeddings.word_embeddings.weight.shape[0]
+        self.cls = BertLMPredictionHead(
+            hidden, vocab, embedding_weights=self.bert.embeddings.word_embeddings.weight)
+        self.nsp = Linear(hidden, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                                attention_mask)
+        return self.cls(seq), self.nsp(pooled)
+
+
+class BertPretrainingCriterion(Layer):
+    def __init__(self, vocab_size):
+        super().__init__()
+        self.vocab_size = vocab_size
+
+    def forward(self, prediction_scores, seq_relationship_score, masked_lm_labels,
+                next_sentence_labels, masked_lm_scale=1.0):
+        mlm = F.cross_entropy(prediction_scores.reshape([-1, self.vocab_size]),
+                              masked_lm_labels.reshape([-1]), ignore_index=-100,
+                              reduction="mean")
+        nsp = F.cross_entropy(seq_relationship_score,
+                              next_sentence_labels.reshape([-1]), reduction="mean")
+        return mlm + nsp

@@ -1,0 +1,90 @@
+"""Baseline config #5 shape: GPT trains under dp2 x pp2 x mp2 hybrid
+parallelism on the fake 8-device mesh, matching unsharded training
+(VERDICT round-1 item 5 done-criterion)."""
+
+import numpy as np
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.text.models.gpt import (
+    GPTForCausalLM, GPTForCausalLMPipe, pipeline_forward,
+)
+
+CFG = dict(vocab_size=64, hidden_size=16, num_hidden_layers=4,
+           num_attention_heads=2, max_position_embeddings=32)
+
+
+def test_gpt_dp2_pp2_mp2_matches_unsharded():
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed import topology as topo
+
+    rng = np.random.RandomState(0)
+    ids_np = rng.randint(1, 64, (8, 12)).astype("int64")
+    ids = paddle.to_tensor(ids_np)
+
+    # reference weights, snapshotted (DEEP copy: TrainStep donates the ref's
+    # arrays, which would invalidate aliases) BEFORE any training
+    paddle.seed(7)
+    ref = GPTForCausalLM(**CFG)
+    init_sd = {k: paddle.Tensor(np.array(v.numpy()))
+               for k, v in ref.state_dict().items()}
+
+    o_ref = opt.AdamW(learning_rate=1e-3, parameters=ref.parameters())
+    step_ref = paddle.jit.TrainStep(ref, o_ref, loss_fn=None)
+    ref_losses = [float(step_ref({"input_ids": ids, "labels": ids}))
+                  for _ in range(4)]
+
+    # hybrid dp2 x pp2 x mp2
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+                               "order": ["dp", "pp", "mp"]}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_parallel_mode() == "hybrid"
+    mesh = hcg.mesh
+
+    paddle.seed(7)
+    lm = GPTForCausalLM(**CFG)  # builds TP layers under the mp>1 mesh
+    lm.set_state_dict(init_sd)
+    pmodel = GPTForCausalLMPipe(lm, mesh, n_micro=4, batch_axis="dp")
+    o = opt.AdamW(learning_rate=1e-3, parameters=pmodel.parameters())
+    step = paddle.jit.TrainStep(pmodel, o, loss_fn=None)
+    pp_losses = [float(step({"input_ids": ids, "labels": ids})) for _ in range(4)]
+
+    np.testing.assert_allclose(ref_losses, pp_losses, rtol=2e-4, atol=2e-5)
+    # reset the global hcg so other tests see a clean slate
+    topo.set_hybrid_communicate_group(None)
+
+
+def test_pipeline_forward_eval_parity_all_modes():
+    from paddle_tpu.distributed import topology as topo
+    from jax.sharding import Mesh
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(1, 64, (8, 12)).astype("int64"))
+    paddle.seed(7)
+    ref = GPTForCausalLM(**CFG)
+    ref.eval()
+    hidden_ref = ref.gpt(ids).numpy()
+
+    # pp only
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("pp",))
+    hp = pipeline_forward(ref.gpt, ids, mesh, n_micro=4, axis="pp").numpy()
+    np.testing.assert_allclose(hidden_ref, hp, rtol=1e-4, atol=1e-5)
+
+    # dp x pp x mp
+    t = topo.CommunicateTopology(["dp", "pp", "mp"], [2, 2, 2])
+    hcg = topo.HybridCommunicateGroup(t)
+    topo.set_hybrid_communicate_group(hcg)
+    try:
+        paddle.seed(7)
+        lm = GPTForCausalLM(**CFG)
+        lm.set_state_dict(ref.state_dict())
+        lm.eval()
+        h2 = pipeline_forward(lm.gpt, ids, hcg.mesh, n_micro=4, axis="pp",
+                              batch_axis="dp").numpy()
+        np.testing.assert_allclose(hidden_ref, h2, rtol=1e-4, atol=1e-5)
+    finally:
+        topo.set_hybrid_communicate_group(None)

@@ -1,0 +1,170 @@
+"""NLP zoo: BERT cross-validated against the torch/transformers reference,
+GPT trains + generates, tokenizers round-trip."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.text import (
+    BertModel, BertForSequenceClassification, BertTokenizer, GPTForCausalLM,
+    SimpleTokenizer,
+)
+
+
+def small_bert(**kw):
+    cfg = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+               num_attention_heads=4, intermediate_size=64,
+               max_position_embeddings=64, hidden_dropout_prob=0.0,
+               attention_probs_dropout_prob=0.0)
+    cfg.update(kw)
+    return BertModel(**cfg), cfg
+
+
+def test_bert_shapes():
+    m, _ = small_bert()
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(1, 128, (2, 16)).astype("int64"))
+    seq, pooled = m(ids)
+    assert seq.shape == [2, 16, 32]
+    assert pooled.shape == [2, 32]
+
+
+def test_bert_matches_transformers():
+    torch = pytest.importorskip("torch")
+    tfs = pytest.importorskip("transformers")
+
+    m, cfg = small_bert()
+    m.eval()
+    hf_cfg = tfs.BertConfig(
+        vocab_size=cfg["vocab_size"], hidden_size=cfg["hidden_size"],
+        num_hidden_layers=cfg["num_hidden_layers"],
+        num_attention_heads=cfg["num_attention_heads"],
+        intermediate_size=cfg["intermediate_size"],
+        max_position_embeddings=cfg["max_position_embeddings"],
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        hidden_act="gelu")
+    hf = tfs.BertModel(hf_cfg).eval()
+
+    # copy OUR weights into the HF model (torch Linear weight is [out, in])
+    t = lambda a: torch.tensor(np.asarray(a, dtype=np.float32))
+    sd = {}
+    sd["embeddings.word_embeddings.weight"] = t(m.embeddings.word_embeddings.weight.numpy())
+    sd["embeddings.position_embeddings.weight"] = t(m.embeddings.position_embeddings.weight.numpy())
+    sd["embeddings.token_type_embeddings.weight"] = t(m.embeddings.token_type_embeddings.weight.numpy())
+    sd["embeddings.LayerNorm.weight"] = t(m.embeddings.layer_norm.weight.numpy())
+    sd["embeddings.LayerNorm.bias"] = t(m.embeddings.layer_norm.bias.numpy())
+    for i, lay in enumerate(m.encoder.layers):
+        p = f"encoder.layer.{i}."
+        sd[p + "attention.self.query.weight"] = t(lay.self_attn.q_proj.weight.numpy().T)
+        sd[p + "attention.self.query.bias"] = t(lay.self_attn.q_proj.bias.numpy())
+        sd[p + "attention.self.key.weight"] = t(lay.self_attn.k_proj.weight.numpy().T)
+        sd[p + "attention.self.key.bias"] = t(lay.self_attn.k_proj.bias.numpy())
+        sd[p + "attention.self.value.weight"] = t(lay.self_attn.v_proj.weight.numpy().T)
+        sd[p + "attention.self.value.bias"] = t(lay.self_attn.v_proj.bias.numpy())
+        sd[p + "attention.output.dense.weight"] = t(lay.self_attn.out_proj.weight.numpy().T)
+        sd[p + "attention.output.dense.bias"] = t(lay.self_attn.out_proj.bias.numpy())
+        sd[p + "attention.output.LayerNorm.weight"] = t(lay.norm1.weight.numpy())
+        sd[p + "attention.output.LayerNorm.bias"] = t(lay.norm1.bias.numpy())
+        sd[p + "intermediate.dense.weight"] = t(lay.linear1.weight.numpy().T)
+        sd[p + "intermediate.dense.bias"] = t(lay.linear1.bias.numpy())
+        sd[p + "output.dense.weight"] = t(lay.linear2.weight.numpy().T)
+        sd[p + "output.dense.bias"] = t(lay.linear2.bias.numpy())
+        sd[p + "output.LayerNorm.weight"] = t(lay.norm2.weight.numpy())
+        sd[p + "output.LayerNorm.bias"] = t(lay.norm2.bias.numpy())
+    sd["pooler.dense.weight"] = t(m.pooler.dense.weight.numpy().T)
+    sd["pooler.dense.bias"] = t(m.pooler.dense.bias.numpy())
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 128, (2, 16)).astype("int64")
+    mask = np.ones((2, 16), dtype="int64")
+    mask[1, 10:] = 0
+    ours_seq, ours_pool = m(paddle.to_tensor(ids),
+                            attention_mask=paddle.to_tensor(mask))
+    with torch.no_grad():
+        hf_out = hf(torch.tensor(ids), attention_mask=torch.tensor(mask))
+    np.testing.assert_allclose(ours_seq.numpy(), hf_out.last_hidden_state.numpy(),
+                               rtol=1e-3, atol=2e-4)
+    np.testing.assert_allclose(ours_pool.numpy(), hf_out.pooler_output.numpy(),
+                               rtol=1e-3, atol=2e-4)
+
+
+def test_bert_finetune_through_train_step():
+    paddle.seed(0)
+    net = BertForSequenceClassification(
+        num_classes=2, vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64, max_position_embeddings=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    o = opt.AdamW(learning_rate=5e-4, parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, o, loss_fn=nn.CrossEntropyLoss())
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(1, 128, (8, 16)).astype("int64"))
+    y = paddle.to_tensor((ids.numpy()[:, 0] % 2).astype("int64"))
+    losses = [float(step(ids, y)) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_gpt_train_and_generate():
+    paddle.seed(0)
+    lm = GPTForCausalLM(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                        num_attention_heads=4, max_position_embeddings=64)
+    o = opt.AdamW(learning_rate=1e-3, parameters=lm.parameters())
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(1, 96, (4, 12)).astype("int64"))
+    step = paddle.jit.TrainStep(lm, o, loss_fn=None)
+    losses = [float(step({"input_ids": ids, "labels": ids})) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    gen = lm.generate(ids[:1, :4], max_new_tokens=3, temperature=0.0)
+    assert gen.shape == [1, 7]
+
+
+def test_gpt_forward_on_labels_none():
+    lm = GPTForCausalLM(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+                        num_attention_heads=4, max_position_embeddings=64)
+    lm.eval()
+    ids = paddle.to_tensor(np.arange(8, dtype="int64")[None, :])
+    logits = lm(ids)
+    assert logits.shape == [1, 8, 96]
+
+
+def test_tokenizers():
+    corpus = ["the quick brown fox jumps over the lazy dog",
+              "pack my box with five dozen liquor jugs"]
+    tok = SimpleTokenizer.from_corpus(corpus)
+    enc = tok("the quick fox", max_length=16)
+    assert len(enc["input_ids"]) == 16
+    assert enc["input_ids"][0] == tok.cls_token_id
+
+    bt = BertTokenizer.from_corpus(corpus, min_freq=1)
+    pieces = bt.tokenize("quickest")
+    assert pieces and all(p in bt.vocab for p in pieces)
+    ids = bt.convert_tokens_to_ids(pieces)
+    assert bt.convert_ids_to_tokens(ids) == pieces
+
+
+def test_bert_pretraining_tied_head_single_param():
+    """Tied MLM head must not double-register the embedding weight."""
+    from paddle_tpu.text import BertForPretraining
+
+    net = BertForPretraining(vocab_size=64, hidden_size=16, num_hidden_layers=1,
+                             num_attention_heads=2, intermediate_size=32,
+                             max_position_embeddings=32,
+                             hidden_dropout_prob=0.0,
+                             attention_probs_dropout_prob=0.0)
+    emb = net.bert.embeddings.word_embeddings.weight
+    shared = [n for n, p in net.named_parameters() if p is emb]
+    assert len(shared) == 1, shared
+
+    # one eager SGD step moves the tied weight exactly once
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(1, 64, (2, 8)).astype("int64"))
+    mlm, nsp = net(ids)
+    loss = mlm.mean() + nsp.mean()
+    loss.backward()
+    o = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+    before = emb.numpy().copy()
+    g = emb.grad.numpy().copy()
+    o.step()
+    np.testing.assert_allclose(emb.numpy(), before - 0.1 * g, rtol=1e-5, atol=1e-6)
