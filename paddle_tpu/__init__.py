@@ -56,7 +56,7 @@ import importlib as _importlib
 
 _LAZY = ("nn", "optimizer", "amp", "io", "metric", "jit", "static", "vision",
          "distributed", "autograd", "device", "framework", "hapi", "profiler",
-         "incubate", "utils", "sparse", "signal", "fft")
+         "incubate", "utils", "sparse", "signal", "fft", "text", "ops")
 
 
 def __getattr__(name):

@@ -71,7 +71,8 @@ def _topo_from(root_node) -> List[Node]:
     return order  # children before parents; iterate reversed for backward
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False, into=None):
+def backward(tensors, grad_tensors=None, retain_graph=False, into=None,
+             create_graph=False):
     """Run backward from ``tensors`` (paddle.autograd.backward semantics).
 
     Accumulates ``.grad`` on every reachable leaf tensor with
@@ -79,6 +80,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False, into=None):
     called ``retain_grads()``.  If ``into`` (a dict) is given, grads are
     written there keyed by ``id(tensor)`` instead of touching ``.grad`` —
     used by :func:`grad` so it has no side effects on other leaves.
+
+    ``create_graph=True`` runs every vjp THROUGH the dispatch layer, so the
+    gradient computation is itself taped and differentiable (double grad —
+    reference: paddle.grad(create_graph=True) via double-grad ops).
     """
     from ..tensor.tensor import Tensor
 
@@ -89,14 +94,20 @@ def backward(tensors, grad_tensors=None, retain_graph=False, into=None):
     elif isinstance(grad_tensors, Tensor):
         grad_tensors = [grad_tensors]
 
-    # cotangent accumulator keyed by tensor identity
+    # cotangent accumulator keyed by tensor identity; values are raw arrays
+    # normally, Tensors when create_graph (so accumulation itself is taped)
     cts: dict[int, Any] = {}
     keep: dict[int, Tensor] = {}  # keep tensors alive during walk
     roots = []
     for t, g in zip(tensors, grad_tensors):
         if t.stop_gradient:
             raise RuntimeError("backward() on a tensor with stop_gradient=True")
-        seed = g._value if isinstance(g, Tensor) else (g if g is not None else jnp.ones_like(t._value))
+        if create_graph:
+            seed = g if isinstance(g, Tensor) else Tensor(
+                g if g is not None else jnp.ones_like(t._value), stop_gradient=True)
+        else:
+            seed = g._value if isinstance(g, Tensor) else (
+                g if g is not None else jnp.ones_like(t._value))
         cts[id(t)] = cts.get(id(t), 0) + seed
         keep[id(t)] = t
         if t._grad_node is not None:
@@ -110,7 +121,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False, into=None):
                 seen.add(id(n))
                 order.append(n)
 
-    _run_nodes(order, cts, keep)
+    hooked: set = set()
+    _run_nodes(order, cts, keep, create_graph, hooked)
 
     # store accumulated grads on leaves (and retain_grads tensors), once
     for tid, t in keep.items():
@@ -119,16 +131,42 @@ def backward(tensors, grad_tensors=None, retain_graph=False, into=None):
         is_leaf = t._grad_node is None
         if (is_leaf and not t.stop_gradient) or getattr(t, "_retain_grads", False):
             g = cts[tid]
+            if tid not in hooked:  # mid-walk application already ran once
+                g = _apply_hooks(t, g)
             if into is not None:
                 into[tid] = into[tid] + g if tid in into else g
+            elif isinstance(g, Tensor):
+                t.grad = g if t.grad is None else Tensor(t.grad._value + g._value,
+                                                         stop_gradient=True)
             elif t.grad is None:
                 t.grad = Tensor(g, stop_gradient=True)
             else:
                 t.grad = Tensor(t.grad._value + g, stop_gradient=True)
 
 
-def _run_nodes(order, cts, keep):
-    """Execute vjps parents-first; accumulate cotangents into ``cts``."""
+def _apply_hooks(t, g):
+    """Run Tensor.register_hook callbacks on a finalized gradient."""
+    from ..tensor.tensor import Tensor
+
+    hooks = getattr(t, "_grad_hooks", None)
+    if not hooks:
+        return g
+    gt = g if isinstance(g, Tensor) else Tensor(g, stop_gradient=True)
+    for h in hooks:
+        out = h(gt)
+        if out is not None:
+            gt = out if isinstance(out, Tensor) else Tensor(out, stop_gradient=True)
+    return gt if isinstance(g, Tensor) else gt._value
+
+
+def _run_nodes(order, cts, keep, create_graph=False, hooked=None):
+    """Execute vjps parents-first; accumulate cotangents into ``cts``.
+
+    create_graph: route every vjp through dispatch.apply so the gradient
+    computation is itself recorded on the tape (differentiable grads).
+    ``hooked`` records tensors whose hooks ran here, so backward()'s final
+    loop doesn't apply them a second time.
+    """
     from ..tensor.tensor import Tensor
 
     for node in reversed(order):
@@ -137,7 +175,14 @@ def _run_nodes(order, cts, keep):
         have_any = False
         for o in outs:
             if o is not None and id(o) in cts:
-                out_cts.append(cts[id(o)])
+                g = cts[id(o)]
+                if getattr(o, "_grad_hooks", None) and (
+                        o._grad_node is not None or not o.stop_gradient):
+                    g = _apply_hooks(o, g)
+                    cts[id(o)] = g
+                    if hooked is not None:
+                        hooked.add(id(o))
+                out_cts.append(g)
                 have_any = True
             else:
                 out_cts.append(None)
@@ -148,7 +193,6 @@ def _run_nodes(order, cts, keep):
         if not tin:
             continue
         idxs = [i for i, _ in tin]
-        tvals = [t._value for _, t in tin]
 
         def primal(*vs, _node=node, _idxs=idxs):
             args = list(_node.inputs)
@@ -157,6 +201,44 @@ def _run_nodes(order, cts, keep):
             args = [a._value if isinstance(a, Tensor) else a for a in args]
             return _node.fn(*args, **_node.kwargs)
 
+        n_in = len(tin)
+
+        if create_graph:
+            # taped gradient: (inputs..., cotangents...) -> input cotangents,
+            # recorded through dispatch.apply so a second backward() works
+            from ..tensor.dispatch import apply as _dispatch_apply
+
+            ct_tensors = [c if isinstance(c, Tensor) else
+                          (None if c is None else Tensor(c, stop_gradient=True))
+                          for c in out_cts]
+            present = [i for i, c in enumerate(ct_tensors) if c is not None]
+
+            def grad_fn(*vals, _primal=primal, _present=tuple(present),
+                        _n_in=n_in):
+                tv = vals[:_n_in]
+                cvs = vals[_n_in:]
+                p_out, vjp_fn = jax.vjp(_primal, *tv)
+                if isinstance(p_out, (tuple, list)):
+                    it = iter(cvs)
+                    ct_full = tuple(
+                        next(it) if i in _present else _zero_cotangent(po)
+                        for i, po in enumerate(p_out))
+                else:
+                    ct_full = cvs[0]
+                res = vjp_fn(ct_full)
+                return tuple(res) if len(res) > 1 else res[0]
+
+            args = [t for _, t in tin] + [ct_tensors[i] for i in present]
+            grads = _dispatch_apply(grad_fn, *args,
+                                    op_name=f"grad_{node.name}", n_outs=None)
+            in_cts = grads if isinstance(grads, tuple) else (grads,)
+            for (_, t), g in zip(tin, in_cts):
+                tid = id(t)
+                keep[tid] = t
+                cts[tid] = cts[tid] + g if tid in cts else g
+            continue
+
+        tvals = [t._value for _, t in tin]
         primal_out, vjp_fn = jax.vjp(primal, *tvals)
         if isinstance(primal_out, (tuple, list)):
             ct = tuple(
@@ -187,15 +269,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
          only_inputs=True, allow_unused=False):
     """paddle.grad: return grads of ``outputs`` w.r.t. ``inputs`` with NO
     side effects on any tensor's ``.grad`` (grads flow into a private sink).
-    ``create_graph`` (double grad) is not yet supported on the eager tape —
-    compose ``jax.grad`` via jit/to_static for higher-order derivatives.
+    ``create_graph=True`` returns grads that are themselves on the tape, so
+    a second backward()/grad() differentiates through them (double grad).
     """
     from ..tensor.tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is not supported on the eager tape; "
-            "use jit/to_static + jax.grad composition instead")
     single_in = isinstance(inputs, Tensor)
     inputs = [inputs] if single_in else list(inputs)
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
@@ -211,7 +289,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
             retains.append(t)
     sink: dict = {}
     try:
-        backward(outputs, grad_outputs, retain_graph=retain_graph, into=sink)
+        backward(outputs, grad_outputs, retain_graph=retain_graph, into=sink,
+                 create_graph=create_graph)
         results = []
         for t in inputs:
             g = sink.get(id(t))
@@ -219,6 +298,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
                 if not allow_unused:
                     raise RuntimeError("an input tensor is unused in the graph (allow_unused=False)")
                 results.append(None)
+            elif isinstance(g, Tensor):
+                # create_graph: g is on the tape; keep its node for the
+                # second-order backward
+                results.append(g)
             else:
                 results.append(Tensor(g, stop_gradient=True))
     finally:

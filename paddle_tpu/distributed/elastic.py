@@ -1,0 +1,47 @@
+"""Elastic / fault recovery (reference: fleet/elastic/ + launch master
+heartbeat — SURVEY.md §5.3).
+
+TPU strategy: fail-fast + auto-restart-from-checkpoint.  There is no
+NCCL-style per-rank rejoin inside an ICI slice — when a host/chip drops,
+the whole job restarts and resumes from the last checkpoint (the
+supervisor below), which is exactly how pod-scale TPU training recovers.
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+
+
+class ElasticSupervisor:
+    """Run a resumable training function with restart-on-failure.
+
+    ``train_fn(start_step, state) -> None`` should checkpoint through the
+    given CheckpointManager; on crash the supervisor reloads the latest
+    checkpoint and calls it again.
+    """
+
+    def __init__(self, checkpoint_manager, max_restarts=3, backoff_seconds=1.0):
+        self.manager = checkpoint_manager
+        self.max_restarts = max_restarts
+        self.backoff = backoff_seconds
+
+    def run(self, train_fn, template=None):
+        restarts = 0
+        while True:
+            step = self.manager.latest_step()
+            state = None
+            if step is not None:
+                state = self.manager.restore(step, template=template)
+            try:
+                return train_fn((step or 0), state)
+            except KeyboardInterrupt:
+                raise
+            except Exception:
+                restarts += 1
+                if restarts > self.max_restarts:
+                    raise
+                traceback.print_exc()
+                print(f"[elastic] restart {restarts}/{self.max_restarts} "
+                      f"from step {self.manager.latest_step()}")
+                time.sleep(self.backoff * restarts)

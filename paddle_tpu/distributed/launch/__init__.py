@@ -1,0 +1,65 @@
+"""paddle.distributed.launch — the job launcher.
+
+Reference analog: python/paddle/distributed/launch/ (one worker process per
+GPU, env-var rendezvous contract, elastic master).
+
+TPU model (SURVEY.md §3.5): ONE process per TPU host drives all local
+chips (single-controller SPMD), so "launch" degenerates to: set the
+coordination-service env vars, run the script.  Multi-host: run this same
+command on every host with --nnodes/--node_rank/--master; it maps the
+paddle env contract onto jax.distributed.initialize inputs, which
+init_parallel_env consumes.
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+
+def build_env(nnodes=1, node_rank=0, master="127.0.0.1:8765"):
+    env = {
+        "PADDLE_TRAINERS_NUM": str(nnodes),
+        "PADDLE_TRAINER_ID": str(node_rank),
+        "PADDLE_TRAINER_ENDPOINTS": master,
+        "PADDLE_CURRENT_ENDPOINT": master if node_rank == 0 else "",
+        "JAX_COORDINATOR_ADDRESS": master,
+        "JAX_NUM_PROCESSES": str(nnodes),
+        "JAX_PROCESS_ID": str(node_rank),
+    }
+    return env
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a (multi-host) TPU training job: one process per "
+                    "host, chips driven via the global mesh.")
+    parser.add_argument("--nnodes", type=int,
+                        default=int(os.environ.get("PADDLE_NNODES", "1")),
+                        help="number of hosts in the job")
+    parser.add_argument("--node_rank", type=int,
+                        default=int(os.environ.get("PADDLE_NODE_RANK", "0")),
+                        help="this host's rank")
+    parser.add_argument("--master", type=str,
+                        default=os.environ.get("PADDLE_MASTER", "127.0.0.1:8765"),
+                        help="coordinator host:port (rank-0 host)")
+    parser.add_argument("--devices", "--gpus", type=str, default=None,
+                        help="accepted for reference-CLI parity; chip "
+                             "visibility is controlled by the TPU runtime")
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("script", help="training script to run")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    os.environ.update(build_env(args.nnodes, args.node_rank, args.master))
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        os.environ["PADDLE_LOG_DIR"] = args.log_dir
+
+    sys.argv = [args.script] + list(args.script_args)
+    runpy.run_path(args.script, run_name="__main__")
+    return 0

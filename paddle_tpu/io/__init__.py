@@ -10,6 +10,7 @@ multi-host input pipelines.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import math
 
@@ -268,7 +269,8 @@ class DataLoader:
                  worker_init_fn=None, persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
-        self.num_workers = num_workers  # workers>0 falls back to in-process on 1-vCPU hosts
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
         self.return_list = return_list
         self._iterable = isinstance(dataset, IterableDataset)
         self.batch_size = batch_size
@@ -291,10 +293,45 @@ class DataLoader:
                 if len(batch) < self.batch_size and self.drop_last:
                     return
                 yield self._wrap(self.collate_fn(batch))
+        elif self.num_workers > 0:
+            yield from self._iter_workers()
         else:
             for indices in self.batch_sampler:
                 batch = [self.dataset[i] for i in indices]
                 yield self._wrap(self.collate_fn(batch))
+
+    def _iter_workers(self):
+        """Parallel batch assembly: a thread pool loads/augments batches
+        ``prefetch_factor * num_workers`` ahead of the training loop.
+
+        Threads (not processes): sample decode/augment is numpy/PIL work
+        that releases the GIL, device feeding must happen on the main
+        thread anyway, and the reference's worker-process shared-memory
+        plumbing (python/paddle/io DataLoader workers) exists to dodge a
+        GIL that this pipeline mostly doesn't hold.
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def load(indices):
+            batch = [self.dataset[i] for i in indices]
+            return self.collate_fn(batch)
+
+        depth = max(2, self.prefetch_factor) * self.num_workers
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            pending = collections.deque()
+            it = iter(self.batch_sampler)
+            try:
+                for _ in range(depth):
+                    pending.append(pool.submit(load, next(it)))
+            except StopIteration:
+                pass
+            while pending:
+                fut = pending.popleft()
+                try:
+                    pending.append(pool.submit(load, next(it)))
+                except StopIteration:
+                    pass
+                yield self._wrap(fut.result())
 
     def _wrap(self, collated):
         if isinstance(collated, (list, tuple)):
@@ -313,3 +350,6 @@ class DataLoader:
 
 def get_worker_info():
     return None
+
+from . import checkpoint  # noqa: E402,F401 — orbax-backed sharded checkpointing
+from .checkpoint import CheckpointManager, save_checkpoint, load_checkpoint  # noqa: E402,F401

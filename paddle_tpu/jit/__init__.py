@@ -99,12 +99,62 @@ class StaticFunction:
         self.__wrapped__ = fn
 
     # ------------------------------------------------------------- utils
-    @property
-    def _params_and_buffers(self):
-        layer = self._layer
-        if layer is None:
-            return [], []
-        return list(layer.named_parameters()), list(layer.named_buffers())
+    def _captured_layers(self):
+        """Layers this function computes with: the bound layer, or Layers the
+        free function closes over / references as globals — their params must
+        be threaded as traced inputs or the output is not differentiable
+        (reference dy2static supports the closure pattern; round-1 hole)."""
+        if self._layer is not None:
+            return [("", self._layer)]
+        from ..nn.layer import Layer
+
+        fn = self._fn
+        code = getattr(fn, "__code__", None)
+        if code is None:
+            return []
+        found = []
+        seen = set()
+
+        def visit(name, v):
+            if isinstance(v, Layer):
+                if id(v) not in seen:
+                    seen.add(id(v))
+                    found.append((name, v))
+            elif isinstance(v, dict):  # one container level: {'enc': layer}
+                for k2, v2 in v.items():
+                    if isinstance(v2, Layer) and id(v2) not in seen:
+                        seen.add(id(v2))
+                        found.append((f"{name}[{k2!r}]", v2))
+            elif isinstance(v, (list, tuple)):
+                for i2, v2 in enumerate(v):
+                    if isinstance(v2, Layer) and id(v2) not in seen:
+                        seen.add(id(v2))
+                        found.append((f"{name}[{i2}]", v2))
+
+        if getattr(fn, "__closure__", None):
+            for name, cell in zip(code.co_freevars, fn.__closure__):
+                try:
+                    v = cell.cell_contents
+                except ValueError:
+                    continue
+                visit(name, v)
+        for name in code.co_names:
+            v = getattr(fn, "__globals__", {}).get(name)
+            if v is not None:
+                visit(name, v)
+        return found
+
+    @staticmethod
+    def _collect_state(layers):
+        """Merged (key, tensor) lists across captured layers; keys carry the
+        layer slot so bind() can split them back."""
+        named_p, named_b = [], []
+        for slot, (lname, layer) in enumerate(layers):
+            for k, p in layer.named_parameters():
+                named_p.append((f"{slot}|{k}", p))
+            for k, b in layer.named_buffers():
+                named_b.append((f"{slot}|{k}", b))
+        return named_p, named_b
 
     def _spec_default_args(self, args):
         """Pad args with zeros tensors built from input_spec when called with
@@ -136,11 +186,11 @@ class StaticFunction:
                 return self._fn(self._layer, *args, **kwargs)
             return self._fn(*args, **kwargs)
 
-        layer = self._layer
-        named_p, named_b = self._params_and_buffers
+        layers = self._captured_layers()
+        named_p, named_b = self._collect_state(layers)
         pnames = [k for k, _ in named_p]
         bnames = [k for k, _ in named_b]
-        training = bool(layer.training) if layer is not None else False
+        training = tuple(bool(l.training) for _, l in layers)
 
         flat, treedef = jax.tree_util.tree_flatten(
             (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
@@ -155,12 +205,13 @@ class StaticFunction:
         # alias traces.  Unhashable leaves are frozen to a content fingerprint
         # so repeat calls still hit the cache instead of retracing forever.
         static_key = _freeze_statics(statics)
-        key = (treedef, static_key, avals, training)
+        key = (treedef, static_key, avals, training,
+               tuple(id(l) for _, l in layers))
 
         jitted = self._cache.get(key)
         if jitted is None:
-            jitted = self._build(treedef, t_idx, statics, pnames, bnames, training,
-                                 len(tensors))
+            jitted = self._build(treedef, t_idx, statics, layers, pnames, bnames,
+                                 training, key)
             self._cache[key] = jitted
 
         p_ts = [p for _, p in named_p]
@@ -178,15 +229,26 @@ class StaticFunction:
             outs = outs[:len(outs) - n_b]
         return jax.tree_util.tree_unflatten(self._out_treedefs[key], list(outs))
 
-    def _build(self, treedef, t_idx, statics, pnames, bnames, training, n_tensors):
+    def _build(self, treedef, t_idx, statics, layers, pnames, bnames, training,
+               cache_key):
+        import contextlib
+
         fn = self._fn
-        layer = self._layer
+        bound_layer = self._layer
         if not hasattr(self, "_out_treedefs"):
             self._out_treedefs = {}
         sf = self
 
         n_p = len(pnames)
         n_b = len(bnames)
+
+        def _per_layer(keys, vals):
+            """Split 'slot|name' keyed values back into per-layer dicts."""
+            out = [dict() for _ in layers]
+            for k, v in zip(keys, vals):
+                slot, _, name = k.partition("|")
+                out[int(slot)][name] = v
+            return out
 
         def pure(rng_key, *leaves):
             pvals = leaves[:n_p]
@@ -198,22 +260,28 @@ class StaticFunction:
             for i, leaf in statics:
                 flat[i] = leaf
             call_args, call_kwargs = jax.tree_util.tree_unflatten(treedef, flat)
-            with no_grad_ctx(), _rng.rng_scope(rng_key):
-                if layer is not None:
-                    was = layer.training
-                    layer.training = training
-                    try:
-                        with layer.bind(dict(zip(pnames, pvals)),
-                                        dict(zip(bnames, bvals))):
-                            out = fn(layer, *call_args, **call_kwargs)
-                        # bind captures buffer mutations on exit
-                        newb = [layer._captured_buffers[k] for k in bnames] \
-                            if n_b else []
-                    finally:
-                        layer.training = was
-                else:
-                    out = fn(*call_args, **call_kwargs)
-                    newb = []
+            p_split = _per_layer(pnames, pvals)
+            b_split = _per_layer(bnames, bvals)
+            was = [l.training for _, l in layers]
+            newb = []
+            try:
+                with no_grad_ctx(), _rng.rng_scope(rng_key), \
+                        contextlib.ExitStack() as stack:
+                    for slot, (_, l) in enumerate(layers):
+                        l.training = training[slot]
+                        stack.enter_context(l.bind(p_split[slot], b_split[slot]))
+                    if bound_layer is not None:
+                        out = fn(bound_layer, *call_args, **call_kwargs)
+                    else:
+                        out = fn(*call_args, **call_kwargs)
+                # binds capture buffer mutations on exit (stack closed above)
+                if n_b:
+                    for slot, (_, l) in enumerate(layers):
+                        for name in b_split[slot]:
+                            newb.append(l._captured_buffers[name])
+            finally:
+                for (_, l), w in zip(layers, was):
+                    l.training = w
             out_leaves, out_tree = jax.tree_util.tree_flatten(
                 out, is_leaf=lambda x: isinstance(x, Tensor))
             out_vals = [o._value if isinstance(o, Tensor) else jnp.asarray(o)
@@ -226,12 +294,8 @@ class StaticFunction:
         def run(rng_key, *leaves):
             res = jitted_inner(rng_key, *leaves)
             # out_tree is set during trace; cached afterwards
-            k = (treedef,
-                 sf._static_key_of(statics),
-                 tuple((tuple(v.shape), str(v.dtype)) for v in leaves[n_p + n_b:]),
-                 training)
-            if k not in sf._out_treedefs:
-                sf._out_treedefs[k] = pure._out_tree
+            if cache_key not in sf._out_treedefs:
+                sf._out_treedefs[cache_key] = pure._out_tree
             return res
 
         run.__name__ = f"to_static_{self.__name__}"

@@ -49,16 +49,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     qv = unwrap(query)
     if (attn_mask is None and dropout_p == 0.0 and qv.ndim == 4):
         try:
-            from ...ops import flash_attention as fa
+            from ...ops.flash_attention import supported
 
-            use_flash = fa.supported(qv.shape, unwrap(key).shape, is_causal)
+            use_flash = supported(qv.shape, unwrap(key).shape, is_causal)
         except Exception:
             use_flash = False
     if use_flash:
-        from ...ops import flash_attention as fa
+        from ...ops.flash_attention import flash_attention_bshd
 
         def fn(q, k, v):
-            return fa.flash_attention_bshd(q, k, v, causal=is_causal, scale=scale)
+            return flash_attention_bshd(q, k, v, causal=is_causal, scale=scale)
 
         return apply(fn, query, key, value, op_name="flash_attention")
 

@@ -1,0 +1,51 @@
+"""paddle_tpu.ops — Pallas TPU kernels and their paddle-shaped front-ends.
+
+Reference analog: the fused/custom kernel layer (phi/kernels/fusion/,
+incubate fused ops).  Kernels here are the hand-tuned hot-ops XLA shouldn't
+have to rediscover: flash attention (online-softmax, VMEM-resident state)
+and ring attention (context parallelism over ppermute).
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..tensor.dispatch import apply as _apply
+from ..tensor.tensor import Tensor
+from .flash_attention import flash_attention_fn
+from .ring_attention import ring_attention_fn
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention equivalent: [B, S, H, D] in/out.
+
+    dropout inside the kernel is unsupported (apply dropout on the output);
+    return_softmax returns (out, None) for API parity — the point of flash
+    attention is that the softmax matrix never exists.
+    """
+    if dropout and training:
+        raise NotImplementedError(
+            "flash_attention dropout inside the kernel is not supported; "
+            "use nn.functional.scaled_dot_product_attention for dropout")
+    out = _apply(lambda q, k, v: flash_attention_fn(q, k, v, causal=causal),
+                 query, key, value, op_name="flash_attention")
+    if return_softmax:
+        return out, None
+    return out
+
+
+def ring_attention(query, key, value, mesh=None, axis="sep", causal=False,
+                   name=None):
+    """Context-parallel attention over the mesh's sequence axis."""
+    if mesh is None:
+        from ..distributed.topology import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        if hcg is None:
+            raise ValueError("ring_attention needs a mesh (fleet.init first)")
+        mesh = hcg.mesh
+    return _apply(
+        lambda q, k, v: ring_attention_fn(q, k, v, mesh, axis=axis, causal=causal),
+        query, key, value, op_name="ring_attention")

@@ -1,0 +1,87 @@
+"""Ring attention — context parallelism over the ICI ring (SURVEY.md §5.7
+item 3, the flagship TPU-idiomatic component; reference analog: PaddleNLP's
+ring_flash_attention built on p2p send/recv groups).
+
+Design: q/k/v are sharded along the SEQUENCE dim across the mesh axis.
+Inside a shard_map, each device holds one sequence block; K/V blocks rotate
+one hop per step with ``lax.ppermute`` (the ICI ring IS the communication
+pattern), and every step merges the local attention contribution with
+blockwise online-softmax (running max / denominator), so the full sequence
+is never resident on any chip.  Causal masking is exact across ring steps:
+global positions decide block-level skip (all-masked), diagonal
+(triangular), or full visibility.  Backward is AD-derived — ppermute
+transposes to the reverse rotation, giving the reverse ring schedule.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ring_body(q, k, v, axis, scale, causal):
+    """Per-device body: q,k,v local [B, S_loc, H, D]."""
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    s_loc = q.shape[1]
+
+    qf = jnp.moveaxis(q, 2, 1).astype(jnp.float32)   # [B, H, S, D]
+    m = jnp.full(qf.shape[:-1] + (1,), NEG_INF, jnp.float32)
+    l = jnp.zeros_like(m)
+    acc = jnp.zeros_like(qf)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    kv = (k, v)
+    for step in range(n):
+        src = (idx - step) % n  # whose K/V block we hold this step
+        kc, vc = kv
+        kf = jnp.moveaxis(kc, 2, 1).astype(jnp.float32)
+        vf = jnp.moveaxis(vc, 2, 1).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+        if causal:
+            q_pos = idx * s_loc + lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 0)
+            k_pos = src * s_loc + lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 1)
+            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+        m = m_new
+        if step + 1 < n:
+            kv = lax.ppermute(kv, axis, perm)
+
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.moveaxis(out, 1, 2).astype(q.dtype)  # [B, S, H, D]
+
+
+def ring_attention_fn(q, k, v, mesh, axis="sep", scale=None, causal=False):
+    """Raw-array ring attention.
+
+    q, k, v: [B, S, H, D] global; S is laid out over ``axis`` (S % axis_size
+    == 0).  Returns [B, S, H, D] with the same layout.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    spec = P(None, axis)
+
+    def body(q_l, k_l, v_l):
+        return _ring_body(q_l, k_l, v_l, axis, scale, causal)
+
+    try:
+        mapped = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                               out_specs=spec, check_vma=False)
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map as sm
+
+        mapped = sm(body, mesh=mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec, check_rep=False)
+    return mapped(q, k, v)

@@ -32,7 +32,7 @@ _bool = bool  # guarded against the paddle-style module-level `bool` dtype alias
 
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "grad", "_grad_node", "_retain_grads",
-                 "name", "persistable", "_master", "__weakref__")
+                 "name", "persistable", "_master", "_grad_hooks", "__weakref__")
 
     # let Tensor.__r*__ win over np.ndarray ops
     __array_priority__ = 100
@@ -169,6 +169,26 @@ class Tensor:
 
     def retain_grads(self):
         self._retain_grads = True
+
+    def register_hook(self, hook):
+        """Run ``hook(grad)`` when this tensor's gradient is computed; a
+        returned Tensor replaces the gradient (reference:
+        Tensor.register_hook via egr grad-node hooks)."""
+        hooks = getattr(self, "_grad_hooks", None)
+        if hooks is None:
+            hooks = []
+            self._grad_hooks = hooks
+        hooks.append(hook)
+
+        class _Removable:
+            def __init__(self, lst, fn):
+                self._lst, self._fn = lst, fn
+
+            def remove(self):
+                if self._fn in self._lst:
+                    self._lst.remove(self._fn)
+
+        return _Removable(hooks, hook)
 
     def detach(self):
         t = Tensor(self._value, stop_gradient=True)
