@@ -1,0 +1,145 @@
+"""Expert parallelism / MoE (reference:
+python/paddle/incubate/distributed/models/moe/ — MoELayer with expert
+placement, all-to-all dispatch/combine, gshard/switch gating and the
+load-balancing aux loss).
+
+TPU-native design: the classic GShard einsum formulation — routing builds
+STATIC-shape dispatch/combine tensors (tokens x experts x capacity), expert
+FFNs are a single vmapped weight stack with the expert dim laid out over
+the mesh's expert axis, and the partitioner materializes the all-to-alls
+from the shardings.  No ragged tensors, no per-expert kernel launches —
+everything is three einsums and one vmapped matmul pair, exactly what the
+MXU wants.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....nn import functional as F  # noqa: F401 (activation lookup)
+from ....nn.layer import Layer
+from ....tensor.dispatch import apply as _apply
+from ....tensor.tensor import Tensor
+from ...topology import get_hybrid_communicate_group
+
+
+def _ep_mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return None, None
+    for ax in ("ep", "sep", "mp", "sharding", "dp"):
+        if ax in hcg.mesh.axis_names and hcg.mesh.shape[ax] > 1:
+            return hcg.mesh, ax
+    return None, None
+
+
+def top2_gating(logits, capacity, dtype=jnp.float32):
+    """GShard top-2 gating: returns (dispatch [G,E,C] bool-ish, combine
+    [G,E,C], aux_loss).  G = tokens, E = experts, C = capacity."""
+    G, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    idx1 = jnp.argmax(probs, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)
+    probs2 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
+
+    # aux load-balance loss (Switch/GShard): E * sum_e fraction_e * prob_e
+    density = mask1.mean(axis=0)
+    density_proxy = probs.mean(axis=0)
+    aux = (density * density_proxy).sum() * (E * E)
+
+    # positions within each expert's buffer, first-come-first-served
+    pos1 = (jnp.cumsum(mask1, axis=0) - 1.0) * mask1
+    mask1 = mask1 * (pos1 < capacity)
+    pos_base = jnp.sum(mask1, axis=0, keepdims=True)
+    pos2 = (jnp.cumsum(mask2, axis=0) - 1.0) * mask2 + pos_base
+    mask2 = mask2 * (pos2 < capacity)
+
+    g1 = (probs * mask1).sum(-1)
+    g2 = (probs * mask2).sum(-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    p1 = (pos1 * mask1).sum(-1)
+    p2 = (pos2 * mask2).sum(-1)
+    disp1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)[:, :, None] * \
+        jax.nn.one_hot(p1.astype(jnp.int32), capacity, dtype=jnp.float32)[:, None, :] * \
+        mask1.sum(-1)[:, None, None]
+    disp2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)[:, :, None] * \
+        jax.nn.one_hot(p2.astype(jnp.int32), capacity, dtype=jnp.float32)[:, None, :] * \
+        mask2.sum(-1)[:, None, None]
+    combine = disp1 * g1[:, None, None] + disp2 * g2[:, None, None]
+    dispatch = (combine > 0.0).astype(dtype)
+    return dispatch, combine.astype(dtype), aux.astype(dtype)
+
+
+class MoELayer(Layer):
+    """Mixture-of-experts FFN block (reference MoELayer).
+
+    Args follow the reference shape: d_model, d_hidden, num_experts, top_k
+    (2 supported), capacity_factor.  ``aux_loss`` holds the last forward's
+    load-balancing loss (add it to the training loss).
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2,
+                 capacity_factor=2.0, act="gelu", gate=None, experts=None,
+                 moe_group=None, **kw):
+        super().__init__()
+        if top_k != 2:
+            raise NotImplementedError("MoELayer implements top-2 (GShard) gating")
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.act_name = act
+        from ....nn import initializer as I
+
+        self.gate_weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=I.XavierUniform())
+        # stacked expert FFNs: [E, d_model, d_hidden], [E, d_hidden, d_model]
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden],
+                                        default_initializer=I.XavierUniform())
+        self.b1 = self.create_parameter([num_experts, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model],
+                                        default_initializer=I.XavierUniform())
+        self.b2 = self.create_parameter([num_experts, d_model], is_bias=True)
+        mesh, ax = _ep_mesh()
+        if mesh is not None and num_experts % mesh.shape[ax] == 0:
+            for p in (self.w1, self.b1, self.w2, self.b2):
+                spec = P(ax, *([None] * (p.ndim - 1)))
+                p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
+        self.aux_loss = None
+
+    def forward(self, x):
+        """x: [B, S, d_model] (or [G, d_model])."""
+        orig_shape = x.shape
+        E = self.num_experts
+        act_name = self.act_name
+        cap_f = self.capacity_factor
+
+        def fn(xv, gw, w1, b1, w2, b2):
+            lead = xv.shape[:-1]
+            d = xv.shape[-1]
+            g = 1
+            for s in lead:
+                g *= s
+            tokens = xv.reshape(g, d)
+            capacity = max(int(cap_f * g * 2 / E), 4)
+            logits = tokens.astype(jnp.float32) @ gw.astype(jnp.float32)
+            dispatch, combine, aux = top2_gating(logits, capacity)
+            # [G,E,C] x [G,d] -> [E,C,d]  (the all-to-all under EP sharding)
+            exp_in = jnp.einsum("gec,gd->ecd", dispatch, tokens.astype(jnp.float32))
+            h = jnp.einsum("ecd,edh->ech", exp_in, w1.astype(jnp.float32)) + \
+                b1[:, None, :].astype(jnp.float32)
+            h = getattr(jax.nn, act_name)(h)
+            out = jnp.einsum("ech,ehd->ecd", h, w2.astype(jnp.float32)) + \
+                b2[:, None, :].astype(jnp.float32)
+            y = jnp.einsum("gec,ecd->gd", combine, out)
+            return y.reshape(xv.shape).astype(xv.dtype), aux
+
+        out, aux = _apply(fn, x, self.gate_weight, self.w1, self.b1, self.w2,
+                          self.b2, op_name="moe", n_outs=None)
+        self.aux_loss = aux
+        return out

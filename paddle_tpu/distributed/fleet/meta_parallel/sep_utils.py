@@ -1,0 +1,78 @@
+"""SEP / Ulysses-style segment parallelism (reference: the ``sep_degree``
+hybrid axis — all-to-all swaps the sequence shard for a head shard around
+attention so each rank holds the FULL sequence for ITS heads; SURVEY.md
+§5.7 item 2).
+
+TPU-native: two spellings.
+- Auto (partitioner) mode: :func:`sep_attention` annotates activations
+  seq-sharded outside attention and head-sharded inside; XLA materializes
+  the two all-to-alls.  Works inside any jit/TrainStep.
+- Manual mode (inside shard_map, axis bound): :func:`alltoall_seq_to_heads`
+  / :func:`alltoall_heads_to_seq` are explicit ``lax.all_to_all`` calls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....nn import functional as F
+from ....tensor.dispatch import apply as _apply
+from ....tensor.tensor import Tensor
+from ...topology import get_hybrid_communicate_group
+
+
+def _sep_mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and "sep" in hcg.mesh.axis_names and hcg.mesh.shape["sep"] > 1:
+        return hcg.mesh
+    return None
+
+
+# --------------------------------------------------------------- manual mode
+def alltoall_seq_to_heads(x, axis="sep"):
+    """[B, S/n, H, D] per rank -> [B, S, H/n, D]: gather sequence, scatter
+    heads (the Ulysses pre-attention all-to-all)."""
+    def fn(v):
+        return lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    return _apply(fn, x, op_name="sep_alltoall") if isinstance(x, Tensor) else fn(x)
+
+
+def alltoall_heads_to_seq(x, axis="sep"):
+    """[B, S, H/n, D] per rank -> [B, S/n, H, D] (post-attention)."""
+    def fn(v):
+        return lax.all_to_all(v, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    return _apply(fn, x, op_name="sep_alltoall") if isinstance(x, Tensor) else fn(x)
+
+
+# ----------------------------------------------------------------- auto mode
+def sep_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+                  training=True, mesh=None):
+    """Attention with Ulysses sequence parallelism via shardings.
+
+    Inputs [B, S, H, D] seq-sharded over 'sep'; inside, activations are
+    constrained head-sharded with the full sequence per rank — the
+    partitioner emits all-to-all on entry and exit.
+    """
+    mesh = mesh if mesh is not None else _sep_mesh()
+    if mesh is None:
+        return F.scaled_dot_product_attention(q, k, v, attn_mask, dropout_p,
+                                              is_causal, training)
+
+    heads_spec = NamedSharding(mesh, P(None, None, "sep", None))
+    seq_spec = NamedSharding(mesh, P(None, "sep", None, None))
+
+    def constrain(t, sh):
+        return _apply(lambda v: jax.lax.with_sharding_constraint(v, sh), t,
+                      op_name="sep_constraint")
+
+    q2 = constrain(q, heads_spec)
+    k2 = constrain(k, heads_spec)
+    v2 = constrain(v, heads_spec)
+    out = F.scaled_dot_product_attention(q2, k2, v2, attn_mask, dropout_p,
+                                         is_causal, training)
+    return constrain(out, seq_spec)

@@ -4,6 +4,7 @@ row: "fused attention/ffn become Pallas kernels") and softmax_mask_fuse.
 """
 
 from . import nn  # noqa: F401
+from . import distributed  # noqa: F401
 from .nn import functional  # noqa: F401
 
 

@@ -1,0 +1,3 @@
+"""reference namespace parity: paddle.incubate.distributed.models.moe."""
+
+from ....distributed.fleet.meta_parallel.moe import MoELayer, top2_gating  # noqa: F401

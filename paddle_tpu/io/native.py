@@ -1,0 +1,132 @@
+"""ctypes bridge to the C++ input-pipeline kernels (native/paddle_tpu_native.cc).
+
+Reference analog: the reference's C++ DataLoader workers and data ops — the
+parts of the runtime that must not run under the Python GIL.  The library
+builds on first use with g++ (cached under ~/.cache/paddle_tpu); every
+entry point has a numpy fallback so the package works without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB = None
+_TRIED = False
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native", "paddle_tpu_native.cc")
+_CACHE = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu")
+
+
+def _build():
+    os.makedirs(_CACHE, exist_ok=True)
+    so = os.path.join(_CACHE, "paddle_tpu_native.so")
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(_SRC):
+        return so
+    # pid-suffixed temp: concurrent first-use compiles (multi-process launch)
+    # must not truncate each other; os.replace makes the install atomic
+    tmp = f"{so}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so)
+    return so
+
+
+def _lib():
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        try:
+            lib = ctypes.CDLL(_build())
+            lib.pt_normalize_chw.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int]
+            lib.pt_crop_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+            lib.pt_collate_f32.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+                ctypes.c_int64, ctypes.c_int]
+            lib.pt_version.restype = ctypes.c_int
+            assert lib.pt_version() == 1
+            _LIB = lib
+        except Exception:
+            _LIB = None
+        return _LIB
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def normalize_chw(images, mean, std, flips=None, num_threads=0):
+    """uint8 [N,H,W,C] -> float32 [N,C,H,W], (x-mean)/std, optional per-image
+    horizontal flip.  C++ threaded when available, numpy otherwise."""
+    images = np.ascontiguousarray(images, dtype=np.uint8)
+    n, h, w, c = images.shape
+    mean = np.ascontiguousarray(mean, dtype=np.float32)
+    std = np.ascontiguousarray(std, dtype=np.float32)
+    lib = _lib()
+    if lib is not None:
+        out = np.empty((n, c, h, w), dtype=np.float32)
+        fl = None
+        if flips is not None:
+            fl = np.ascontiguousarray(flips, dtype=np.uint8)
+        lib.pt_normalize_chw(
+            images.ctypes.data, out.ctypes.data, n, h, w, c,
+            mean.ctypes.data, std.ctypes.data,
+            fl.ctypes.data if fl is not None else None, int(num_threads))
+        return out
+    # numpy fallback
+    x = images.astype(np.float32)
+    if flips is not None:
+        fl = np.asarray(flips, bool)
+        x[fl] = x[fl, :, ::-1]
+    x = (x - mean.reshape(1, 1, 1, c)) / std.reshape(1, 1, 1, c)
+    return np.ascontiguousarray(x.transpose(0, 3, 1, 2))
+
+
+def collate_f32(samples, num_threads=0):
+    """Stack equally-shaped float32 sample arrays into one batch (threaded
+    memcpy in C++; numpy stack otherwise) — the default_collate hot path."""
+    samples = [np.ascontiguousarray(s, dtype=np.float32) for s in samples]
+    n = len(samples)
+    if n == 0:
+        return np.empty((0,), np.float32)
+    shape = samples[0].shape
+    lib = _lib()
+    if lib is None:
+        return np.stack(samples)
+    out = np.empty((n,) + shape, np.float32)
+    ptrs = (ctypes.c_void_p * n)(*[s.ctypes.data for s in samples])
+    lib.pt_collate_f32(ptrs, out.ctypes.data, n,
+                       int(np.prod(shape)) if shape else 1, int(num_threads))
+    return out
+
+
+def crop_batch(images, ys, xs, oh, ow, num_threads=0):
+    """uint8 [N,H,W,C] -> uint8 [N,oh,ow,C] crops at per-image offsets."""
+    images = np.ascontiguousarray(images, dtype=np.uint8)
+    n, H, W, c = images.shape
+    ys = np.ascontiguousarray(ys, dtype=np.int32)
+    xs = np.ascontiguousarray(xs, dtype=np.int32)
+    lib = _lib()
+    if lib is not None:
+        out = np.empty((n, oh, ow, c), dtype=np.uint8)
+        lib.pt_crop_batch(images.ctypes.data, out.ctypes.data, n, H, W, c,
+                          oh, ow, ys.ctypes.data, xs.ctypes.data,
+                          int(num_threads))
+        return out
+    return np.stack([images[i, ys[i]:ys[i] + oh, xs[i]:xs[i] + ow]
+                     for i in range(n)])

@@ -22,4 +22,8 @@ from .densenet import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201, densenet264,
 )
 
+from .detection import (  # noqa: F401
+    YOLOv3, FasterRCNN, ResNetBackbone, FPN, yolov3, ppyoloe, faster_rcnn,
+)
+
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,435 @@
+"""Detection models (baseline config #3 — reference analog: PaddleDetection's
+PP-YOLOE / Faster-RCNN, the hardest model-zoo item per SURVEY.md §2.3:
+dynamic shapes everywhere in the CUDA reference).
+
+TPU-first design: every tensor in the train path is STATIC-shape —
+anchor-free YOLO-style dense head (one box+score per location, like
+PP-YOLOE's ATSS-free variant), top-k proposal selection instead of
+thresholded gathers, padded NMS (vision.ops.nms_padded) only at eval.
+Faster-RCNN follows the same discipline: RPN scores every anchor, takes a
+FIXED number of proposals via top-k, RoIAlign runs on the padded proposal
+set, invalid rois masked in the loss.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from ...nn import functional as F
+from ...tensor.dispatch import apply as _apply
+from ...tensor.tensor import Tensor
+from .. import ops as vops
+from .resnet import resnet50, resnet18
+
+
+class ResNetBackbone(nn.Layer):
+    """C3/C4/C5 feature pyramid taps off a torchvision-style resnet."""
+
+    def __init__(self, depth=50):
+        super().__init__()
+        net = resnet50(num_classes=0, with_pool=False) if depth == 50 else \
+            resnet18(num_classes=0, with_pool=False)
+        self.stem = nn.Sequential(net.conv1, net.bn1, net.relu, net.maxpool)
+        self.layer1, self.layer2 = net.layer1, net.layer2
+        self.layer3, self.layer4 = net.layer3, net.layer4
+        self.out_channels = [512, 1024, 2048] if depth == 50 else [128, 256, 512]
+
+    def forward(self, x):
+        x = self.stem(x)
+        c2 = self.layer1(x)
+        c3 = self.layer2(c2)
+        c4 = self.layer3(c3)
+        c5 = self.layer4(c4)
+        return c3, c4, c5
+
+
+class FPN(nn.Layer):
+    """Top-down feature pyramid (reference: ppdet FPN)."""
+
+    def __init__(self, in_channels, out_channel=256):
+        super().__init__()
+        self.lateral = nn.LayerList([nn.Conv2D(c, out_channel, 1)
+                                     for c in in_channels])
+        self.output = nn.LayerList([nn.Conv2D(out_channel, out_channel, 3, padding=1)
+                                    for _ in in_channels])
+        self.out_channel = out_channel
+
+    def forward(self, feats):
+        lat = [l(f) for l, f in zip(self.lateral, feats)]
+        for i in range(len(lat) - 2, -1, -1):
+            up = F.interpolate(lat[i + 1], scale_factor=2, mode="nearest")
+            lat[i] = lat[i] + up
+        return [o(l) for o, l in zip(self.output, lat)]
+
+
+class YOLOHead(nn.Layer):
+    """Anchor-free dense head: per level, per location -> (cls C, obj 1,
+    ltrb 4) — PP-YOLOE-style decoupled branches."""
+
+    def __init__(self, num_classes, in_channel=256):
+        super().__init__()
+        self.num_classes = num_classes
+        self.cls_conv = nn.Sequential(
+            nn.Conv2D(in_channel, in_channel, 3, padding=1), nn.Silu())
+        self.reg_conv = nn.Sequential(
+            nn.Conv2D(in_channel, in_channel, 3, padding=1), nn.Silu())
+        self.cls_pred = nn.Conv2D(in_channel, num_classes, 1)
+        self.obj_pred = nn.Conv2D(in_channel, 1, 1)
+        self.reg_pred = nn.Conv2D(in_channel, 4, 1)
+
+    def forward(self, feats):
+        outs = []
+        for f in feats:
+            c = self.cls_conv(f)
+            r = self.reg_conv(f)
+            outs.append((self.cls_pred(c), self.obj_pred(r), self.reg_pred(r)))
+        return outs
+
+
+def _grid_centers(h, w, stride):
+    ys = (jnp.arange(h, dtype=jnp.float32) + 0.5) * stride
+    xs = (jnp.arange(w, dtype=jnp.float32) + 0.5) * stride
+    cy, cx = jnp.meshgrid(ys, xs, indexing="ij")
+    return jnp.stack([cx.reshape(-1), cy.reshape(-1)], axis=-1)  # [HW, 2]
+
+
+def _decode_ltrb(centers, reg, stride):
+    """reg (l,t,r,b distances in stride units, softplus>=0) -> xyxy.
+    centers broadcast against reg's batch dims ([1,HW,2] vs [B,HW,4])."""
+    d = jax.nn.softplus(reg) * stride
+    x1 = centers[..., 0] - d[..., 0]
+    y1 = centers[..., 1] - d[..., 1]
+    x2 = centers[..., 0] + d[..., 2]
+    y2 = centers[..., 1] + d[..., 3]
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+class YOLOv3(nn.Layer):
+    """Anchor-free single-stage detector, PP-YOLOE-shaped API.
+
+    Train: ``model(img, gt_boxes, gt_labels)`` -> loss dict.  gt padded to a
+    fixed ``max_boxes`` with label -1 (static shapes).
+    Eval: ``model(img)`` -> list per image of (boxes [K,4], scores [K],
+    labels [K], valid [K]) via padded NMS.
+    """
+
+    strides = (8, 16, 32)
+
+    def __init__(self, num_classes=80, backbone=None, depth=50, max_boxes=50,
+                 score_thresh=0.05, nms_thresh=0.6, top_k=100):
+        super().__init__()
+        self.backbone = backbone or ResNetBackbone(depth)
+        self.neck = FPN(self.backbone.out_channels)
+        self.head = YOLOHead(num_classes, self.neck.out_channel)
+        self.num_classes = num_classes
+        self.max_boxes = max_boxes
+        self.score_thresh = score_thresh
+        self.nms_thresh = nms_thresh
+        self.top_k = top_k
+
+    def _dense_predictions(self, img):
+        feats = self.neck(self.backbone(img))
+        outs = self.head(feats)
+        all_cls, all_obj, all_box, all_ctr, all_str = [], [], [], [], []
+        for (cls, obj, reg), stride in zip(outs, self.strides):
+            B, C, H, W = cls.shape
+            centers = _grid_centers(H, W, float(stride))
+
+            def flat(t):
+                return t.transpose([0, 2, 3, 1]).reshape([B, H * W, -1])
+
+            all_cls.append(flat(cls))
+            all_obj.append(flat(obj))
+            reg_f = flat(reg)
+            box = _apply(lambda r, c=centers, s=float(stride):
+                         _decode_ltrb(c[None], r, s), reg_f, op_name="decode_box")
+            all_box.append(box)
+            all_ctr.append(centers)
+            all_str.append(jnp.full((H * W,), float(stride)))
+        from ...tensor import manipulation as M
+
+        cls = M.concat(all_cls, axis=1)     # [B, N, C]
+        obj = M.concat(all_obj, axis=1)     # [B, N, 1]
+        box = M.concat(all_box, axis=1)     # [B, N, 4]
+        centers = jnp.concatenate(all_ctr, axis=0)
+        strides = jnp.concatenate(all_str, axis=0)
+        return cls, obj, box, centers, strides
+
+    def forward(self, img, gt_boxes=None, gt_labels=None):
+        cls, obj, box, centers, strides = self._dense_predictions(img)
+        if gt_boxes is not None:
+            return self._loss(cls, obj, box, centers, strides, gt_boxes, gt_labels)
+        return self._postprocess(cls, obj, box)
+
+    # ----------------------------------------------------------- training
+    def _loss(self, cls, obj, box, centers, strides, gt_boxes, gt_labels):
+        """Center-inside assignment (FCOS-style, static shapes): a location
+        is positive for the smallest gt box containing it."""
+        C = self.num_classes
+
+        def fn(cls, obj, box, gtb, gtl):
+            B, N = cls.shape[0], cls.shape[1]
+            M_ = gtb.shape[1]
+            cx, cy = centers[:, 0], centers[:, 1]
+            x1, y1, x2, y2 = gtb[..., 0], gtb[..., 1], gtb[..., 2], gtb[..., 3]
+            valid_gt = (gtl >= 0)
+            inside = ((cx[None, :, None] >= x1[:, None]) &
+                      (cx[None, :, None] <= x2[:, None]) &
+                      (cy[None, :, None] >= y1[:, None]) &
+                      (cy[None, :, None] <= y2[:, None]) &
+                      valid_gt[:, None, :])                     # [B,N,M]
+            area = jnp.maximum((x2 - x1) * (y2 - y1), 1.0)
+            area_big = jnp.where(valid_gt, area, 1e18)[:, None, :] * \
+                jnp.where(inside, 1.0, 1e9)
+            match = jnp.argmin(area_big, axis=-1)               # [B,N]
+            pos = inside.any(axis=-1)                           # [B,N]
+
+            tgt_label = jnp.take_along_axis(gtl, match, axis=1)
+            tgt_box = jnp.take_along_axis(gtb, match[..., None], axis=1)
+
+            # objectness: BCE on all locations
+            obj_t = pos.astype(jnp.float32)
+            obj_p = obj[..., 0]
+            l_obj = _bce_logits(obj_p, obj_t).mean()
+
+            # class: BCE on positives
+            onehot = jax.nn.one_hot(jnp.clip(tgt_label, 0, C - 1), C)
+            l_cls = (_bce_logits(cls, onehot).sum(-1) * obj_t).sum() / \
+                jnp.maximum(obj_t.sum(), 1.0)
+
+            # box: IoU loss on positives
+            iou = _pairwise_iou(box, tgt_box)
+            l_box = ((1.0 - iou) * obj_t).sum() / jnp.maximum(obj_t.sum(), 1.0)
+            return l_obj, l_cls, l_box
+
+        l_obj, l_cls, l_box = _apply(fn, cls, obj, box, gt_boxes, gt_labels,
+                                     op_name="yolo_loss", n_outs=None)
+        total = l_obj + l_cls + 2.0 * l_box
+        return {"loss": total, "loss_obj": l_obj, "loss_cls": l_cls,
+                "loss_box": l_box}
+
+    # ---------------------------------------------------------- inference
+    def _postprocess(self, cls, obj, box):
+        import numpy as np
+
+        B = cls.shape[0]
+        results = []
+        for b in range(B):
+            scores = (F.sigmoid(cls[b]) * F.sigmoid(obj[b]))  # [N, C]
+            best = scores.max(axis=-1)
+            label = scores.argmax(axis=-1)
+            idx, valid = vops.nms_padded(box[b], best, self.nms_thresh,
+                                         top_k=self.top_k, category_idxs=label)
+            iv = np.asarray(idx.numpy())
+            vv = np.asarray(valid.numpy())
+            sc = best.numpy()[np.maximum(iv, 0)]
+            keep = vv & (sc > self.score_thresh)
+            results.append({
+                "boxes": Tensor(box[b].numpy()[np.maximum(iv, 0)]),
+                "scores": Tensor(sc),
+                "labels": Tensor(label.numpy()[np.maximum(iv, 0)]),
+                "valid": Tensor(keep),
+            })
+        return results
+
+
+def _bce_logits(logits, targets):
+    return jnp.maximum(logits, 0) - logits * targets + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+
+
+def _pairwise_iou(a, b):
+    """Elementwise IoU of aligned box tensors [..., 4] (xyxy)."""
+    ix1 = jnp.maximum(a[..., 0], b[..., 0])
+    iy1 = jnp.maximum(a[..., 1], b[..., 1])
+    ix2 = jnp.minimum(a[..., 2], b[..., 2])
+    iy2 = jnp.minimum(a[..., 3], b[..., 3])
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    area_a = jnp.clip(a[..., 2] - a[..., 0], 0) * jnp.clip(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.clip(b[..., 2] - b[..., 0], 0) * jnp.clip(b[..., 3] - b[..., 1], 0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-9)
+
+
+# ======================================================================= RCNN
+class RPNHead(nn.Layer):
+    """Region proposal network over FPN levels; proposals = top-k scored
+    anchor-free centers decoded ltrb (static count, padded)."""
+
+    def __init__(self, in_channel=256, num_proposals=128):
+        super().__init__()
+        self.conv = nn.Sequential(nn.Conv2D(in_channel, in_channel, 3, padding=1),
+                                  nn.ReLU())
+        self.obj = nn.Conv2D(in_channel, 1, 1)
+        self.reg = nn.Conv2D(in_channel, 4, 1)
+        self.num_proposals = num_proposals
+
+    def forward(self, feats, strides=(8, 16, 32)):
+        objs, boxes = [], []
+        for f, stride in zip(feats, strides):
+            B, _, H, W = f.shape
+            h = self.conv(f)
+            o = self.obj(h).transpose([0, 2, 3, 1]).reshape([B, H * W])
+            r = self.reg(h).transpose([0, 2, 3, 1]).reshape([B, H * W, 4])
+            centers = _grid_centers(H, W, float(stride))
+            bx = _apply(lambda rv, c=centers, s=float(stride):
+                        _decode_ltrb(c[None], rv, s), r, op_name="decode_box")
+            objs.append(o)
+            boxes.append(bx)
+        from ...tensor import manipulation as M
+
+        obj = M.concat(objs, axis=1)
+        box = M.concat(boxes, axis=1)
+
+        def topk(ov, bv):
+            k = self.num_proposals
+            idx = jnp.argsort(-ov, axis=1)[:, :k]
+            sel = jnp.take_along_axis(bv, idx[..., None], axis=1)
+            sc = jnp.take_along_axis(ov, idx, axis=1)
+            return sel, sc
+
+        proposals, scores = _apply(topk, obj, box, op_name="rpn_topk", n_outs=None)
+        return proposals, scores, obj, box
+
+
+class FasterRCNN(nn.Layer):
+    """Two-stage detector with static-shape proposals (reference:
+    PaddleDetection FasterRCNN; RoIAlign over padded top-k RPN proposals).
+
+    Train: ``model(img, gt_boxes, gt_labels)`` -> loss dict (RPN objectness
+    + RoI head cls/reg, IoU-matched targets over the padded proposal set).
+    Eval: ``model(img)`` -> per-image padded detections like YOLOv3.
+    """
+
+    def __init__(self, num_classes=80, depth=50, num_proposals=128,
+                 roi_resolution=7, nms_thresh=0.5, top_k=100, score_thresh=0.05):
+        super().__init__()
+        self.backbone = ResNetBackbone(depth)
+        self.neck = FPN(self.backbone.out_channels)
+        self.rpn = RPNHead(self.neck.out_channel, num_proposals)
+        ch = self.neck.out_channel
+        self.roi_head = nn.Sequential(
+            nn.Linear(ch * roi_resolution * roi_resolution, 1024), nn.ReLU(),
+            nn.Linear(1024, 1024), nn.ReLU())
+        self.cls_score = nn.Linear(1024, num_classes + 1)  # +1 background
+        self.bbox_delta = nn.Linear(1024, 4)
+        self.num_classes = num_classes
+        self.roi_resolution = roi_resolution
+        self.nms_thresh = nms_thresh
+        self.top_k = top_k
+        self.score_thresh = score_thresh
+
+    def _roi_features(self, feats, proposals):
+        """RoIAlign on the stride-8 level (single-level assign keeps shapes
+        static); proposals [B, K, 4]."""
+        B, K = proposals.shape[0], proposals.shape[1]
+        from ...tensor import manipulation as M
+
+        rois = proposals.reshape([B * K, 4])
+        boxes_num = Tensor(jnp.full((B,), K, jnp.int32))
+        pooled = vops.roi_align(feats[0], rois, boxes_num,
+                                output_size=self.roi_resolution,
+                                spatial_scale=1.0 / 8.0)
+        return pooled.reshape([B, K, -1])
+
+    def forward(self, img, gt_boxes=None, gt_labels=None):
+        feats = self.neck(self.backbone(img))
+        proposals, rpn_scores, rpn_obj_all, rpn_box_all = self.rpn(feats)
+        roi_feat = self._roi_features(feats, proposals)
+        h = self.roi_head(roi_feat)
+        cls_logits = self.cls_score(h)            # [B, K, C+1]
+        deltas = self.bbox_delta(h)               # [B, K, 4]
+        boxes = _apply(lambda p, d: p + d * 16.0, proposals, deltas,
+                       op_name="apply_deltas")
+        if gt_boxes is not None:
+            return self._loss(rpn_obj_all, rpn_box_all, cls_logits, boxes,
+                              proposals, gt_boxes, gt_labels)
+        return self._postprocess(cls_logits, boxes)
+
+    def _loss(self, rpn_obj, rpn_box, cls_logits, boxes, proposals,
+              gt_boxes, gt_labels):
+        C = self.num_classes
+
+        def fn(rpn_obj, rpn_box, cls_logits, boxes, proposals, gtb, gtl):
+            valid_gt = (gtl >= 0)
+            # RPN: IoU-matched objectness over the dense set
+            iou_dense = _iou_matrix(rpn_box, gtb, valid_gt)      # [B,N,M]
+            best_dense = iou_dense.max(axis=-1)
+            rpn_t = (best_dense > 0.5).astype(jnp.float32)
+            l_rpn = _bce_logits(rpn_obj, rpn_t).mean()
+
+            # RoI head: match proposals to gt
+            iou_p = _iou_matrix(proposals, gtb, valid_gt)        # [B,K,M]
+            best = iou_p.max(axis=-1)
+            match = iou_p.argmax(axis=-1)
+            fg = best > 0.5
+            tgt_label = jnp.where(fg, jnp.take_along_axis(gtl, match, axis=1), C)
+            l_cls = _softmax_ce(cls_logits, jnp.clip(tgt_label, 0, C)).mean()
+            tgt_box = jnp.take_along_axis(gtb, match[..., None], axis=1)
+            iou_ref = _pairwise_iou(boxes, tgt_box)
+            l_box = ((1 - iou_ref) * fg).sum() / jnp.maximum(fg.sum(), 1.0)
+            return l_rpn, l_cls, l_box
+
+        l_rpn, l_cls, l_box = _apply(fn, rpn_obj, rpn_box, cls_logits, boxes,
+                                     proposals, gt_boxes, gt_labels,
+                                     op_name="rcnn_loss", n_outs=None)
+        total = l_rpn + l_cls + 2.0 * l_box
+        return {"loss": total, "loss_rpn": l_rpn, "loss_cls": l_cls,
+                "loss_box": l_box}
+
+    def _postprocess(self, cls_logits, boxes):
+        import numpy as np
+
+        B = cls_logits.shape[0]
+        out = []
+        for b in range(B):
+            probs = F.softmax(cls_logits[b], axis=-1)
+            fg = probs[:, :self.num_classes]
+            best = fg.max(axis=-1)
+            label = fg.argmax(axis=-1)
+            idx, valid = vops.nms_padded(boxes[b], best, self.nms_thresh,
+                                         top_k=self.top_k, category_idxs=label)
+            iv = np.maximum(np.asarray(idx.numpy()), 0)
+            keep = np.asarray(valid.numpy()) & (best.numpy()[iv] > self.score_thresh)
+            out.append({"boxes": Tensor(boxes[b].numpy()[iv]),
+                        "scores": Tensor(best.numpy()[iv]),
+                        "labels": Tensor(label.numpy()[iv]),
+                        "valid": Tensor(keep)})
+        return out
+
+
+def _iou_matrix(boxes, gt, valid_gt):
+    """[B,N,4] x [B,M,4] -> [B,N,M] IoU with invalid gt zeroed."""
+    a = boxes[:, :, None, :]
+    b = gt[:, None, :, :]
+    ix1 = jnp.maximum(a[..., 0], b[..., 0])
+    iy1 = jnp.maximum(a[..., 1], b[..., 1])
+    ix2 = jnp.minimum(a[..., 2], b[..., 2])
+    iy2 = jnp.minimum(a[..., 3], b[..., 3])
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    area_a = jnp.clip(a[..., 2] - a[..., 0], 0) * jnp.clip(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.clip(b[..., 2] - b[..., 0], 0) * jnp.clip(b[..., 3] - b[..., 1], 0)
+    iou = inter / jnp.maximum(area_a + area_b - inter, 1e-9)
+    return jnp.where(valid_gt[:, None, :], iou, 0.0)
+
+
+def _softmax_ce(logits, labels):
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return lse - picked
+
+
+def yolov3(num_classes=80, **kwargs):
+    return YOLOv3(num_classes=num_classes, **kwargs)
+
+
+def ppyoloe(num_classes=80, **kwargs):
+    """PP-YOLOE-shaped constructor (anchor-free decoupled head)."""
+    return YOLOv3(num_classes=num_classes, **kwargs)
+
+
+def faster_rcnn(num_classes=80, **kwargs):
+    return FasterRCNN(num_classes=num_classes, **kwargs)

@@ -161,32 +161,103 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=No
     returns ``top_k`` (default: all boxes) padded with -1; the eager wrapper
     strips the padding so user-facing behavior matches the reference.
     """
-    bv, sv = _v(boxes), None
+    bv = _v(boxes)
     n = bv.shape[0]
     if scores is None:
-        sv = jnp.arange(n, 0, -1, dtype=jnp.float32)  # keep input order
+        sv = Tensor(jnp.arange(n, 0, -1, dtype=jnp.float32))  # keep input order
     else:
-        sv = _v(scores).astype(jnp.float32)
-    max_out = int(top_k) if top_k is not None else n
-
-    if category_idxs is not None:
-        # category-aware: offset boxes per category so cross-class boxes never overlap
-        cv = _v(category_idxs)
-        offs = (cv.astype(jnp.float32) * (bv.max() + 1.0))[:, None]
-        bv = bv + offs
-
-    idx, valid = _nms_impl(bv, sv, iou_threshold, max_out)
+        sv = scores
+    idx, valid = nms_padded(boxes, sv, iou_threshold, top_k, category_idxs)
     import numpy as np
 
-    idx = np.asarray(idx)[np.asarray(valid)]
-    return Tensor(jnp.asarray(idx, dtype=jnp.int64))
+    out = np.asarray(_v(idx))[np.asarray(_v(valid))]
+    return Tensor(jnp.asarray(out, dtype=jnp.int64))
+
+
+def nms_padded(boxes, scores, iou_threshold=0.3, top_k=None, category_idxs=None):
+    """Static-shape NMS for traced callers (the jit-friendly core the eager
+    :func:`nms` wraps): returns ``(indices [top_k], valid [top_k])`` with -1
+    padding — usable inside to_static/TrainStep/detection heads."""
+    bv, sv = _v(boxes), _v(scores).astype(jnp.float32)
+    n = bv.shape[0]
+    max_out = int(top_k) if top_k is not None else n
+    if category_idxs is not None:
+        # per-category coordinate shift so cross-class boxes never overlap;
+        # span must cover negative coordinates too
+        cv = _v(category_idxs)
+        span = bv.max() - bv.min() + 1.0
+        offs = (cv.astype(jnp.float32) * span)[:, None]
+        bv = (bv - bv.min()) + offs
+
+    def fn(bv, sv):
+        return _nms_impl(bv, sv, iou_threshold, max_out)
+
+    from ..tensor.dispatch import apply as _apply
+
+    idx, valid = _apply(fn, Tensor(bv), Tensor(sv), op_name="nms_padded",
+                        n_outs=None)
+    return idx, valid
 
 
 def matrix_nms(bboxes, scores, score_threshold, post_threshold=0., nms_top_k=400,
-               keep_top_k=200, use_gaussian=False, gaussian_sigma=2., background_label=0,
-               normalized=True, return_index=False, return_rois_num=True, name=None):
-    raise NotImplementedError("matrix_nms: use vision.ops.nms per class; "
-                              "full matrix_nms lands with the detection zoo")
+               keep_top_k=200, use_gaussian=False, gaussian_sigma=2.,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2): scores decay by overlap instead of hard
+    suppression — one IoU matrix, no sequential loop; the TPU-friendly NMS
+    variant.  bboxes [N,4] (single image), scores [C,N].
+
+    SOLOv2 decay for candidate j: min over higher-scored i of
+    f(iou_ij) / f(comp_i), comp_i = i's own max overlap with anything above
+    it; f = (1-x) linear or exp(-x^2/sigma) gaussian.
+    """
+    import numpy as _np
+
+    bv = _v(bboxes)
+    sv = _v(scores)
+    C, n = sv.shape
+
+    def per_class(sc):
+        order = jnp.argsort(-sc)[:nms_top_k]
+        b = bv[order]
+        s = sc[order]
+        iou = jnp.asarray(_v(box_iou(Tensor(b), Tensor(b))))
+        m = iou.shape[0]
+        upper = jnp.triu(iou, k=1)              # [i,j] valid for i < j
+        comp = upper.max(axis=0)                # comp_i: overlap with above-i
+        pair_mask = jnp.triu(jnp.ones((m, m), bool), k=1)
+        if use_gaussian:
+            ratio = jnp.exp(-(upper ** 2 - comp[:, None] ** 2) / gaussian_sigma)
+        else:
+            ratio = (1 - upper) / jnp.maximum(1 - comp[:, None], 1e-9)
+        ratio = jnp.where(pair_mask, ratio, 1.0)
+        decay = jnp.minimum(ratio.min(axis=0), 1.0)
+        return s * decay, order
+
+    outs = []
+    for c in range(C):
+        if c == background_label:
+            continue
+        s_dec, order = per_class(sv[c])
+        m = min(nms_top_k, n)
+        cls_col = jnp.full((m, 1), float(c))
+        outs.append(jnp.concatenate(
+            [cls_col, s_dec[:m, None], bv[order[:m]]], axis=1))
+    if not outs:
+        empty = Tensor(jnp.zeros((0, 6), jnp.float32))
+        return (empty, Tensor(jnp.zeros((1,), jnp.int32))) if return_rois_num \
+            else empty
+    all_out = jnp.concatenate(outs, axis=0)
+    sel = jnp.argsort(-all_out[:, 1])[:keep_top_k]
+    out = all_out[sel]
+    # eager strip: reference filters by score_threshold (and post_threshold)
+    thresh = max(float(score_threshold), float(post_threshold))
+    keep = _np.asarray(out[:, 1]) > thresh
+    out = out[_np.nonzero(keep)[0]]
+    res = Tensor(out)
+    if return_rois_num:
+        return res, Tensor(jnp.asarray([out.shape[0]], jnp.int32))
+    return res
 
 
 # --------------------------------------------------------------- yolo / boxes

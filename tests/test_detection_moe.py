@@ -1,0 +1,234 @@
+"""Detection zoo (YOLO/FasterRCNN, static shapes), MoE, SEP utils, padded
+NMS, native C++ pipeline kernels."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def _gt():
+    gtb = np.zeros((2, 5, 4), dtype="float32")
+    gtl = np.full((2, 5), -1, dtype="int64")
+    gtb[0, 0] = [10, 10, 60, 60]
+    gtl[0, 0] = 3
+    gtb[1, 0] = [30, 40, 100, 110]
+    gtl[1, 0] = 1
+    return paddle.to_tensor(gtb), paddle.to_tensor(gtl)
+
+
+def test_yolo_trains_and_evals():
+    from paddle_tpu.vision.models import yolov3
+
+    rng = np.random.RandomState(0)
+    img = paddle.to_tensor(rng.randn(2, 3, 128, 128).astype("float32"))
+    gt_boxes, gt_labels = _gt()
+    paddle.seed(0)
+    m = yolov3(num_classes=5, depth=18)
+    o = opt.Adam(learning_rate=1e-4, parameters=m.parameters())
+    l0 = None
+    for _ in range(3):
+        out = m(img, gt_boxes, gt_labels)
+        out["loss"].backward()
+        o.step()
+        o.clear_grad()
+        l0 = l0 if l0 is not None else float(out["loss"])
+    assert float(out["loss"]) < l0
+    m.eval()
+    dets = m(img)
+    assert len(dets) == 2
+    assert dets[0]["boxes"].shape[1] == 4
+    assert dets[0]["valid"].numpy().dtype == bool
+
+
+def test_faster_rcnn_trains_and_evals():
+    from paddle_tpu.vision.models import faster_rcnn
+
+    rng = np.random.RandomState(1)
+    img = paddle.to_tensor(rng.randn(2, 3, 128, 128).astype("float32"))
+    gt_boxes, gt_labels = _gt()
+    paddle.seed(1)
+    m = faster_rcnn(num_classes=5, depth=18, num_proposals=32)
+    o = opt.Adam(learning_rate=1e-4, parameters=m.parameters())
+    l0 = None
+    for _ in range(3):
+        out = m(img, gt_boxes, gt_labels)
+        out["loss"].backward()
+        o.step()
+        o.clear_grad()
+        l0 = l0 if l0 is not None else float(out["loss"])
+    assert float(out["loss"]) < l0
+    m.eval()
+    dets = m(img)
+    assert len(dets) == 2
+
+
+def test_nms_padded_traceable():
+    from paddle_tpu.vision import ops as vops
+
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]], dtype="float32"))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], dtype="float32"))
+
+    @paddle.jit.to_static
+    def run(b, s):
+        idx, valid = vops.nms_padded(b, s, iou_threshold=0.5, top_k=3)
+        return idx, valid
+
+    idx, valid = run(boxes, scores)
+    iv, vv = idx.numpy(), valid.numpy()
+    kept = set(iv[vv].tolist())
+    assert kept == {0, 2}
+
+
+def test_matrix_nms_decays_overlaps():
+    from paddle_tpu.vision import ops as vops
+
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5], [50, 50, 60, 60]],
+        dtype="float32"))
+    scores = paddle.to_tensor(np.array(
+        [[0.9, 0.85, 0.7]], dtype="float32"))  # one class (background=-1)
+    out, num = vops.matrix_nms(boxes, scores, score_threshold=0.1,
+                               keep_top_k=3, background_label=-1)
+    a = out.numpy()
+    assert a.shape[1] == 6  # [label, score, x1, y1, x2, y2]
+    by_score = {tuple(r[2:4]): r[1] for r in a}
+    assert by_score[(0.0, 0.0)] == pytest.approx(0.9, abs=1e-5)
+    # heavily-overlapping second box MUST decay well below its raw 0.85
+    assert by_score[(0.5, 0.5)] < 0.5
+    # isolated third box keeps its score
+    assert by_score[(50.0, 50.0)] == pytest.approx(0.7, abs=1e-5)
+    # background_label=0 with a single class yields an empty result, not a crash
+    empty, n0 = vops.matrix_nms(boxes, scores, score_threshold=0.1,
+                                background_label=0)
+    assert empty.shape[0] == 0 and int(n0.numpy()[0]) == 0
+
+
+def test_nms_padded_negative_coords_classes():
+    from paddle_tpu.vision import ops as vops
+
+    # two DIFFERENT classes, overlapping coords incl. negatives: no
+    # cross-class suppression allowed
+    boxes = paddle.to_tensor(np.array(
+        [[-5, -5, 10, 10], [-5, -5, 10, 10]], dtype="float32"))
+    scores = paddle.to_tensor(np.array([0.9, 0.8], dtype="float32"))
+    cats = paddle.to_tensor(np.array([0, 1], dtype="int64"))
+    idx, valid = vops.nms_padded(boxes, scores, 0.5, top_k=2,
+                                 category_idxs=cats)
+    assert valid.numpy().sum() == 2
+
+
+def test_native_collate():
+    from paddle_tpu.io import native
+
+    rng = np.random.RandomState(0)
+    samples = [rng.randn(3, 5).astype("float32") for _ in range(7)]
+    out = native.collate_f32(samples)
+    np.testing.assert_array_equal(out, np.stack(samples))
+
+
+def test_moe_layer_trains():
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    paddle.seed(0)
+    moe = MoELayer(d_model=16, d_hidden=32, num_experts=4, top_k=2)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 8, 16).astype("float32"))
+    y = moe(x)
+    assert y.shape == [2, 8, 16]
+    assert np.isfinite(float(moe.aux_loss))
+
+    head = nn.Linear(16, 4)
+    params = moe.parameters() + head.parameters()
+    o = opt.AdamW(learning_rate=1e-3, parameters=params)
+    yl = paddle.to_tensor(np.random.RandomState(1).randint(0, 4, (2,)).astype("int64"))
+    lossf = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(5):
+        l = lossf(head(moe(x).mean(axis=1)), yl) + moe.aux_loss * 0.01
+        l.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(l))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_ep_sharding_under_mesh():
+    from paddle_tpu.distributed import topology as topo
+    from paddle_tpu.distributed.fleet.meta_parallel import MoELayer
+
+    t = topo.CommunicateTopology(["dp", "mp"], [2, 4])
+    topo.set_hybrid_communicate_group(topo.HybridCommunicateGroup(t))
+    try:
+        paddle.seed(1)
+        moe = MoELayer(16, 32, num_experts=4)
+        assert "mp" in str(moe.w1._value.sharding.spec)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 8, 16).astype("float32"))
+        y = moe(x)
+        assert y.shape == [2, 8, 16]
+    finally:
+        topo.set_hybrid_communicate_group(None)
+
+
+def test_sep_alltoall_manual_roundtrip():
+    from paddle_tpu.distributed.fleet.meta_parallel import sep_utils
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    B, S, H, D = 2, 16, 4, 8
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, S, H, D).astype("float32"))
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("sep",))
+
+    def body(v):
+        heads = sep_utils.alltoall_seq_to_heads(v, axis="sep")
+        assert heads.shape == (B, S, H // 4, D)  # full seq, local heads
+        return sep_utils.alltoall_heads_to_seq(heads, axis="sep")
+
+    f = jax.jit(jax.shard_map(body, mesh=mesh,
+                              in_specs=P(None, "sep"), out_specs=P(None, "sep"),
+                              check_vma=False))
+    out = f(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+
+def test_sep_attention_matches_plain():
+    from paddle_tpu.distributed.fleet.meta_parallel import sep_attention
+    from paddle_tpu.distributed import topology as topo
+    import paddle_tpu.nn.functional as F
+
+    t = topo.CommunicateTopology(["sep"], [4])
+    topo.set_hybrid_communicate_group(topo.HybridCommunicateGroup(t))
+    try:
+        rng = np.random.RandomState(0)
+        q = paddle.to_tensor(rng.randn(2, 16, 4, 8).astype("float32") * 0.5)
+        out = sep_attention(q, q, q, is_causal=True, training=False)
+        ref = F.scaled_dot_product_attention(q, q, q, is_causal=True,
+                                             training=False)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-5, atol=2e-6)
+    finally:
+        topo.set_hybrid_communicate_group(None)
+
+
+def test_native_pipeline_kernels():
+    from paddle_tpu.io import native
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (4, 32, 32, 3), dtype=np.uint8)
+    mean = np.array([123.7, 116.3, 103.5], np.float32)
+    std = np.array([58.4, 57.1, 57.4], np.float32)
+    flips = np.array([0, 1, 0, 1], np.uint8)
+    out = native.normalize_chw(imgs, mean, std, flips)
+    x = imgs.astype(np.float32)
+    x[flips.astype(bool)] = x[flips.astype(bool), :, ::-1]
+    ref = ((x - mean) / std).transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-5)
+
+    ys = np.array([0, 1, 2, 3], np.int32)
+    xs = np.array([3, 2, 1, 0], np.int32)
+    crop = native.crop_batch(imgs, ys, xs, 16, 16)
+    np.testing.assert_array_equal(crop[2], imgs[2, 2:18, 1:17])
