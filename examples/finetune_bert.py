@@ -1,0 +1,67 @@
+"""Baseline config #2: BERT/ERNIE sequence-classification fine-tune through
+the compiled path (the reference drives this via @to_static; here the fused
+TrainStep compiles forward+backward+AdamW into one program).
+
+    python examples/finetune_bert.py [--model ernie|bert] [--epochs 3]
+"""
+
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.text import (BertTokenizer, BertForSequenceClassification,
+                             ErnieForSequenceClassification)
+
+TOY_SST = [
+    ("a triumph of wit and craft", 1),
+    ("gorgeous, moving, expertly acted", 1),
+    ("one of the year's best films", 1),
+    ("sharp writing and a brilliant cast", 1),
+    ("dull, lifeless, and painfully long", 0),
+    ("a waste of everyone's talent and time", 0),
+    ("the plot collapses into nonsense", 0),
+    ("clumsy pacing and wooden dialogue", 0),
+] * 8
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="ernie", choices=["ernie", "bert"])
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--max-len", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=5e-4)
+    args = ap.parse_args()
+
+    texts = [t for t, _ in TOY_SST]
+    labels = np.array([l for _, l in TOY_SST], dtype="int64")
+    tok = BertTokenizer.from_corpus(texts, min_freq=1)
+    vocab = ((tok.vocab_size + 7) // 8) * 8
+    ids = np.array([tok(t, max_length=args.max_len)["input_ids"] for t in texts],
+                   dtype="int64")
+
+    paddle.seed(0)
+    cls = ErnieForSequenceClassification if args.model == "ernie" else \
+        BertForSequenceClassification
+    net = cls(num_classes=2, vocab_size=vocab, hidden_size=128,
+              num_hidden_layers=4, num_attention_heads=4,
+              intermediate_size=256, max_position_embeddings=args.max_len,
+              hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1)
+    model = paddle.Model(net)
+    model.prepare(opt.AdamW(learning_rate=args.lr,
+                            parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+
+    from paddle_tpu.io import TensorDataset
+
+    data = TensorDataset([ids, labels])
+    model.fit(data, epochs=args.epochs, batch_size=args.batch_size, verbose=1)
+    print("final:", model.evaluate(data, batch_size=args.batch_size, verbose=0))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""Baseline config #3: detection training (PP-YOLOE-style anchor-free head
+or FasterRCNN) on synthetic boxes.
+
+    python examples/train_detection.py [--arch yolo|rcnn] [--steps 20]
+"""
+
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.vision.models import yolov3, faster_rcnn
+
+
+def synth_batch(rng, b=2, size=160, max_boxes=8, classes=8):
+    img = rng.randn(b, 3, size, size).astype("float32")
+    gtb = np.zeros((b, max_boxes, 4), dtype="float32")
+    gtl = np.full((b, max_boxes), -1, dtype="int64")
+    for i in range(b):
+        n = rng.randint(1, 4)
+        for j in range(n):
+            x1, y1 = rng.randint(0, size - 48, 2)
+            w, h = rng.randint(24, 48, 2)
+            gtb[i, j] = [x1, y1, x1 + w, y1 + h]
+            gtl[i, j] = rng.randint(0, classes)
+    return (paddle.to_tensor(img), paddle.to_tensor(gtb), paddle.to_tensor(gtl))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", default="yolo", choices=["yolo", "rcnn"])
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--classes", type=int, default=8)
+    args = ap.parse_args()
+
+    paddle.seed(0)
+    model = yolov3(num_classes=args.classes, depth=18) if args.arch == "yolo" \
+        else faster_rcnn(num_classes=args.classes, depth=18, num_proposals=64)
+    optim = opt.Adam(learning_rate=2e-4, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    for i in range(args.steps):
+        img, gtb, gtl = synth_batch(rng, classes=args.classes)
+        losses = model(img, gtb, gtl)
+        losses["loss"].backward()
+        optim.step()
+        optim.clear_grad()
+        if (i + 1) % 5 == 0:
+            print(f"step {i + 1}: " +
+                  " ".join(f"{k}={float(v):.3f}" for k, v in losses.items()))
+    model.eval()
+    dets = model(synth_batch(rng, classes=args.classes)[0])
+    n = int(dets[0]["valid"].numpy().sum())
+    print(f"eval: {n} detections on image 0")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Baseline config #5: GPT decoder LM under Fleet hybrid parallelism
+(dp x pp x mp over the device mesh; run on CPU with a virtual mesh via
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu).
+
+    python examples/train_gpt_hybrid.py --dp 2 --pp 2 --mp 2 [--steps 10]
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+import paddle_tpu.distributed.fleet as fleet
+from paddle_tpu.text.models import GPTForCausalLM, GPTForCausalLMPipe
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--pp", type=int, default=2)
+    ap.add_argument("--mp", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--micro", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": args.dp, "pp_degree": args.pp,
+                               "mp_degree": args.mp,
+                               "order": ["dp", "pp", "mp"]}
+    fleet.init(is_collective=True, strategy=strategy)
+    mesh = fleet.get_hybrid_communicate_group().mesh
+    print("mesh:", mesh)
+
+    paddle.seed(0)
+    lm = GPTForCausalLM(vocab_size=args.vocab, hidden_size=args.hidden,
+                        num_hidden_layers=args.layers,
+                        num_attention_heads=args.heads,
+                        max_position_embeddings=args.seq)
+    if args.pp > 1:
+        model = GPTForCausalLMPipe(lm, mesh, n_micro=args.micro,
+                                   batch_axis="dp" if args.dp > 1 else None)
+    else:
+        model = lm
+    optim = fleet.distributed_optimizer(
+        opt.AdamW(learning_rate=3e-4, parameters=model.parameters()))
+    step = paddle.jit.TrainStep(model, optim, loss_fn=None)
+
+    B = args.micro * max(args.dp, 1)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(1, args.vocab, (B, args.seq)).astype("int64"))
+
+    loss = step({"input_ids": ids, "labels": ids})
+    float(loss)
+    t0 = time.time()
+    for i in range(args.steps):
+        loss = step({"input_ids": ids, "labels": ids})
+        print(f"step {i + 1}: loss {float(loss):.4f}")
+    dt = (time.time() - t0) / args.steps
+    tokens = B * args.seq
+    print(f"{tokens / dt:.0f} tokens/sec ({dt * 1e3:.1f} ms/step) on "
+          f"dp{args.dp} x pp{args.pp} x mp{args.mp}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Baseline config #1: ResNet-50 single-device training (dygraph-equivalent
+API, fused-compiled step).  Synthetic data unless an ImageFolder path is
+given.
+
+    python examples/train_resnet50.py [--batch-size 128] [--steps 50]
+                                      [--amp O2] [--data /path/to/imagefolder]
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--amp", default="O2", choices=["O0", "O1", "O2"])
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--data", default=None, help="ImageFolder root (optional)")
+    args = ap.parse_args()
+
+    paddle.seed(0)
+    model = paddle.vision.models.resnet50(num_classes=1000)
+    optim = opt.Momentum(learning_rate=args.lr, momentum=0.9,
+                         parameters=model.parameters(), weight_decay=1e-4)
+    step = paddle.jit.TrainStep(model, optim, loss_fn=nn.CrossEntropyLoss(),
+                                amp_level=None if args.amp == "O0" else args.amp)
+
+    if args.data:
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.vision import transforms as T
+        from paddle_tpu.vision.datasets import ImageFolder
+
+        tf = T.Compose([T.Resize(256), T.RandomCrop(224),
+                        T.RandomHorizontalFlip(), T.ToTensor(),
+                        T.Normalize([0.485, 0.456, 0.406], [0.229, 0.224, 0.225])])
+        loader = DataLoader(ImageFolder(args.data, transform=tf),
+                            batch_size=args.batch_size, shuffle=True,
+                            num_workers=4, drop_last=True)
+
+        def batches():
+            while True:
+                yield from loader
+    else:
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(args.batch_size, 3, 224, 224).astype("float32"))
+        y = paddle.to_tensor(rng.randint(0, 1000, (args.batch_size,)).astype("int64"))
+
+        def batches():
+            while True:
+                yield x, y
+
+    it = batches()
+    loss = step(*next(it))  # compile
+    float(loss)
+    t0 = time.time()
+    for i in range(args.steps):
+        loss = step(*next(it))
+        if (i + 1) % 10 == 0:
+            print(f"step {i + 1}: loss {float(loss):.4f}")
+    dt = (time.time() - t0) / args.steps
+    print(f"{args.batch_size / dt:.0f} imgs/sec ({dt * 1e3:.1f} ms/step)")
+
+
+if __name__ == "__main__":
+    main()

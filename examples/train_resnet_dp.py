@@ -1,0 +1,57 @@
+"""Baseline config #4: Fleet data-parallel ResNet across all visible chips
+(allreduce handled by the XLA partitioner; run on CPU with a virtual mesh
+via XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu).
+
+    python examples/train_resnet_dp.py [--steps 20] [--batch-size 64]
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+import paddle_tpu.distributed as dist
+import paddle_tpu.distributed.fleet as fleet
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--depth", type=int, default=18)
+    args = ap.parse_args()
+
+    dist.init_parallel_env()
+    fleet.init(is_collective=True)  # pure DP over every visible chip
+    paddle.seed(0)
+    net = paddle.vision.models.resnet18(num_classes=100) if args.depth == 18 \
+        else paddle.vision.models.resnet50(num_classes=100)
+    model = fleet.distributed_model(net)
+    optim = fleet.distributed_optimizer(
+        opt.Momentum(learning_rate=0.1, momentum=0.9,
+                     parameters=net.parameters()))
+    step = paddle.jit.TrainStep(net, optim, loss_fn=nn.CrossEntropyLoss())
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(args.batch_size, 3, 64, 64).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 100, (args.batch_size,)).astype("int64"))
+    model.shard_input(x)  # batch rides the dp axis
+    model.shard_input(y)
+
+    loss = step(x, y)
+    float(loss)
+    t0 = time.time()
+    for i in range(args.steps):
+        loss = step(x, y)
+        if (i + 1) % 5 == 0:
+            print(f"step {i + 1}: loss {float(loss):.4f}")
+    dt = (time.time() - t0) / args.steps
+    print(f"{args.batch_size / dt:.0f} imgs/sec over "
+          f"{model.mesh.devices.size} devices")
+
+
+if __name__ == "__main__":
+    main()

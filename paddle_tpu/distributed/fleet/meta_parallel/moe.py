@@ -50,7 +50,7 @@ def top2_gating(logits, capacity, dtype=jnp.float32):
     # aux load-balance loss (Switch/GShard): E * sum_e fraction_e * prob_e
     density = mask1.mean(axis=0)
     density_proxy = probs.mean(axis=0)
-    aux = (density * density_proxy).sum() * (E * E)
+    aux = (density * density_proxy).sum() * E
 
     # positions within each expert's buffer, first-come-first-served
     pos1 = (jnp.cumsum(mask1, axis=0) - 1.0) * mask1
@@ -91,6 +91,10 @@ class MoELayer(Layer):
         super().__init__()
         if top_k != 2:
             raise NotImplementedError("MoELayer implements top-2 (GShard) gating")
+        if gate is not None or experts is not None:
+            raise NotImplementedError(
+                "custom gate/experts modules are not supported; MoELayer owns "
+                "a linear gate and a stacked expert FFN (the einsum/EP design)")
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
         self.act_name = act

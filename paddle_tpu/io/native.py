@@ -105,6 +105,9 @@ def collate_f32(samples, num_threads=0):
     if n == 0:
         return np.empty((0,), np.float32)
     shape = samples[0].shape
+    for s in samples[1:]:  # the C memcpy must never read past a ragged sample
+        if s.shape != shape:
+            raise ValueError(f"collate_f32: ragged samples {s.shape} vs {shape}")
     lib = _lib()
     if lib is None:
         return np.stack(samples)
@@ -121,6 +124,8 @@ def crop_batch(images, ys, xs, oh, ow, num_threads=0):
     n, H, W, c = images.shape
     ys = np.ascontiguousarray(ys, dtype=np.int32)
     xs = np.ascontiguousarray(xs, dtype=np.int32)
+    if (ys < 0).any() or (xs < 0).any() or (ys > H - oh).any() or (xs > W - ow).any():
+        raise ValueError("crop_batch: offsets out of bounds for crop size")
     lib = _lib()
     if lib is not None:
         out = np.empty((n, oh, ow, c), dtype=np.uint8)

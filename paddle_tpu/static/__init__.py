@@ -112,30 +112,39 @@ class Executor:
 
 
 def _replay(t: Tensor):
-    """Recompute ``t`` from the tape graph with current placeholder values."""
-    node = t._grad_node
-    if node is None:
+    """Recompute ``t`` from the tape graph with current placeholder values.
+    Iterative post-order walk — Programs can be deeper than Python's
+    recursion limit (same reason autograd/tape.py walks iteratively)."""
+    if t._grad_node is None:
         return t
     memo: dict[int, object] = {}
 
-    def value_of(x):
-        if not isinstance(x, Tensor):
-            return x
-        if getattr(x, "is_data", False) or x._grad_node is None:
-            return x._value
-        if id(x) in memo:
-            return memo[id(x)]
+    def is_pending(x):
+        return (isinstance(x, Tensor) and not getattr(x, "is_data", False)
+                and x._grad_node is not None and id(x) not in memo)
+
+    stack = [(t, False)]
+    while stack:
+        x, expanded = stack.pop()
+        if not is_pending(x):
+            continue
         n = x._grad_node
-        args = [value_of(a) for a in n.inputs]
+        if not expanded:
+            stack.append((x, True))
+            for a in n.inputs:
+                if is_pending(a):
+                    stack.append((a, False))
+            continue
+        args = [memo[id(a)] if (isinstance(a, Tensor) and id(a) in memo)
+                else (a._value if isinstance(a, Tensor) else a)
+                for a in n.inputs]
         out = n.fn(*args, **n.kwargs)
         outs = out if isinstance(out, (tuple, list)) else (out,)
         for ref, v in zip(n.outputs, outs):
             ot = ref()
             if ot is not None:
                 memo[id(ot)] = v
-        return memo[id(x)]
-
-    return Tensor(value_of(t))
+    return Tensor(memo[id(t)])
 
 
 class CompiledProgram:

@@ -20,6 +20,7 @@ from .random import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .attribute import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
+from .extras import *  # noqa: F401,F403
 
 # linalg is exposed as a namespace (paddle.linalg.*) plus a few top-level names
 from .linalg import norm, dist  # noqa: F401

@@ -1,0 +1,205 @@
+"""Long-tail tensor ops (reference: assorted python/paddle/tensor/ entries)
+rounding out the ~500-op surface."""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+
+from .dispatch import apply
+from .tensor import Tensor
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply(lambda yv, xv: jnp.trapezoid(yv, xv, axis=axis), y, x,
+                     op_name="trapezoid")
+    return apply(lambda yv: jnp.trapezoid(yv, dx=dx if dx is not None else 1.0,
+                                          axis=axis), y, op_name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def fn(yv, *xs):
+        d = axis % yv.ndim
+        y1 = jax.lax.slice_in_dim(yv, 1, yv.shape[d], axis=d)
+        y0 = jax.lax.slice_in_dim(yv, 0, yv.shape[d] - 1, axis=d)
+        if xs:
+            xv = xs[0]
+            x1 = jax.lax.slice_in_dim(xv, 1, xv.shape[d] if xv.ndim > 1 else xv.shape[0],
+                                      axis=d if xv.ndim > 1 else 0)
+            x0 = jax.lax.slice_in_dim(xv, 0, -1, axis=d if xv.ndim > 1 else 0)
+            h = (x1 - x0)
+            if xv.ndim == 1 and yv.ndim > 1:
+                shape = [1] * yv.ndim
+                shape[d] = -1
+                h = h.reshape(shape)
+        else:
+            h = dx if dx is not None else 1.0
+        return jnp.cumsum((y0 + y1) * 0.5 * h, axis=d)
+
+    args = (y,) if x is None else (y, x)
+    return apply(fn, *args, op_name="cumulative_trapezoid")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def fn(v):
+        dims = [d for d in range(v.ndim) if d != axis % v.ndim]
+        norms = jnp.sum(jnp.abs(v) ** p, axis=tuple(dims), keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return v * factor
+
+    return apply(fn, x, op_name="renorm")
+
+
+def signbit(x, name=None):
+    return apply(lambda v: jnp.signbit(v), x, op_name="signbit")
+
+
+def sinc(x, name=None):
+    return apply(lambda v: jnp.sinc(v), x, op_name="sinc")
+
+
+def polygamma(x, n, name=None):
+    from jax.scipy.special import polygamma as _pg
+
+    return apply(lambda v: _pg(n, v), x, op_name="polygamma")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def fn(v):
+        a = axis
+        if a is None:
+            v = v.reshape(-1)
+            a = 0
+        return jax.lax.cumlogsumexp(v, axis=a)
+
+    return apply(fn, x, op_name="logcumsumexp")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def fn(v, s):
+        idx = [slice(None)] * v.ndim
+        idx[axis % v.ndim] = index
+        return v.at[tuple(idx)].set(s)
+
+    return apply(fn, x, values, op_name="select_scatter")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def fn(v, s):
+        n = min(v.shape[axis1 % v.ndim], v.shape[axis2 % v.ndim])
+        # move target axes last, scatter on the diagonal, move back
+        perm = [d for d in range(v.ndim) if d not in (axis1 % v.ndim, axis2 % v.ndim)]
+        perm += [axis1 % v.ndim, axis2 % v.ndim]
+        vp = jnp.transpose(v, perm)
+        k = s.shape[-1] if s.ndim else n
+        rows = jnp.arange(k) + max(-offset, 0)
+        cols = jnp.arange(k) + max(offset, 0)
+        vp = vp.at[..., rows, cols].set(s)
+        inv = [perm.index(d) for d in range(v.ndim)]
+        return jnp.transpose(vp, inv)
+
+    return apply(fn, x, y, op_name="diagonal_scatter")
+
+
+def unfold(x, axis, size, step, name=None):
+    def fn(v):
+        d = axis % v.ndim
+        n = (v.shape[d] - size) // step + 1
+        starts = jnp.arange(n) * step
+        idx = starts[:, None] + jnp.arange(size)[None, :]       # [n, size]
+        out = jnp.take(v, idx.reshape(-1), axis=d)
+        shape = list(v.shape)
+        shape[d:d + 1] = [n, size]
+        out = out.reshape(shape)
+        # paddle/torch put the window dim last
+        return jnp.moveaxis(out, d + 1, -1)
+
+    return apply(fn, x, op_name="unfold")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    def fn(v):
+        return jnp.vander(v, N=n, increasing=increasing)
+
+    return apply(fn, x, op_name="vander")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def fn(v):
+        n = v.shape[-1] + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        rows = jnp.arange(v.shape[-1]) + max(-offset, 0)
+        cols = jnp.arange(v.shape[-1]) + max(offset, 0)
+        out = out.at[..., rows, cols].set(v)
+        d1, d2 = dim1 % out.ndim, dim2 % out.ndim
+        std = (out.ndim - 2, out.ndim - 1)
+        if (d1, d2) != std:
+            out = jnp.moveaxis(out, std, (d1, d2))
+        return out
+
+    return apply(fn, x, op_name="diag_embed")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    def fn(v):
+        n = v.shape[0]
+        combo = itertools.combinations_with_replacement(range(n), r) \
+            if with_replacement else itertools.combinations(range(n), r)
+        idx = jnp.asarray(list(combo), dtype=jnp.int32)
+        if idx.size == 0:
+            return jnp.zeros((0, r), v.dtype)
+        return v[idx]
+
+    return apply(fn, x, op_name="combinations")
+
+
+def cartesian_prod(*xs, name=None):
+    def fn(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return apply(fn, *xs, op_name="cartesian_prod")
+
+
+def vsplit(x, num_or_indices, name=None):
+    return _split_axis(x, num_or_indices, 0, "vsplit")
+
+
+def hsplit(x, num_or_indices, name=None):
+    return _split_axis(x, num_or_indices, 1 if x.ndim > 1 else 0, "hsplit")
+
+
+def dsplit(x, num_or_indices, name=None):
+    return _split_axis(x, num_or_indices, 2, "dsplit")
+
+
+def _split_axis(x, num_or_indices, axis, op_name):
+    def fn(v):
+        return tuple(jnp.split(v, num_or_indices, axis=axis))
+
+    return list(apply(fn, x, op_name=op_name, n_outs=None))
+
+
+def block_diag(*xs, name=None):
+    def fn(*vs):
+        import jax.scipy.linalg as jsl
+
+        return jsl.block_diag(*vs)
+
+    return apply(fn, *xs, op_name="block_diag")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view (reference Tensor.as_strided): gather-based (XLA arrays
+    have no aliasing views; identical values, fresh buffer)."""
+    def fn(v):
+        flat = v.reshape(-1)
+        idx = jnp.full((1,), offset, jnp.int32)
+        for s, st in zip(shape, stride):
+            idx = (idx[..., None] + (jnp.arange(s) * st)[None, :]).reshape(-1)
+        return flat[idx].reshape(tuple(shape))
+
+    return apply(fn, x, op_name="as_strided")

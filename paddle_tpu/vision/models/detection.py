@@ -61,7 +61,9 @@ class FPN(nn.Layer):
     def forward(self, feats):
         lat = [l(f) for l, f in zip(self.lateral, feats)]
         for i in range(len(lat) - 2, -1, -1):
-            up = F.interpolate(lat[i + 1], scale_factor=2, mode="nearest")
+            # upsample to the EXACT lateral size (scale_factor=2 breaks when
+            # the finer map has odd spatial dims, e.g. 104 or 600 inputs)
+            up = F.interpolate(lat[i + 1], size=lat[i].shape[2:], mode="nearest")
             lat[i] = lat[i] + up
         return [o(l) for o, l in zip(self.output, lat)]
 

@@ -234,7 +234,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0., nms_top_k=400
         decay = jnp.minimum(ratio.min(axis=0), 1.0)
         return s * decay, order
 
-    outs = []
+    outs, idxs = [], []
     for c in range(C):
         if c == background_label:
             continue
@@ -243,21 +243,31 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0., nms_top_k=400
         cls_col = jnp.full((m, 1), float(c))
         outs.append(jnp.concatenate(
             [cls_col, s_dec[:m, None], bv[order[:m]]], axis=1))
+        idxs.append(order[:m])
     if not outs:
         empty = Tensor(jnp.zeros((0, 6), jnp.float32))
-        return (empty, Tensor(jnp.zeros((1,), jnp.int32))) if return_rois_num \
-            else empty
+        parts = [empty]
+        if return_index:
+            parts.append(Tensor(jnp.zeros((0,), jnp.int64)))
+        if return_rois_num:
+            parts.append(Tensor(jnp.zeros((1,), jnp.int32)))
+        return parts[0] if len(parts) == 1 else tuple(parts)
     all_out = jnp.concatenate(outs, axis=0)
+    all_idx = jnp.concatenate(idxs, axis=0)
     sel = jnp.argsort(-all_out[:, 1])[:keep_top_k]
     out = all_out[sel]
+    out_idx = all_idx[sel]
     # eager strip: reference filters by score_threshold (and post_threshold)
     thresh = max(float(score_threshold), float(post_threshold))
-    keep = _np.asarray(out[:, 1]) > thresh
-    out = out[_np.nonzero(keep)[0]]
-    res = Tensor(out)
+    keep = _np.nonzero(_np.asarray(out[:, 1]) > thresh)[0]
+    out = out[keep]
+    out_idx = out_idx[keep]
+    parts = [Tensor(out)]
+    if return_index:
+        parts.append(Tensor(out_idx.astype(jnp.int64)))
     if return_rois_num:
-        return res, Tensor(jnp.asarray([out.shape[0]], jnp.int32))
-    return res
+        parts.append(Tensor(jnp.asarray([out.shape[0]], jnp.int32)))
+    return parts[0] if len(parts) == 1 else tuple(parts)
 
 
 # --------------------------------------------------------------- yolo / boxes

@@ -246,3 +246,58 @@ def test_topk_backward_int_output():
 def test_split_indivisible_raises():
     with pytest.raises(ValueError):
         paddle.split(paddle.arange(5), 2)
+
+
+def test_extras_long_tail_ops():
+    import numpy as np
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.linspace(0, 1, 9).astype("float32"))
+    np.testing.assert_allclose(float(paddle.trapezoid(x, dx=0.125)), 0.5, atol=1e-6)
+    ct = paddle.cumulative_trapezoid(x, dx=0.125)
+    assert ct.shape == [8] and abs(float(ct[-1]) - 0.5) < 1e-6
+
+    m = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+    r = paddle.renorm(m, p=2.0, axis=0, max_norm=1.0)
+    norms = np.linalg.norm(r.numpy(), axis=1)
+    assert (norms <= 1.0 + 1e-5).all()
+
+    assert bool(paddle.signbit(paddle.to_tensor(np.float32(-2.0))))
+    np.testing.assert_allclose(paddle.sinc(paddle.to_tensor(np.float32(0.5))).numpy(),
+                               np.sinc(0.5), rtol=1e-6)
+
+    lcse = paddle.logcumsumexp(paddle.to_tensor(np.zeros(3, "float32")))
+    np.testing.assert_allclose(lcse.numpy(), np.log(np.arange(1, 4)), rtol=1e-6)
+
+    d = paddle.diag_embed(paddle.to_tensor(np.array([1.0, 2.0], "float32")))
+    np.testing.assert_array_equal(d.numpy(), np.diag([1.0, 2.0]))
+
+    u = paddle.unfold(paddle.to_tensor(np.arange(6, dtype="float32")), 0, 3, 1)
+    assert u.shape == [4, 3]
+    np.testing.assert_array_equal(u.numpy()[1], [1, 2, 3])
+
+    c = paddle.combinations(paddle.to_tensor(np.arange(4, dtype="int64")), r=2)
+    assert c.shape == [6, 2]
+
+    cp = paddle.cartesian_prod(paddle.to_tensor(np.arange(2, dtype="int64")),
+                               paddle.to_tensor(np.arange(3, dtype="int64")))
+    assert cp.shape == [6, 2]
+
+    parts = paddle.vsplit(paddle.to_tensor(np.arange(12, dtype="float32").reshape(4, 3)), 2)
+    assert len(parts) == 2 and parts[0].shape == [2, 3]
+
+    bd = paddle.block_diag(paddle.to_tensor(np.ones((2, 2), "float32")),
+                           paddle.to_tensor(np.full((1, 1), 3.0, "float32")))
+    assert bd.shape == [3, 3] and float(bd[2, 2]) == 3.0
+
+    st = paddle.as_strided(paddle.to_tensor(np.arange(10, dtype="float32")),
+                           [3, 2], [3, 1])
+    np.testing.assert_array_equal(st.numpy(), [[0, 1], [3, 4], [6, 7]])
+
+    ss = paddle.select_scatter(paddle.to_tensor(np.zeros((3, 4), "float32")),
+                               paddle.to_tensor(np.ones(4, "float32")), 0, 1)
+    assert float(ss[1].sum()) == 4.0
+
+    ds = paddle.diagonal_scatter(paddle.to_tensor(np.zeros((3, 3), "float32")),
+                                 paddle.to_tensor(np.array([5.0, 6.0, 7.0], "float32")))
+    np.testing.assert_array_equal(np.diag(ds.numpy()), [5.0, 6.0, 7.0])
