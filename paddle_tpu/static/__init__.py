@@ -171,3 +171,5 @@ def save(program, model_path, protocol=4):
 
 def load(program, model_path, executor=None, var_list=None):
     raise NotImplementedError("static.load: use paddle.jit.load")
+
+from . import nn  # noqa: E402,F401 — control-flow ops (cond/while_loop/...)

@@ -1,0 +1,223 @@
+"""paddle.static.nn control-flow ops (reference:
+python/paddle/static/nn/control_flow.py — cond, while_loop, case,
+switch_case: the graph-mode control-flow ops dy2static lowers Python
+if/while into).
+
+TPU-native: these ARE `lax.cond` / `lax.while_loop` / `lax.switch` — the
+compiled control flow XLA executes on-device.  They work eagerly AND inside
+to_static/TrainStep traces, which is how data-dependent control flow is
+expressed in this framework (jax traces Python by value, so a Python `if`
+on a traced tensor cannot branch; use these instead — the same rule the
+reference enforces in static graph mode).
+
+Differentiability: Tensors the branch/body closures capture are discovered
+(closure cells + referenced globals) and threaded as real inputs through the
+dispatch layer, so gradients flow into them — the tape sees one node for the
+whole control-flow op, mirroring the reference's ConditionalBlockGrad /
+WhileGrad ops.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.dispatch import apply as _apply
+from ..tensor.tensor import Tensor
+
+
+def _closure_tensors(*fns):
+    """Tensors the callables can reach: closure cells and referenced globals,
+    looking through Layers (their params/buffers), dicts, lists and tuples —
+    everything found is threaded as a dispatch input so gradients flow."""
+    from ..nn.layer import Layer
+
+    seen, out = set(), []
+
+    def visit(v, depth=0):
+        if isinstance(v, Tensor):
+            if id(v) not in seen:
+                seen.add(id(v))
+                out.append(v)
+        elif isinstance(v, Layer):
+            if id(v) in seen:
+                return
+            seen.add(id(v))
+            for p in v.parameters():
+                visit(p)
+            for b in v.buffers():
+                visit(b)
+        elif depth < 2 and isinstance(v, dict):
+            for x in v.values():
+                visit(x, depth + 1)
+        elif depth < 2 and isinstance(v, (list, tuple)):
+            for x in v:
+                visit(x, depth + 1)
+
+    for fn in fns:
+        if fn is None:
+            continue
+        code = getattr(fn, "__code__", None)
+        if code is None:
+            continue
+        if getattr(fn, "__closure__", None):
+            for cell in fn.__closure__:
+                try:
+                    visit(cell.cell_contents)
+                except ValueError:
+                    pass
+        for name in code.co_names:
+            visit(getattr(fn, "__globals__", {}).get(name))
+    return out
+
+
+@contextlib.contextmanager
+def _swapped(tensors, values):
+    saved = [t._value for t in tensors]
+    for t, v in zip(tensors, values):
+        t._value = v
+    try:
+        yield
+    finally:
+        for t, v in zip(tensors, saved):
+            t._value = v
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda v: v._value if isinstance(v, Tensor) else v, tree,
+        is_leaf=lambda v: isinstance(v, Tensor))
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Run ``true_fn()`` or ``false_fn()`` by a traced boolean — both branches
+    compile; XLA selects at run time (reference: paddle.static.nn.cond)."""
+    captured = _closure_tensors(true_fn, false_fn)
+    pred_t = pred if isinstance(pred, Tensor) else Tensor(jnp.asarray(pred))
+
+    def fn(pv, *tvals):
+        # branches trace INSIDE lax.cond — the untaken branch never executes
+        # at run time (guard patterns like x/n protected by the predicate stay
+        # NaN-free, and its vjp contributes nothing)
+        def t_branch():
+            with _swapped(captured, tvals):
+                return _unwrap_tree(true_fn()) if true_fn is not None else None
+
+        def f_branch():
+            with _swapped(captured, tvals):
+                return _unwrap_tree(false_fn()) if false_fn is not None else None
+
+        return jax.lax.cond(pv.reshape(()).astype(bool), t_branch, f_branch)
+
+    return _apply(fn, pred_t, *captured, op_name="cond", n_outs=None)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """reference: paddle.static.nn.while_loop(cond, body, loop_vars).
+    cond_fn/body_fn take and return the loop-var list.
+
+    Eager: runs as a Python loop over Tensors — every iteration is on the
+    tape, so backward() works (the reference's WhileGrad).  Inside a jit
+    trace: lowers to ``lax.while_loop``, which XLA cannot
+    reverse-differentiate — use a bounded loop (scan/fori pattern) when you
+    need gradients through a compiled dynamic loop.
+    """
+    captured = _closure_tensors(cond_fn, body_fn)
+    loop_vars = list(loop_vars)
+    n_loop = len(loop_vars)
+
+    traced = any(isinstance(v._value if isinstance(v, Tensor) else v,
+                            jax.core.Tracer) for v in loop_vars + captured)
+    if not traced:
+        # eager: plain taped Python loop — fully differentiable
+        vars_ = [v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+                 for v in loop_vars]
+        while bool(cond_fn(*vars_)):
+            out = body_fn(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_
+
+    def fn(*all_vals):
+        loop_init = all_vals[:n_loop]
+        tvals = all_vals[n_loop:]
+
+        def c(state):
+            with _swapped(captured, tvals):
+                r = cond_fn(*[Tensor(s) for s in state])
+            rv = r._value if isinstance(r, Tensor) else jnp.asarray(r)
+            return rv.reshape(()).astype(bool)
+
+        def b(state):
+            with _swapped(captured, tvals):
+                out = body_fn(*[Tensor(s) for s in state])
+            out = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(_unwrap_tree(list(out)))
+
+        return jax.lax.while_loop(c, b, tuple(loop_init))
+
+    out = _apply(fn, *loop_vars, *captured, op_name="while_loop", n_outs=None)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference: paddle.static.nn.switch_case."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        keys = [k for k, _ in branch_fns]
+        fns = [f for _, f in branch_fns]
+    else:
+        fns = list(branch_fns)
+        keys = list(range(len(fns)))
+    if default is None:
+        default = fns[-1]
+    captured = _closure_tensors(*fns, default)
+    idx_t = branch_index if isinstance(branch_index, Tensor) else \
+        Tensor(jnp.asarray(branch_index))
+
+    def fn(iv, *tvals):
+        i = iv.reshape(()).astype(jnp.int32)
+        slot = jnp.asarray(len(fns), jnp.int32)  # default
+        for s, k in enumerate(keys):
+            slot = jnp.where(i == k, jnp.int32(s), slot)
+
+        def make(f):
+            def run():
+                with _swapped(captured, tvals):
+                    return _unwrap_tree(f())
+            return run
+
+        return jax.lax.switch(slot, [make(f) for f in fns] + [make(default)])
+
+    return _apply(fn, idx_t, *captured, op_name="switch_case", n_outs=None)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference: paddle.static.nn.case — first true predicate wins."""
+    preds = [p if isinstance(p, Tensor) else Tensor(jnp.asarray(p))
+             for p, _ in pred_fn_pairs]
+    fns = [f for _, f in pred_fn_pairs]
+    if default is None:
+        default = fns[-1]
+    captured = _closure_tensors(*fns, default)
+    n_p = len(preds)
+
+    def fn(*all_vals):
+        pvs = all_vals[:n_p]
+        tvals = all_vals[n_p:]
+        stacked = jnp.stack([p.reshape(()).astype(bool) for p in pvs])
+        idx = jnp.where(jnp.any(stacked), jnp.argmax(stacked), n_p)
+
+        def make(f):
+            def run():
+                with _swapped(captured, tvals):
+                    return _unwrap_tree(f())
+            return run
+
+        return jax.lax.switch(idx.astype(jnp.int32),
+                              [make(f) for f in fns] + [make(default)])
+
+    return _apply(fn, *preds, *captured, op_name="case", n_outs=None)

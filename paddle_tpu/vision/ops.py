@@ -246,11 +246,11 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0., nms_top_k=400
         idxs.append(order[:m])
     if not outs:
         empty = Tensor(jnp.zeros((0, 6), jnp.float32))
-        parts = [empty]
-        if return_index:
-            parts.append(Tensor(jnp.zeros((0,), jnp.int64)))
+        parts = [empty]  # reference order: out, rois_num, index
         if return_rois_num:
             parts.append(Tensor(jnp.zeros((1,), jnp.int32)))
+        if return_index:
+            parts.append(Tensor(jnp.zeros((0,), jnp.int64)))
         return parts[0] if len(parts) == 1 else tuple(parts)
     all_out = jnp.concatenate(outs, axis=0)
     all_idx = jnp.concatenate(idxs, axis=0)
@@ -262,11 +262,11 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0., nms_top_k=400
     keep = _np.nonzero(_np.asarray(out[:, 1]) > thresh)[0]
     out = out[keep]
     out_idx = out_idx[keep]
-    parts = [Tensor(out)]
-    if return_index:
-        parts.append(Tensor(out_idx.astype(jnp.int64)))
+    parts = [Tensor(out)]  # reference order: out, rois_num, index
     if return_rois_num:
         parts.append(Tensor(jnp.asarray([out.shape[0]], jnp.int32)))
+    if return_index:
+        parts.append(Tensor(out_idx.astype(jnp.int64)))
     return parts[0] if len(parts) == 1 else tuple(parts)
 
 

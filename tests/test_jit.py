@@ -119,3 +119,83 @@ def test_static_compat_feed_fetch():
     out2, = exe.run(prog, feed={"x": np.full((2, 4), 3.0, dtype="float32")},
                     fetch_list=[y])
     np.testing.assert_allclose(out2, 48.0)
+
+
+def test_static_nn_control_flow():
+    """paddle.static.nn.cond/while_loop/switch_case work eagerly and traced
+    (the dy2static data-dependent control-flow story)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.static import nn as snn
+
+    x = paddle.to_tensor(np.float32(3.0), stop_gradient=False)
+    out = snn.cond(x > 0, lambda: x * 2, lambda: x - 1)
+    assert float(out) == 6.0
+    out.backward()
+    assert float(x.grad) == 2.0  # grads flow through the taken branch
+
+    # while_loop: sum 0..9
+    i = paddle.to_tensor(np.int64(0))
+    s = paddle.to_tensor(np.float32(0.0))
+    i2, s2 = snn.while_loop(lambda i, s: i < 10,
+                            lambda i, s: [i + 1, s + i.astype("float32")],
+                            [i, s])
+    assert float(s2) == 45.0 and int(i2) == 10
+
+    # switch_case
+    idx = paddle.to_tensor(np.int64(1))
+    r = snn.switch_case(idx, {0: lambda: paddle.to_tensor(np.float32(10.0)),
+                              1: lambda: paddle.to_tensor(np.float32(20.0))})
+    assert float(r) == 20.0
+
+    # inside to_static: data-dependent branch compiles
+    @paddle.jit.to_static
+    def f(v):
+        return snn.cond(v.sum() > 0, lambda: v * 2, lambda: -v)
+
+    a = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"))
+    np.testing.assert_allclose(f(a).numpy(), [2.0, 4.0])
+    b = paddle.to_tensor(np.array([-1.0, -2.0], dtype="float32"))
+    np.testing.assert_allclose(f(b).numpy(), [1.0, 2.0])
+
+    # case: first true predicate wins
+    p1 = paddle.to_tensor(False)
+    p2 = paddle.to_tensor(True)
+    r = snn.case([(p1, lambda: paddle.to_tensor(np.float32(1.0))),
+                  (p2, lambda: paddle.to_tensor(np.float32(2.0)))])
+    assert float(r) == 2.0
+
+
+def test_static_nn_cond_guard_and_layers():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as pnn
+    from paddle_tpu.static import nn as snn
+
+    # guard pattern: untaken branch must not poison gradients with NaN
+    n = paddle.to_tensor(np.float32(0.0))
+    x = paddle.to_tensor(np.float32(3.0), stop_gradient=False)
+    out = snn.cond(n > 0, lambda: x / n, lambda: x * 1.0)
+    assert float(out) == 3.0
+    out.backward()
+    assert np.isfinite(float(x.grad)) and float(x.grad) == 1.0
+
+    # Layers used inside a branch receive gradients
+    paddle.seed(0)
+    lin = pnn.Linear(2, 2)
+    xi = paddle.to_tensor(np.ones((1, 2), dtype="float32"), stop_gradient=False)
+    pred = paddle.to_tensor(True)
+    out = snn.cond(pred, lambda: lin(xi).sum(), lambda: xi.sum())
+    out.backward()
+    assert lin.weight.grad is not None
+    np.testing.assert_allclose(lin.weight.grad.numpy(), np.ones((2, 2)), rtol=1e-6)
+
+    # eager while_loop is differentiable (taped python loop)
+    w = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+    i = paddle.to_tensor(np.int64(0))
+    s = paddle.to_tensor(np.float32(0.0), stop_gradient=False)
+    i2, s2 = snn.while_loop(lambda i, s: i < 3,
+                            lambda i, s: [i + 1, s + w], [i, s])
+    assert float(s2) == 6.0
+    s2.backward()
+    assert float(w.grad) == 3.0
