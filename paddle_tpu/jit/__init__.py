@@ -120,6 +120,9 @@ class StaticFunction:
                 if id(v) not in seen:
                     seen.add(id(v))
                     found.append((name, v))
+            elif hasattr(v, "__self__") and isinstance(v.__self__, Layer):
+                # bound method: fwd = model.forward
+                visit(name, v.__self__)
             elif isinstance(v, dict):  # one container level: {'enc': layer}
                 for k2, v2 in v.items():
                     if isinstance(v2, Layer) and id(v2) not in seen:
@@ -138,7 +141,20 @@ class StaticFunction:
                 except ValueError:
                     continue
                 visit(name, v)
-        for name in code.co_names:
+
+        # global names referenced by fn AND by its nested lambdas/defs (their
+        # co_names live in nested code objects under co_consts)
+        import types as _types
+
+        def all_names(c, depth=0):
+            names = set(c.co_names)
+            if depth < 4:
+                for k in c.co_consts:
+                    if isinstance(k, _types.CodeType):
+                        names |= all_names(k, depth + 1)
+            return names
+
+        for name in sorted(all_names(code)):
             v = getattr(fn, "__globals__", {}).get(name)
             if v is not None:
                 visit(name, v)

@@ -61,6 +61,11 @@ def _convnd(x, weight, bias, stride, padding, dilation, groups, data_format, n,
     dn = _dim_numbers(data_format, n)
 
     def fn(v, w, *b):
+        # bf16-first convenience: a .bfloat16() model fed f32 batches computes
+        # in the weight dtype (lax.conv rejects mixed dtypes, unlike matmul)
+        if v.dtype != w.dtype and jnp.issubdtype(v.dtype, jnp.floating) \
+                and jnp.issubdtype(w.dtype, jnp.floating):
+            v = v.astype(w.dtype)
         out = jax.lax.conv_general_dilated(
             v, w, window_strides=strides, padding=pad,
             rhs_dilation=dil, dimension_numbers=dn,
@@ -103,6 +108,9 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
     def fn(v, w, *b):
         # paddle weight layout for transpose conv: (in, out/groups, *k).
         # conv_transpose via gradient trick: lhs_dilation implements stride.
+        if v.dtype != w.dtype and jnp.issubdtype(v.dtype, jnp.floating) \
+                and jnp.issubdtype(w.dtype, jnp.floating):
+            v = v.astype(w.dtype)
         kshape = w.shape[2:]
         if isinstance(pad, str):
             pads = None

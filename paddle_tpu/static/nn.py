@@ -36,6 +36,8 @@ def _closure_tensors(*fns):
 
     seen, out = set(), []
 
+    import functools as _ft
+
     def visit(v, depth=0):
         if isinstance(v, Tensor):
             if id(v) not in seen:
@@ -49,6 +51,14 @@ def _closure_tensors(*fns):
                 visit(p)
             for b in v.buffers():
                 visit(b)
+        elif hasattr(v, "__self__"):  # bound method: fwd = layer.forward
+            visit(v.__self__, depth)
+        elif isinstance(v, _ft.partial):
+            visit(v.func, depth)
+            for a in v.args:
+                visit(a, depth + 1)
+            for a in v.keywords.values():
+                visit(a, depth + 1)
         elif depth < 2 and isinstance(v, dict):
             for x in v.values():
                 visit(x, depth + 1)
@@ -91,27 +101,54 @@ def _unwrap_tree(tree):
         is_leaf=lambda v: isinstance(v, Tensor))
 
 
+def _is_traced(v):
+    return isinstance(v._value if isinstance(v, Tensor) else v, jax.core.Tracer)
+
+
+def _flatten_branch(out, tree_box):
+    """Flatten a branch's (possibly nested) output for the dispatch layer;
+    the treedef is recorded for reassembly outside."""
+    leaves, tree = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda v: isinstance(v, Tensor))
+    tree_box[0] = tree
+    return tuple(v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                 for v in leaves)
+
+
+def _reassemble(result, tree_box):
+    leaves = list(result) if isinstance(result, tuple) else [result]
+    return jax.tree_util.tree_unflatten(tree_box[0], leaves)
+
+
 def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
-    """Run ``true_fn()`` or ``false_fn()`` by a traced boolean — both branches
-    compile; XLA selects at run time (reference: paddle.static.nn.cond)."""
+    """Run ``true_fn()`` or ``false_fn()`` by a boolean (reference:
+    paddle.static.nn.cond).  Concrete predicate (eager): only the taken
+    branch executes, directly on the tape — dygraph semantics.  Traced
+    predicate: both branches compile inside ``lax.cond`` and XLA selects at
+    run time (the untaken branch neither executes nor contributes vjp)."""
     captured = _closure_tensors(true_fn, false_fn)
     pred_t = pred if isinstance(pred, Tensor) else Tensor(jnp.asarray(pred))
+    if not _is_traced(pred_t):
+        taken = true_fn if bool(pred_t) else false_fn
+        return taken() if taken is not None else None
+
+    tree_box = [None]
 
     def fn(pv, *tvals):
-        # branches trace INSIDE lax.cond — the untaken branch never executes
-        # at run time (guard patterns like x/n protected by the predicate stay
-        # NaN-free, and its vjp contributes nothing)
         def t_branch():
             with _swapped(captured, tvals):
-                return _unwrap_tree(true_fn()) if true_fn is not None else None
+                return _flatten_branch(
+                    true_fn() if true_fn is not None else None, tree_box)
 
         def f_branch():
             with _swapped(captured, tvals):
-                return _unwrap_tree(false_fn()) if false_fn is not None else None
+                return _flatten_branch(
+                    false_fn() if false_fn is not None else None, tree_box)
 
         return jax.lax.cond(pv.reshape(()).astype(bool), t_branch, f_branch)
 
-    return _apply(fn, pred_t, *captured, op_name="cond", n_outs=None)
+    out = _apply(fn, pred_t, *captured, op_name="cond", n_outs=None)
+    return _reassemble(out, tree_box)
 
 
 def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
@@ -172,27 +209,36 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     else:
         fns = list(branch_fns)
         keys = list(range(len(fns)))
-    if default is None:
-        default = fns[-1]
-    captured = _closure_tensors(*fns, default)
+    # default=None means "last branch" — reuse its slot instead of tracing
+    # that branch twice
+    default_slot = len(fns) - 1 if default is None else len(fns)
+    branch_list = list(fns) if default is None else list(fns) + [default]
+    captured = _closure_tensors(*branch_list)
     idx_t = branch_index if isinstance(branch_index, Tensor) else \
         Tensor(jnp.asarray(branch_index))
+    if not _is_traced(idx_t):
+        i = int(idx_t)
+        taken = dict(zip(keys, fns)).get(i, branch_list[default_slot])
+        return taken()
+
+    tree_box = [None]
 
     def fn(iv, *tvals):
         i = iv.reshape(()).astype(jnp.int32)
-        slot = jnp.asarray(len(fns), jnp.int32)  # default
+        slot = jnp.asarray(default_slot, jnp.int32)
         for s, k in enumerate(keys):
             slot = jnp.where(i == k, jnp.int32(s), slot)
 
         def make(f):
             def run():
                 with _swapped(captured, tvals):
-                    return _unwrap_tree(f())
+                    return _flatten_branch(f(), tree_box)
             return run
 
-        return jax.lax.switch(slot, [make(f) for f in fns] + [make(default)])
+        return jax.lax.switch(slot, [make(f) for f in branch_list])
 
-    return _apply(fn, idx_t, *captured, op_name="switch_case", n_outs=None)
+    out = _apply(fn, idx_t, *captured, op_name="switch_case", n_outs=None)
+    return _reassemble(out, tree_box)
 
 
 def case(pred_fn_pairs, default=None, name=None):
@@ -200,24 +246,33 @@ def case(pred_fn_pairs, default=None, name=None):
     preds = [p if isinstance(p, Tensor) else Tensor(jnp.asarray(p))
              for p, _ in pred_fn_pairs]
     fns = [f for _, f in pred_fn_pairs]
-    if default is None:
-        default = fns[-1]
-    captured = _closure_tensors(*fns, default)
+    default_slot = len(fns) - 1 if default is None else len(fns)
+    branch_list = list(fns) if default is None else list(fns) + [default]
+    captured = _closure_tensors(*branch_list)
     n_p = len(preds)
+
+    if not any(_is_traced(p) for p in preds):
+        for p, f in zip(preds, fns):
+            if bool(p):
+                return f()
+        return branch_list[default_slot]()
+
+    tree_box = [None]
 
     def fn(*all_vals):
         pvs = all_vals[:n_p]
         tvals = all_vals[n_p:]
         stacked = jnp.stack([p.reshape(()).astype(bool) for p in pvs])
-        idx = jnp.where(jnp.any(stacked), jnp.argmax(stacked), n_p)
+        idx = jnp.where(jnp.any(stacked), jnp.argmax(stacked), default_slot)
 
         def make(f):
             def run():
                 with _swapped(captured, tvals):
-                    return _unwrap_tree(f())
+                    return _flatten_branch(f(), tree_box)
             return run
 
         return jax.lax.switch(idx.astype(jnp.int32),
-                              [make(f) for f in fns] + [make(default)])
+                              [make(f) for f in branch_list])
 
-    return _apply(fn, *preds, *captured, op_name="case", n_outs=None)
+    out = _apply(fn, *preds, *captured, op_name="case", n_outs=None)
+    return _reassemble(out, tree_box)

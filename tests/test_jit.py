@@ -199,3 +199,43 @@ def test_static_nn_cond_guard_and_layers():
     assert float(s2) == 6.0
     s2.backward()
     assert float(w.grad) == 3.0
+
+
+def test_static_nn_cond_bound_method_and_nested():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as pnn
+    from paddle_tpu.static import nn as snn
+
+    # bound-method capture: layer params must receive grads (traced pred)
+    paddle.seed(3)
+    lin = pnn.Linear(2, 2)
+    fwd = lin.forward
+    xi = paddle.to_tensor(np.ones((1, 2), dtype="float32"), stop_gradient=False)
+
+    @paddle.jit.to_static
+    def f(x):
+        return snn.cond(x.sum() > 0, lambda: fwd(x).sum(), lambda: x.sum())
+
+    out = f(xi)
+    out.backward()
+    assert lin.weight.grad is not None
+
+    # nested branch structures survive (traced)
+    @paddle.jit.to_static
+    def g(x):
+        return snn.cond(x.sum() > 0,
+                        lambda: {"a": x * 2, "b": [x, x + 1]},
+                        lambda: {"a": -x, "b": [x, x - 1]})
+
+    out = g(paddle.to_tensor(np.array([1.0], dtype="float32")))
+    assert isinstance(out, dict) and isinstance(out["b"], list)
+    np.testing.assert_allclose(out["a"].numpy(), [2.0])
+    np.testing.assert_allclose(out["b"][1].numpy(), [2.0])
+
+    # eager concrete predicate: only the taken branch runs (python semantics)
+    calls = []
+    r = snn.cond(paddle.to_tensor(True),
+                 lambda: calls.append("t") or paddle.to_tensor(np.float32(1.0)),
+                 lambda: calls.append("f") or paddle.to_tensor(np.float32(2.0)))
+    assert calls == ["t"] and float(r) == 1.0

@@ -124,3 +124,17 @@ def test_static_cache_unhashable_statics_hit():
     n_first = len(traces)
     f(x, np.full((2, 2), 3.0, dtype="float32"))  # equal content, new object
     assert len(traces) == n_first, "equal unhashable statics must hit the cache"
+
+
+def test_train_step_bf16_native_model():
+    """model.bfloat16() + f32 batches: convs compute in the weight dtype."""
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(5)
+    m = resnet18(num_classes=10).bfloat16()
+    o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 32, 32).astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 10, (2,)).astype("int64"))
+    losses = [float(step(x, y)) for _ in range(3)]
+    assert all(np.isfinite(losses))
