@@ -103,9 +103,10 @@ def new_group(ranks=None, backend=None, timeout=None, axis_name=None) -> Group:
 
 
 def destroy_process_group(group=None):
+    # _NEXT_GID stays monotonic: Group objects can outlive the registry
+    # (fleet hands them out), so ids are never reused for new groups.
     if group is None:
         _GROUPS.clear()
-        _NEXT_GID[0] = 0
     else:
         _GROUPS.pop(group.id, None)
 

@@ -82,15 +82,11 @@ def _reduce_traced(v, op, axis):
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted(gid, kind, op=ReduceOp.SUM, **kw):
-    """One-collective compiled program on group ``gid``'s mesh (built lazily,
-    cached per collective kind / op / static attrs)."""
-    from .collective import get_group
-
-    g = get_group(gid)
-    ax = g.axis_name
-    mesh = g.mesh
-
+def _jitted_cached(mesh, ax, kind, op=ReduceOp.SUM, **kw):
+    """One-collective compiled program over ``ax`` of ``mesh`` (built lazily,
+    cached per mesh/axis/collective kind/op).  Keyed on the mesh itself, not a
+    group-registry id, so it works for any Group-shaped object — including the
+    per-axis views fleet's HybridCommunicateGroup hands out."""
     if kind == "all_reduce":
         def body(x):  # x: [1, *S] block per rank
             return _reduce_traced(x, op, ax)
@@ -108,7 +104,14 @@ def _jitted(gid, kind, op=ReduceOp.SUM, **kw):
         fn = shard_map(body, mesh=mesh, in_specs=P(ax), out_specs=P(None))
     elif kind == "reduce_scatter":
         def body(x):  # [1, n, *S] -> [1, *S]
-            return lax.psum_scatter(x, ax, scatter_dimension=1, tiled=False)
+            if op == ReduceOp.SUM:
+                return lax.psum_scatter(x, ax, scatter_dimension=1, tiled=False)
+            if op == ReduceOp.AVG:
+                n = lax.axis_size(ax)
+                return lax.psum_scatter(x, ax, scatter_dimension=1, tiled=False) / n
+            full = _reduce_traced(x, op, ax)  # [1, n, *S] reduced across ranks
+            return lax.dynamic_index_in_dim(full, lax.axis_index(ax), axis=1,
+                                            keepdims=False)
         fn = shard_map(body, mesh=mesh, in_specs=P(ax), out_specs=P(ax))
     elif kind == "broadcast":
         src = kw["src"]
@@ -124,6 +127,10 @@ def _jitted(gid, kind, op=ReduceOp.SUM, **kw):
     else:
         raise ValueError(kind)
     return jax.jit(fn)
+
+
+def _jitted(g: Group, kind, op=ReduceOp.SUM, **kw):
+    return _jitted_cached(g.mesh, g.axis_name, kind, op, **kw)
 
 
 def _to_group_sharded(v, g: Group):
@@ -142,7 +149,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_strea
     if _is_traced(v):
         out = _reduce_traced(v, op, g.axis_name)
     elif _stacked(v, g):
-        out = _jitted(g.id, "all_reduce", op)(_to_group_sharded(v, g))
+        out = _jitted(g, "all_reduce", op)(_to_group_sharded(v, g))
     else:  # replicated single-controller value
         n = g.nranks
         out = {ReduceOp.SUM: v * n, ReduceOp.PROD: v ** n}.get(op, v)
@@ -158,7 +165,7 @@ def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
     if _is_traced(v):
         out = _reduce_traced(v, op, g.axis_name)
     elif _stacked(v, g):
-        out = _jitted(g.id, "reduce", op, dst=g.get_group_rank(dst) if dst in g.ranks else dst)(
+        out = _jitted(g, "reduce", op, dst=g.get_group_rank(dst) if dst in g.ranks else dst)(
             _to_group_sharded(v, g))
     else:
         n = g.nranks
@@ -180,7 +187,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
             tensor_list.extend(Tensor(out[i]) for i in range(g.nranks))
         return Tensor(out)
     if _stacked(v, g):
-        full = _jitted(g.id, "all_gather")(_to_group_sharded(v, g))
+        full = _jitted(g, "all_gather")(_to_group_sharded(v, g))
     else:
         full = jnp.stack([v] * g.nranks)
     if tensor_list is not None:
@@ -205,9 +212,18 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
     else:
         v = _unwrap(tensor_list)
     if _is_traced(v):
-        out = lax.psum_scatter(v, g.axis_name, scatter_dimension=0, tiled=False)
+        ax = g.axis_name
+        if op == ReduceOp.SUM:
+            out = lax.psum_scatter(v, ax, scatter_dimension=0, tiled=False)
+        elif op == ReduceOp.AVG:
+            out = lax.psum_scatter(v, ax, scatter_dimension=0, tiled=False) \
+                / lax.axis_size(ax)
+        else:
+            full = _reduce_traced(v, op, ax)
+            out = lax.dynamic_index_in_dim(full, lax.axis_index(ax), axis=0,
+                                           keepdims=False)
     elif v.ndim >= 2 and v.shape[0] == g.nranks and v.shape[1] == g.nranks:
-        out = _jitted(g.id, "reduce_scatter", op)(_to_group_sharded(v, g))
+        out = _jitted(g, "reduce_scatter", op)(_to_group_sharded(v, g))
     else:
         out = v
     if isinstance(tensor, Tensor):
@@ -224,7 +240,7 @@ def broadcast(tensor, src, group=None, sync_op=True):
         full = lax.all_gather(v, g.axis_name, axis=0)
         out = full[src_local]
     elif _stacked(v, g):
-        out = _jitted(g.id, "broadcast", src=src_local)(_to_group_sharded(v, g))
+        out = _jitted(g, "broadcast", src=src_local)(_to_group_sharded(v, g))
     else:
         out = v
     if isinstance(tensor, Tensor):
@@ -259,7 +275,7 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     if _is_traced(v):
         out = lax.all_to_all(v, g.axis_name, split_axis=0, concat_axis=0, tiled=True)
     elif v.ndim >= 2 and v.shape[0] == g.nranks and v.shape[1] == g.nranks:
-        out = _jitted(g.id, "alltoall")(_to_group_sharded(v, g))
+        out = _jitted(g, "alltoall")(_to_group_sharded(v, g))
     else:
         out = v
     if isinstance(out_tensor_list, list):
@@ -277,7 +293,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
     elif v.ndim >= 1 and v.shape[0] == n * n:
         # stacked layout [n*n, ...]: rank j holds rows [j*n, (j+1)*n)
         v2 = v.reshape((n, n) + tuple(v.shape[1:]))
-        out = _jitted(g.id, "alltoall")(_to_group_sharded(v2, g)).reshape(v.shape)
+        out = _jitted(g, "alltoall")(_to_group_sharded(v2, g)).reshape(v.shape)
     else:
         out = v
     if isinstance(out_tensor, Tensor):
@@ -292,16 +308,22 @@ _MAILBOX: dict = {}
 
 def send(tensor, dst=0, group=None, sync_op=True):
     """Eager p2p for API parity (single-controller: a device-to-device copy
-    through a mailbox).  In-step PP p2p uses lax.ppermute (fleet.meta_parallel)."""
+    through a FIFO mailbox).  Delivery is matched on the SENDER's process
+    index against recv's ``src`` — ``dst`` is accepted for API fidelity but
+    all ranks live in this one process, so it cannot select a receiver.
+    In-step PP p2p uses lax.ppermute (fleet.meta_parallel)."""
     g = _group(group)
     src = jax.process_index()
-    _MAILBOX[(src, dst, g.id)] = _unwrap(tensor)
+    q = _MAILBOX.setdefault((src, g.id), [])
+    q.append(_unwrap(tensor))
+    if len(q) > 64:  # bound the shim: unmatched sends must not leak HBM
+        q.pop(0)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     g = _group(group)
-    dst = jax.process_index()
-    v = _MAILBOX.pop((src, dst, g.id), None)
+    q = _MAILBOX.get((src, g.id))
+    v = q.pop(0) if q else None
     if v is None:
         raise RuntimeError(f"recv: nothing sent from rank {src} (eager p2p mailbox)")
     if isinstance(tensor, Tensor):
@@ -331,7 +353,7 @@ def barrier(group=None):
     if g.nranks <= 1:
         return
     one = jnp.ones((g.nranks,), jnp.int32)
-    out = _jitted(g.id, "all_reduce", ReduceOp.SUM)(_to_group_sharded(one, g))
+    out = _jitted(g, "all_reduce", ReduceOp.SUM)(_to_group_sharded(one, g))
     jax.block_until_ready(out)
 
 

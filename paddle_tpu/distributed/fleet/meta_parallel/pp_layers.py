@@ -168,18 +168,17 @@ class PipelineParallel(Layer):
 
         x, y = data
         loss_fn = loss_fn or self._layers._loss_fn or (lambda out, lbl: out.mean())
-        if self._train_step is None or self._train_step.optimizer is not optimizer:
-            self._train_step = TrainStep(self._layers, optimizer, loss_fn=loss_fn)
         m = self._micro_batches
         bsz = x.shape[0]
         if bsz % m:
             raise ValueError(f"batch {bsz} not divisible by accumulate_steps {m}")
-        micro = bsz // m
-        total = 0.0
-        for i in range(m):
-            xs = x[i * micro:(i + 1) * micro]
-            ys = y[i * micro:(i + 1) * micro]
-            total += float(self._train_step(xs, ys))
+        if self._train_step is None or self._train_step.optimizer is not optimizer:
+            # one fused program: grads accumulated over the m micro-batches
+            # inside the step (lax.scan), ONE optimizer update per call —
+            # the reference's gradient-merge semantics.
+            self._train_step = TrainStep(self._layers, optimizer,
+                                         loss_fn=loss_fn, accumulate_steps=m)
+        loss = self._train_step(x, y)
         if lr_scheduler is not None:
             lr_scheduler.step()
-        return Tensor(total / m)
+        return Tensor(loss._value if isinstance(loss, Tensor) else loss)

@@ -90,16 +90,20 @@ class HybridCommunicateGroup:
         arr = np.asarray(devs[:n]).reshape(tuple(dims.values()))
         self.mesh = Mesh(arr, tuple(dims.keys()))
         self._dims = dims
-        from .collective import Group
+        from . import collective
 
         self._groups = {}
         for name in dims:
             ranks = topology.get_comm_list(name)[0]
-            g = Group.__new__(Group)
+            g = collective.Group.__new__(collective.Group)
             g.ranks = ranks
-            g.id = hash((id(self), name)) & 0x7FFFFFFF
             g.axis_name = name
             g.mesh = self.mesh
+            # register so eager paddle.distributed.* calls resolve this group
+            # (get_group parity with the reference's per-axis NCCL groups)
+            collective._NEXT_GID[0] += 1
+            g.id = collective._NEXT_GID[0]
+            collective._GROUPS[g.id] = g
             self._groups[name] = g
 
     # degrees
@@ -180,6 +184,14 @@ _HCG = [None]
 
 
 def set_hybrid_communicate_group(hcg):
+    prev = _HCG[0]
+    if prev is not None and prev is not hcg:
+        # unregister the replaced hcg's per-axis groups so repeated
+        # fleet.init in one process doesn't grow the registry unboundedly
+        from . import collective
+
+        for g in getattr(prev, "_groups", {}).values():
+            collective._GROUPS.pop(g.id, None)
     _HCG[0] = hcg
 
 

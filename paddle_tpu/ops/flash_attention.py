@@ -214,6 +214,8 @@ def supported(q_shape, k_shape, causal=False) -> bool:
         return False
     b, sq, h, d = q_shape
     sk = k_shape[1]
+    if k_shape[2] != h:  # GQA/MQA (h_kv != h_q) not handled by the kernel
+        return False
     if d > 256:
         return False
     if sq < 2 * MIN_BLOCK:

@@ -227,7 +227,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0., nms_top_k=400
         comp = upper.max(axis=0)                # comp_i: overlap with above-i
         pair_mask = jnp.triu(jnp.ones((m, m), bool), k=1)
         if use_gaussian:
-            ratio = jnp.exp(-(upper ** 2 - comp[:, None] ** 2) / gaussian_sigma)
+            ratio = jnp.exp(-gaussian_sigma * (upper ** 2 - comp[:, None] ** 2))
         else:
             ratio = (1 - upper) / jnp.maximum(1 - comp[:, None], 1e-9)
         ratio = jnp.where(pair_mask, ratio, 1.0)
