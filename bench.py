@@ -1,13 +1,17 @@
 """Driver benchmark: ResNet-50 fused-train-step throughput on the real chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-vs_baseline compares against a pure-JAX hand-written NHWC bf16 ResNet-50
-fwd+bwd measured on the same chip class (2707 imgs/sec on the v5e-1 via the
-axon tunnel, this session) — i.e. value 1.0 means "the framework trains as
-fast as raw JAX on identical hardware", which is the honest single-chip
-ceiling (BASELINE.md has no retrievable reference numbers; the v5e-256-pod
-numbers in BASELINE.json are not measurable on one chip).
+vs_baseline compares against a hand-written raw-JAX NHWC bf16 ResNet-50 FULL
+train step (benchmarks/raw_resnet50.py: fwd+bwd, BN batch+running stats, CE,
+momentum+wd update, donated single jit) measured IN THE SAME RUN on the same
+chip — i.e. 1.0 means "the framework trains exactly as fast as expert
+hand-written JAX on identical hardware under identical conditions".  The
+baseline is re-measured each run because the axon-tunneled chip's absolute
+throughput drifts between sessions (round-2 recorded 2707 imgs/s for the
+same raw program; the same-run measurement removes that drift from the
+ratio).  BASELINE.md has no retrievable reference numbers; the v5e-256-pod
+numbers in BASELINE.json are not measurable on one chip.
 """
 
 import json
@@ -16,16 +20,13 @@ import time
 
 import numpy as np
 
-PURE_JAX_BASELINE_IPS = 2707.0  # hand-written jax NHWC bf16 fwd+bwd, same chip
 
-
-def main():
+def measure_framework(B=128, iters=15):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     import paddle_tpu.optimizer as opt
     from paddle_tpu.vision.models import resnet50
 
-    B = 128
     paddle.seed(0)
     m = resnet50(num_classes=1000)
     o = opt.Momentum(learning_rate=0.1, momentum=0.9, parameters=m.parameters(),
@@ -37,18 +38,27 @@ def main():
 
     loss = step(x, y)  # compile
     float(loss)
-    n = 15
     t0 = time.time()
-    for _ in range(n):
+    for _ in range(iters):
         loss = step(x, y)
     float(loss)  # host sync
-    dt = (time.time() - t0) / n
-    ips = B / dt
+    dt = (time.time() - t0) / iters
+    return B / dt
+
+
+def main():
+    B = 128
+    fw_ips = measure_framework(B)
+    from benchmarks.raw_resnet50 import measure as measure_raw
+
+    raw_ips = measure_raw(B)
     print(json.dumps({
         "metric": "resnet50_train_imgs_per_sec",
-        "value": round(ips, 1),
+        "value": round(fw_ips, 1),
         "unit": "imgs/sec (bf16 O2, B=128, fused train step, 1 chip)",
-        "vs_baseline": round(ips / PURE_JAX_BASELINE_IPS, 3),
+        "vs_baseline": round(fw_ips / raw_ips, 3),
+        "baseline_imgs_per_sec_same_run": round(raw_ips, 1),
+        "baseline": "hand-written raw-JAX NHWC bf16 full train step, same run/chip",
     }))
 
 

@@ -1,0 +1,146 @@
+"""Hand-written raw-JAX ResNet-50 training step — the bench.py calibration
+baseline.
+
+This is the "what a JAX expert would write by hand for this exact job"
+program: NHWC bf16 compute, f32 params cast in-graph (O2 recipe), BN batch
+statistics + running-stat update, softmax cross-entropy, SGD momentum with
+weight decay, all in ONE donated jit.  bench.py measures it in the same
+process/run as the framework step so `vs_baseline` compares identical
+hardware, tunnel conditions, and measurement method (the axon chip's
+throughput drifts across sessions, so a hardcoded number would not be an
+honest denominator).
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+CFG = [(64, 256, 3, 1), (256, 512, 4, 2), (512, 1024, 6, 2), (1024, 2048, 3, 2)]
+
+
+def _conv(x, w, stride=1):
+    k = w.shape[0]
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), [(k // 2, k // 2)] * 2,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _init_conv(key, cin, cout, k):
+    # np.float32: a bare np.sqrt is a strong-typed f64 scalar and would
+    # silently promote every parameter to f64 under jax_enable_x64
+    return jax.random.normal(key, (k, k, cin, cout), jnp.float32) \
+        * np.float32(np.sqrt(2.0 / (cin * k * k)))
+
+
+def build_params(key):
+    ps, bn = [], []
+    keys = iter(jax.random.split(key, 200))
+    ps.append(_init_conv(next(keys), 3, 64, 7))
+    bn.append((jnp.ones(64, jnp.float32), jnp.zeros(64, jnp.float32)))
+    cin = 64
+    for (_, cout, blocks, _stride) in CFG:
+        mid = cout // 4
+        for b in range(blocks):
+            ps.append(_init_conv(next(keys), cin, mid, 1)); bn.append((jnp.ones(mid, jnp.float32), jnp.zeros(mid, jnp.float32)))
+            ps.append(_init_conv(next(keys), mid, mid, 3)); bn.append((jnp.ones(mid, jnp.float32), jnp.zeros(mid, jnp.float32)))
+            ps.append(_init_conv(next(keys), mid, cout, 1)); bn.append((jnp.ones(cout, jnp.float32), jnp.zeros(cout, jnp.float32)))
+            if b == 0:
+                ps.append(_init_conv(next(keys), cin, cout, 1)); bn.append((jnp.ones(cout, jnp.float32), jnp.zeros(cout, jnp.float32)))
+            cin = cout
+    fcw = jax.random.normal(next(keys), (2048, 1000), jnp.float32) * 0.01
+    run = [(jnp.zeros(g.shape, jnp.float32), jnp.ones(g.shape, jnp.float32)) for g, _ in bn]
+    return {"convs": ps, "bn": bn, "fc": (fcw, jnp.zeros(1000, jnp.float32))}, run
+
+
+def _bn(x, gamma, beta):
+    m = jnp.mean(x, axis=(0, 1, 2))
+    v = jnp.var(x, axis=(0, 1, 2))
+    out = (x - m.reshape(1, 1, 1, -1)) * jax.lax.rsqrt(v.reshape(1, 1, 1, -1) + 1e-5)
+    out = out * gamma.astype(x.dtype).reshape(1, 1, 1, -1) \
+        + beta.astype(x.dtype).reshape(1, 1, 1, -1)
+    return out, (jax.lax.stop_gradient(m), jax.lax.stop_gradient(v))
+
+
+def forward(params, x):
+    stats = []
+    ci = iter(range(len(params["convs"])))
+    cv, bns = params["convs"], params["bn"]
+
+    def cbr(h, i, stride=1, relu=True):
+        o = _conv(h, cv[i].astype(jnp.bfloat16), stride)
+        o, st = _bn(o, *bns[i])
+        stats.append(st)
+        return jax.nn.relu(o) if relu else o
+
+    x = x.astype(jnp.bfloat16)
+    i = next(ci)
+    h = jax.lax.conv_general_dilated(
+        x, cv[i].astype(jnp.bfloat16), (2, 2), [(3, 3), (3, 3)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    h, st = _bn(h, *bns[i]); stats.append(st)
+    h = jax.nn.relu(h)
+    h = jax.lax.reduce_window(h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                              ((0, 0), (1, 1), (1, 1), (0, 0)))
+    for (_, _cout, blocks, stride) in CFG:
+        for b in range(blocks):
+            s = stride if b == 0 else 1
+            idn = h
+            o = cbr(h, next(ci), s)
+            o = cbr(o, next(ci))
+            o = cbr(o, next(ci), relu=False)
+            if b == 0:
+                idn = cbr(h, next(ci), s, relu=False)
+            h = jax.nn.relu(o + idn)
+    h = jnp.mean(h, axis=(1, 2))
+    fcw, fcb = params["fc"]
+    logits = h.astype(jnp.float32) @ fcw + fcb
+    return logits, stats
+
+
+def loss_fn(params, x, y):
+    logits, stats = forward(params, x)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    nll = lse - jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
+    return nll.mean(), stats
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def train_step(params, mom, run, x, y):
+    (l, stats), g = jax.value_and_grad(loss_fn, has_aux=True)(params, x, y)
+
+    def sgd(p, m, gr):
+        gr = gr + 1e-4 * p
+        m2 = 0.9 * m + gr
+        return p - 0.1 * m2, m2
+
+    flat_p, td = jax.tree_util.tree_flatten(params)
+    flat_m = jax.tree_util.tree_flatten(mom)[0]
+    flat_g = jax.tree_util.tree_flatten(g)[0]
+    out = [sgd(p, m, gr) for p, m, gr in zip(flat_p, flat_m, flat_g)]
+    new_p = jax.tree_util.tree_unflatten(td, [o[0] for o in out])
+    new_m = jax.tree_util.tree_unflatten(td, [o[1] for o in out])
+    new_run = [(0.9 * rm + 0.1 * m, 0.9 * rv + 0.1 * v)
+               for (rm, rv), (m, v) in zip(run, stats)]
+    return l, new_p, new_m, new_run
+
+
+def measure(batch_size=128, iters=15):
+    """imgs/sec of the raw train step (same timing method as bench.py)."""
+    import time
+
+    params, run = build_params(jax.random.key(0))
+    mom = jax.tree_util.tree_map(jnp.zeros_like, params)
+    x = jnp.asarray(np.random.RandomState(0).randn(
+        batch_size, 224, 224, 3).astype("float32"))
+    y = jnp.asarray(np.random.RandomState(1).randint(
+        0, 1000, (batch_size,)).astype("int32"))
+    l, params, mom, run = train_step(params, mom, run, x, y)
+    float(l)
+    t0 = time.time()
+    for _ in range(iters):
+        l, params, mom, run = train_step(params, mom, run, x, y)
+    float(l)
+    dt = (time.time() - t0) / iters
+    return batch_size / dt

@@ -26,9 +26,13 @@ BLACK_LIST = {
     "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
     "softmax", "log_softmax", "cross_entropy", "nll_loss", "mse_loss", "l1_loss",
     "bce_with_logits", "binary_cross_entropy", "kl_div", "sum", "mean", "norm",
-    "logsumexp", "layer_norm", "batch_norm", "group_norm", "cumsum", "var", "std",
+    "logsumexp", "cumsum", "var", "std",
     "sigmoid_focal_loss", "softmax_with_cross_entropy",
 }
+# NOTE: batch_norm/layer_norm/group_norm are deliberately NOT black-listed:
+# their kernels compute statistics in f32 internally and keep the big
+# elementwise math in the amp dtype — casting the whole activation to f32
+# (the reference GPU recipe) costs ~20% extra HBM traffic on TPU.
 
 
 class AmpState:

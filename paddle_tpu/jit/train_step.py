@@ -69,20 +69,30 @@ class TrainStep:
         self._bnames = [k for k, _ in named_b]
         self._btensors = [b for _, b in named_b]
 
-        # live state (jax arrays), rebound into the model after every step
-        self._params = OrderedDict(
+        # live state (jax arrays), rebound into the model after every step.
+        # Plain dicts throughout: jit OUTPUTS are plain dicts, and a treedef
+        # change (OrderedDict in, dict back in) would retrace on step 2.
+        params = dict(
             (k, p._master if p._master is not None else p._value) for k, p in named_p)
         self._master = {k: p._master is not None for k, p in named_p}
-        self._buffers = OrderedDict((k, b._value) for k, b in named_b)
-        diff_params = OrderedDict(
-            (k, v) for (k, v), d in zip(self._params.items(), self._diff) if d)
-        self._opt_state = optimizer.functional_init(diff_params)
+        self._buffers = dict((k, b._value) for k, b in named_b)
+        # split once: the jitted step takes the diff/frozen dicts wholesale so
+        # __call__ does no per-step dict rebuilding (host overhead matters
+        # through the dispatch tunnel)
+        self._diff_params = dict(
+            (k, v) for (k, v), d in zip(params.items(), self._diff) if d)
+        self._frozen_params = dict(
+            (k, v) for (k, v), d in zip(params.items(), self._diff) if not d)
+        self._opt_state = optimizer.functional_init(self._diff_params)
         self._leaf_meta = optimizer.resolve_leaf_meta(
             OrderedDict((k, t) for (k, t), d in zip(zip(self._pnames, self._ptensors),
                                                     self._diff) if d))
         self._step_count = 0
         self._compiled = {}
         self._donate = donate
+        self._lr_float = None
+        self._lr_dev = None
+        self._rng_carry = None
 
         # ZeRO: group_sharded_parallel marks the optimizer; lay the fresh
         # functional states out over the sharding axis (donation keeps it)
@@ -93,8 +103,15 @@ class TrainStep:
 
     # ------------------------------------------------------------------ call
     def __call__(self, *batch):
-        lr = jnp.asarray(self._lr_value(), jnp.float32)
-        key = _rng.next_key()
+        lr_f = self._lr_value()
+        if lr_f != self._lr_float:  # upload the lr scalar only when it changes
+            self._lr_float = lr_f
+            self._lr_dev = jnp.asarray(lr_f, jnp.float32)
+        if self._rng_carry is None:
+            # per-step keys are fold_in(base, t) computed INSIDE the program;
+            # the (base, counter) carry lives on device and is donated, so a
+            # step costs zero host-side RNG dispatches.
+            self._rng_carry = (_rng.next_key(), jnp.zeros((), jnp.uint32))
         leaves, treedef = jax.tree_util.tree_flatten(
             batch, is_leaf=lambda x: isinstance(x, Tensor))
         vals = [x._value if isinstance(x, Tensor) else jnp.asarray(x) for x in leaves]
@@ -104,14 +121,10 @@ class TrainStep:
         if fn is None:
             fn = self._build(treedef, bool(self.model.training))
             self._compiled[avals] = fn
-        diff_params = OrderedDict(
-            (k, v) for (k, v), d in zip(self._params.items(), self._diff) if d)
-        frozen = OrderedDict(
-            (k, v) for (k, v), d in zip(self._params.items(), self._diff) if not d)
-        out = fn(diff_params, self._opt_state, dict(self._buffers), frozen, lr, key, *vals)
-        loss, new_params, self._opt_state, new_buffers, outs = out
-        self._params.update(new_params)
-        self._buffers.update(new_buffers)
+        out = fn(self._diff_params, self._opt_state, self._buffers,
+                 self._frozen_params, self._lr_dev, self._rng_carry, *vals)
+        loss, self._diff_params, self._opt_state, self._buffers, outs, \
+            self._rng_carry = out
         self._step_count += 1
         self._rebind()
         loss_t = Tensor(loss, stop_gradient=True)
@@ -136,7 +149,9 @@ class TrainStep:
 
         tree_box = [None]  # out-treedef recorded at trace time, per variant
 
-        def step(diff_params, opt_state, buffers, frozen, lr, key, *vals):
+        def step(diff_params, opt_state, buffers, frozen, lr, rng_carry, *vals):
+            base_key, rng_counter = rng_carry
+            key = jax.random.fold_in(base_key, rng_counter)
             def loss_of_with(dp, vals, buffers, key):
                 bind_p = dict(dp)
                 # O2 master weights: compute runs on an amp-dtype cast of the
@@ -224,23 +239,35 @@ class TrainStep:
                     loss_of, has_aux=True)(diff_params)
             new_p, new_s = opt.functional_update(
                 diff_params, grads, opt_state, lr, leaf_meta=leaf_meta)
-            return loss, new_p, new_s, newb, outs
+            return loss, new_p, new_s, newb, outs, (base_key, rng_counter + 1)
 
-        donate = (0, 1, 2) if self._donate else ()
+        donate = (0, 1, 2, 5) if self._donate else ()
         jitted = jax.jit(step, donate_argnums=donate)
 
         def runner(*args):
             return jitted(*args)
 
         runner._tree_box = tree_box
+        runner._jitted = jitted  # exposed for lowering/inspection (profiler, tests)
         return runner
 
     # ------------------------------------------------------------ state sync
+    @property
+    def _params(self):
+        """Merged name->array view (diff + frozen), for state_dict/debug."""
+        merged = OrderedDict()
+        for k in self._pnames:
+            d = self._diff_params
+            merged[k] = d[k] if k in d else self._frozen_params[k]
+        return merged
+
     def _rebind(self):
         """Point model Parameters/buffers at the fresh arrays (in-place
         discipline: a handful of attribute writes, no device work)."""
         for k, p in zip(self._pnames, self._ptensors):
-            v = self._params[k]
+            if k not in self._diff_params:
+                continue  # frozen params never move
+            v = self._diff_params[k]
             if self._master[k]:
                 p._master = v
                 p._value = v.astype(p._value.dtype)
@@ -264,7 +291,11 @@ class TrainStep:
                 "opt_state": self._opt_state, "step": self._step_count}
 
     def set_state_dict(self, sd):
-        self._params.update(sd["params"])
+        for k, v in sd["params"].items():
+            if k in self._diff_params:
+                self._diff_params[k] = v
+            else:
+                self._frozen_params[k] = v
         self._buffers.update(sd["buffers"])
         self._opt_state = sd["opt_state"]
         self._step_count = sd.get("step", 0)

@@ -33,18 +33,27 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
 
     def fn(v, *wb):
         # stats computed INSIDE the op (grads flow through them); the op
-        # also returns them so the running update reuses the same values
+        # also returns them so the running update reuses the same values.
+        # TPU/amp recipe: statistics in f32 (the converts fuse into the
+        # reductions), then ONE scale+shift on the big tensor in its own
+        # dtype — keeps bf16 activations bf16 end-to-end instead of the
+        # reference's cast-whole-tensor-to-f32 black-list behavior.
+        f32 = jnp.float32
         if use_batch:
-            m = jnp.mean(v, axis=reduce_axes)
-            var = jnp.var(v, axis=reduce_axes)
+            vf = v.astype(f32)
+            m = jnp.mean(vf, axis=reduce_axes)
+            var = jnp.var(vf, axis=reduce_axes)
         else:
-            m, var = mean_used, var_used
-        out = (v - m.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+            m, var = mean_used.astype(f32), var_used.astype(f32)
+        scale = jax.lax.rsqrt(var + epsilon)
         wb = list(wb)
         if weight is not None:
-            out = out * wb.pop(0).reshape(shape)
+            scale = scale * wb.pop(0).astype(f32)
+        offset = -m * scale
         if bias is not None:
-            out = out + wb.pop(0).reshape(shape)
+            offset = offset + wb.pop(0).astype(f32)
+        out = v * scale.astype(v.dtype).reshape(shape) \
+            + offset.astype(v.dtype).reshape(shape)
         return out, jax.lax.stop_gradient(m), jax.lax.stop_gradient(var)
 
     args = [x] + [t for t in (weight, bias) if t is not None]
@@ -63,15 +72,17 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     n = len(tuple(normalized_shape))
 
     def fn(v, *wb):
+        # f32 statistics, elementwise math in the input dtype (see batch_norm)
         axes = tuple(range(v.ndim - n, v.ndim))
-        m = jnp.mean(v, axis=axes, keepdims=True)
-        var = jnp.var(v, axis=axes, keepdims=True)
-        out = (v - m) * jax.lax.rsqrt(var + epsilon)
+        vf = v.astype(jnp.float32)
+        m = jnp.mean(vf, axis=axes, keepdims=True)
+        var = jnp.var(vf, axis=axes, keepdims=True)
+        out = ((vf - m) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
         wb = list(wb)
         if weight is not None:
-            out = out * wb.pop(0)
+            out = out * wb.pop(0).astype(v.dtype)
         if bias is not None:
-            out = out + wb.pop(0)
+            out = out + wb.pop(0).astype(v.dtype)
         return out
 
     args = [x] + [t for t in (weight, bias) if t is not None]
@@ -87,15 +98,16 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
         rest = vm.shape[2:]
         g = vm.reshape((N, num_groups, C // num_groups) + rest)
         axes = tuple(range(2, g.ndim))
-        m = jnp.mean(g, axis=axes, keepdims=True)
-        var = jnp.var(g, axis=axes, keepdims=True)
-        out = ((g - m) * jax.lax.rsqrt(var + epsilon)).reshape(vm.shape)
+        gf = g.astype(jnp.float32)  # f32 statistics (see batch_norm)
+        m = jnp.mean(gf, axis=axes, keepdims=True)
+        var = jnp.var(gf, axis=axes, keepdims=True)
+        out = ((gf - m) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype).reshape(vm.shape)
         wb = list(wb)
         shape = [1, C] + [1] * len(rest)
         if weight is not None:
-            out = out * wb.pop(0).reshape(shape)
+            out = out * wb.pop(0).astype(v.dtype).reshape(shape)
         if bias is not None:
-            out = out + wb.pop(0).reshape(shape)
+            out = out + wb.pop(0).astype(v.dtype).reshape(shape)
         return jnp.moveaxis(out, 1, ch) if ch != 1 else out
 
     args = [x] + [t for t in (weight, bias) if t is not None]
