@@ -215,9 +215,13 @@ _TP_DIM = {
 }
 
 
-def stack_block_params(model: GPTModel, pp: int):
+def stack_block_params(model: GPTModel, pp: int, order="stage"):
     """Stack the (structurally identical) decoder blocks' parameters into
     [pp, layers_per_stage, ...] pytrees for the SPMD pipeline engine.
+    ``order='stage'`` places layer j at [j // per, j % per] (contiguous
+    chunks per rank — the gpipe schedule); ``order='lap'`` places layer j
+    at [j % pp, j // pp] (round-robin virtual stages — what the circular /
+    interleaved schedule executes lap-major).
     Returns (stacked, specs): specs shard the stage dim over 'pp' and the
     TP dim (per _TP_DIM) over 'mp' when the model was built tensor-parallel."""
     from jax.sharding import PartitionSpec as P
@@ -235,7 +239,10 @@ def stack_block_params(model: GPTModel, pp: int):
             p = dict(layer.named_parameters())[name]
             leaves.append(p._value)
         arr = jnp.stack(leaves)  # [n_layers, ...]
-        stacked[name] = arr.reshape((pp, per) + arr.shape[1:])
+        if order == "lap":
+            stacked[name] = arr.reshape((per, pp) + arr.shape[1:]).swapaxes(0, 1)
+        else:
+            stacked[name] = arr.reshape((pp, per) + arr.shape[1:])
         entries = ["pp", None] + [None] * (arr.ndim - 1)
         tp_dim = _TP_DIM.get(name)
         if mp > 1 and tp_dim is not None:
@@ -262,6 +269,18 @@ def block_fn_for(model: GPTModel):
     return block_fn
 
 
+def single_block_fn_for(model: GPTModel):
+    """(one-layer params, x) -> x — the per-VIRTUAL-stage body the circular
+    (interleaved) schedule calls once per lap."""
+    block = model.layers[0]
+
+    def block_fn(stage_params, x):
+        with block.bind(stage_params, {}):
+            return block(Tensor(x))._value
+
+    return block_fn
+
+
 class GPTForCausalLMPipe(Layer):
     """GPTForCausalLM with the decoder stack run through the SPMD pipeline
     engine (reference analog: PaddleNLP's GPTForCausalLMPipe built on
@@ -269,7 +288,7 @@ class GPTForCausalLMPipe(Layer):
     manual pp (x mp x dp)."""
 
     def __init__(self, lm: "GPTForCausalLM" = None, mesh=None, n_micro=1,
-                 batch_axis=None, **kwargs):
+                 batch_axis=None, schedule="gpipe", **kwargs):
         super().__init__()
         self.lm = lm if lm is not None else GPTForCausalLM(**kwargs)
         if mesh is None:
@@ -282,11 +301,13 @@ class GPTForCausalLMPipe(Layer):
         self._mesh = mesh
         self._n_micro = n_micro
         self._batch_axis = batch_axis
+        self._schedule = schedule
 
     def forward(self, input_ids, labels=None):
         hidden = pipeline_forward(self.lm.gpt, input_ids, self._mesh,
                                   self._n_micro, axis="pp",
-                                  batch_axis=self._batch_axis)
+                                  batch_axis=self._batch_axis,
+                                  schedule=self._schedule)
         w = self.lm.gpt.word_embeddings.weight
         logits = _apply(lambda h, wv: h @ wv.T, hidden, w, op_name="matmul")
         if labels is not None:
@@ -297,20 +318,26 @@ class GPTForCausalLMPipe(Layer):
 
 
 def pipeline_forward(model: GPTModel, input_ids, mesh, n_micro, axis="pp",
-                     batch_axis=None):
+                     batch_axis=None, schedule="gpipe"):
     """Full GPT forward with the decoder stack pipelined over ``axis``:
     embed (all ranks, partitioner-sharded) -> spmd_pipeline(blocks, manual
     pp x mp x dp) -> final_ln.  input_ids: [B, S]; B divides into n_micro
-    micro-batches."""
+    micro-batches.  ``schedule='interleaved'`` runs the circular virtual-
+    stage schedule (layer j on rank j % pp), shrinking the fill/drain bubble
+    by ~layers_per_stage."""
     from ...distributed.fleet.meta_parallel import spmd_pipeline
 
     pp = mesh.shape[axis]
-    stacked, specs = stack_block_params(model, pp)
+    order = "lap" if schedule == "interleaved" else "stage"
+    stacked, specs = stack_block_params(model, pp, order=order)
     x = model.embed(input_ids)
     B = x.shape[0]
     micro = B // n_micro
     xm = x._value.reshape((n_micro, micro) + tuple(x.shape[1:]))
-    out = spmd_pipeline(block_fn_for(model), stacked, xm, mesh, axis=axis,
-                        batch_axis=batch_axis, param_specs=specs)
+    fn = single_block_fn_for(model) if schedule == "interleaved" \
+        else block_fn_for(model)
+    out = spmd_pipeline(fn, stacked, xm, mesh, axis=axis,
+                        batch_axis=batch_axis, param_specs=specs,
+                        schedule=schedule)
     out = out.reshape((B,) + tuple(x.shape[1:]))
     return model.final_ln(Tensor(out))

@@ -329,3 +329,128 @@ def test_send_recv_mailbox():
     out = paddle.to_tensor(np.zeros(4, dtype="float32"))
     dist.recv(out, src=0)
     np.testing.assert_allclose(out.numpy(), t.numpy())
+
+
+def test_spmd_pipeline_interleaved_parity():
+    """Circular/virtual-stage schedule == sequential v*S blocks (fwd + grad)."""
+    from paddle_tpu.distributed.fleet.meta_parallel import spmd_pipeline
+    from jax.sharding import Mesh
+
+    S, v, M, micro, D = 4, 2, 8, 2, 12
+    rng = np.random.RandomState(1)
+    Ws = jnp.asarray(rng.randn(S, v, D, D).astype("float32") * 0.3)
+    bs = jnp.asarray(rng.randn(S, v, D).astype("float32") * 0.1)
+    x = jnp.asarray(rng.randn(M, micro, D).astype("float32"))
+
+    def block(params, h):  # one VIRTUAL stage
+        W, b = params
+        return jnp.tanh(h @ W + b)
+
+    # reference: virtual stage order is lap-major (rank 0..S-1 for lap 0,
+    # then rank 0..S-1 for lap 1, ...)
+    ref = x
+    for lap in range(v):
+        for s in range(S):
+            ref = block((Ws[s, lap], bs[s, lap]), ref)
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("dp", "pp"))
+    out = spmd_pipeline(block, (Ws, bs), x, mesh, axis="pp",
+                        schedule="interleaved")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=1e-5)
+
+    g1 = jax.grad(lambda W, b: spmd_pipeline(
+        block, (W, b), x, mesh, axis="pp", schedule="interleaved").sum())(Ws, bs)
+
+    def seq(W, b):
+        h = x
+        for lap in range(v):
+            for s in range(S):
+                h = block((W[s, lap], b[s, lap]), h)
+        return h.sum()
+
+    g2 = jax.grad(seq)(Ws, bs)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=2e-4, atol=1e-4)
+
+
+def test_spmd_pipeline_1f1b_parity():
+    """Explicit 1F1B (O(S)-memory custom-vjp backward) == sequential stages."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_schedule import (
+        spmd_pipeline_1f1b)
+    from jax.sharding import Mesh
+
+    S, M, micro, D = 4, 8, 2, 12
+    rng = np.random.RandomState(2)
+    Ws = jnp.asarray(rng.randn(S, D, D).astype("float32") * 0.3)
+    bs = jnp.asarray(rng.randn(S, D).astype("float32") * 0.1)
+    x = jnp.asarray(rng.randn(M, micro, D).astype("float32"))
+
+    def block(params, h):
+        W, b = params
+        return jnp.tanh(h @ W + b)
+
+    ref = x
+    for s in range(S):
+        ref = block((Ws[s], bs[s]), ref)
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("dp", "pp"))
+    out = spmd_pipeline_1f1b(block, (Ws, bs), x, mesh, axis="pp")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=1e-5)
+
+    # grads w.r.t. params AND input match the sequential reference
+    g1 = jax.grad(lambda W, b, xx: spmd_pipeline_1f1b(
+        block, (W, b), xx, mesh, axis="pp").sum(), argnums=(0, 1, 2))(Ws, bs, x)
+    g2 = jax.grad(lambda W, b, xx: _seq_loss(block, W, b, xx),
+                  argnums=(0, 1, 2))(Ws, bs, x)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=1e-4)
+
+
+def test_spmd_pipeline_scales_to_many_microbatches():
+    """Compile/trace is O(1) in M (scan over ticks): M=32 must trace+lower
+    in seconds, and the fwd jaxpr size must match M=8's (round-2 weakness:
+    the Python-unrolled tick loop grew the HLO with M+S-1)."""
+    import time
+    from paddle_tpu.distributed.fleet.meta_parallel import spmd_pipeline
+    from jax.sharding import Mesh
+
+    S, micro, D = 4, 2, 8
+    rng = np.random.RandomState(3)
+    Ws = jnp.asarray(rng.randn(S, D, D).astype("float32") * 0.3)
+    bs = jnp.asarray(rng.randn(S, D).astype("float32") * 0.1)
+
+    def block(params, h):
+        W, b = params
+        return jnp.tanh(h @ W + b)
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("dp", "pp"))
+
+    def jaxpr_len(M):
+        x = jnp.zeros((M, micro, D), jnp.float32)
+        t0 = time.time()
+        jaxpr = jax.make_jaxpr(lambda W, b, xx: spmd_pipeline(
+            block, (W, b), xx, mesh, axis="pp").sum())(Ws, bs, x)
+        return len(str(jaxpr)), time.time() - t0
+
+    n8, _ = jaxpr_len(8)
+    n32, dt32 = jaxpr_len(32)
+    assert dt32 < 20.0, f"tracing M=32 took {dt32:.1f}s"
+    assert n32 < n8 * 1.2, (n8, n32)
+
+    # the M=32 pipeline also RUNS and matches the sequential reference
+    x = jnp.asarray(rng.randn(32, micro, D).astype("float32"))
+    out = spmd_pipeline(block, (Ws, bs), x, mesh, axis="pp")
+    ref = x
+    for s in range(S):
+        ref = block((Ws[s], bs[s]), ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=1e-5)
+
+
+def test_pipeline_tick_stats_bubble():
+    """Interleaved (virtual stages) reduces bubble compute vs GPipe."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_schedule import (
+        pipeline_tick_stats)
+
+    g = pipeline_tick_stats(32, 4, layers_per_stage=4, schedule="gpipe")
+    i = pipeline_tick_stats(32, 4, layers_per_stage=4, schedule="interleaved")
+    assert i["bubble_fraction"] < g["bubble_fraction"], (i, g)
