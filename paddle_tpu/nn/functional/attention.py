@@ -47,6 +47,34 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     """paddle layout: (batch, seq, num_heads, head_dim)."""
     use_flash = False
     qv = unwrap(query)
+    kv_ = unwrap(key)
+    # Context parallelism: when the job's hybrid mesh carries a live sep
+    # axis, long self-attention routes through ring attention (sequence
+    # sharded over the ICI ring, flash kernel per block) automatically.
+    if attn_mask is None and dropout_p == 0.0 and qv.ndim == 4:
+        try:
+            from ...distributed.topology import get_hybrid_communicate_group
+
+            hcg = get_hybrid_communicate_group()
+            sep = hcg.get_sep_parallel_world_size() if hcg is not None else 1
+        except Exception:
+            sep = 1
+        if sep > 1:
+            # already inside a manual 'sep' region (SEP utils / shard_map)?
+            # then that code owns the distribution — don't nest.
+            try:
+                jax.lax.axis_index("sep")  # raises when 'sep' is unbound
+                sep = 1
+            except Exception:
+                pass
+        if (sep > 1 and kv_.shape == qv.shape and qv.shape[1] % sep == 0):
+            from ...ops.ring_attention import ring_attention_fn
+
+            def ring_fn(q, k, v):
+                return ring_attention_fn(q, k, v, hcg.mesh, axis="sep",
+                                         scale=scale, causal=is_causal)
+
+            return apply(ring_fn, query, key, value, op_name="ring_attention")
     if (attn_mask is None and dropout_p == 0.0 and qv.ndim == 4):
         try:
             from ...ops.flash_attention import supported

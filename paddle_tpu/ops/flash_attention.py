@@ -9,9 +9,16 @@ sweep — q/k/v tiles stream HBM→VMEM per block, the two matmuls hit the MXU
 at (BLOCK_Q=128, BLOCK_K=128) tiles, and the S x S score matrix never
 materializes (memory O(S) instead of O(S^2)).
 
-Backward: `jax.custom_vjp` whose bwd recomputes the softmax q-chunk by
-q-chunk (lax.scan), accumulating dk/dv across chunks — exact gradients with
-peak memory O(S * block_q), never the full S x S matrix.
+Backward: two Pallas kernels (dk/dv: grid sweeps q-blocks per k-block;
+dq: grid sweeps k-blocks per q-block) that recompute the probabilities from
+the forward's saved logsumexp — exact gradients, O(block) memory, both
+matmuls per block on the MXU.  Off-TPU (or for shapes the kernels don't
+cover) a chunked-XLA backward provides the same math.
+
+``flash_attention_with_lse`` additionally returns the per-row logsumexp and
+is differentiable IN BOTH outputs (d/dlse folds into the ds term as
+``ds = p * (dp - delta + g_lse) * scale``), which is what ring attention
+needs to merge per-ring-step blocks exactly.
 """
 
 from __future__ import annotations
@@ -32,8 +39,8 @@ MIN_BLOCK = 128
 NEG_INF = -1e30
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale,
-               causal, block_q, block_k, nk, causal_offset=0):
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+               scale, causal, block_q, block_k, nk, causal_offset=0):
     """causal_offset = sk - sq (bottom-right-aligned mask, matching
     _ref_attention's tril(k=sk-sq) for kv-cache-style sq != sk)."""
     iq = pl.program_id(1)
@@ -85,10 +92,12 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale,
         # Mosaic backend); XLA fuses the downcast outside the kernel
         denom = jnp.maximum(l_scr[:], jnp.float32(1e-30))
         o_ref[0] = acc_scr[:] / denom
+        lse_ref[0] = m_scr[:] + jnp.log(denom)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, causal_offset=0):
-    """q,k,v: [BH, S, D] -> o [BH, S, D]."""
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, causal_offset=0,
+               with_lse=False):
+    """q,k,v: [BH, S, D] -> o [BH, S, D] (and lse [BH, S, 1] if with_lse)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq = pl.cdiv(sq, block_q)
@@ -100,7 +109,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, causal_offset=0):
     # index-map constants must be i32 and must not be captured tracers:
     # derive the zero from a program id (i32) — under jax_enable_x64 a
     # literal 0 would trace as i64, which Mosaic rejects
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
@@ -111,9 +120,16 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, causal_offset=0):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, b * 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, b * 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, b * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, b * 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -123,7 +139,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, causal_offset=0):
             flops=4 * bh * sq * sk * d, transcendentals=bh * sq * sk,
             bytes_accessed=2 * (q.size + k.size + v.size) * q.dtype.itemsize),
     )(q, k, v)
-    return out.astype(q.dtype)
+    out = out.astype(q.dtype)
+    return (out, lse) if with_lse else out
 
 
 def _ref_attention(q, k, v, scale, causal):
@@ -137,9 +154,179 @@ def _ref_attention(q, k, v, scale, causal):
     return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _chunked_attn_bwd(q, k, v, g, scale, causal, causal_offset, chunk):
+# backward blocks: smaller than the forward's — the bwd kernels hold two
+# extra [block, d] accumulators plus three [BQ, BK] intermediates in VMEM
+BWD_BLOCK_Q = 256
+BWD_BLOCK_K = 512
+
+
+def _causal_mask(iq, ik, block_q, block_k, causal_offset):
+    q_pos = iq * block_q + causal_offset + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return q_pos >= k_pos
+
+
+def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, r_ref,
+                        dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                        block_q, block_k, nq, causal_offset):
+    """Grid (bh, k-blocks, q-blocks): accumulate dk/dv for one k-block
+    across the q sweep.  r = delta - g_lse (the combined row correction)."""
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)           # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)           # [BK, D]
+        v = v_ref[0].astype(jnp.float32)           # [BK, D]
+        g = g_ref[0].astype(jnp.float32)           # [BQ, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) \
+            * jnp.float32(scale)
+        p = jnp.exp(s - lse_ref[0])                # [BQ, BK], rowwise lse
+        if causal:
+            mask = _causal_mask(iq, ik, block_q, block_k, causal_offset)
+            p = jnp.where(mask, p, jnp.float32(0.0))
+        # dv += p^T @ g   (contract over the q dim — no explicit transpose)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - r_ref[0]) * jnp.float32(scale)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ik * block_k <= iq * block_q + causal_offset + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(iq == nq - 1)
+    def _fin():
+        dk_ref[0] = dk_scr[:]
+        dv_ref[0] = dv_scr[:]
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, r_ref,
+                      dq_ref, dq_scr, *, scale, causal, block_q, block_k,
+                      nk, causal_offset):
+    """Grid (bh, q-blocks, k-blocks): accumulate dq for one q-block across
+    the k sweep."""
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) \
+            * jnp.float32(scale)
+        p = jnp.exp(s - lse_ref[0])
+        if causal:
+            mask = _causal_mask(iq, ik, block_q, block_k, causal_offset)
+            p = jnp.where(mask, p, jnp.float32(0.0))
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - r_ref[0]) * jnp.float32(scale)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ik * block_k <= iq * block_q + causal_offset + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ik == nk - 1)
+    def _fin():
+        dq_ref[0] = dq_scr[:]
+
+
+def _flash_bwd_pallas(q, k, v, g, lse, r, scale, causal, causal_offset):
+    """Pallas backward. q,k,v,g: [BH, S, D]; lse, r: [BH, S, 1] f32.
+    Returns (dq, dk, dv) in input dtypes."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq = min(BWD_BLOCK_Q, sq)
+    bk = min(BWD_BLOCK_K, sk)
+    nq = pl.cdiv(sq, bq)
+    nk = pl.cdiv(sk, bk)
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, b * 0),
+                          memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, b * 0),
+                          memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, b * 0),
+                            memory_space=pltpu.VMEM)
+    dkdv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkdv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nq=nq,
+                          causal_offset=causal_offset),
+        grid=(bh, nk, nq),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, b * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, b * 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=5 * bh * sq * sk * d, transcendentals=bh * sq * sk,
+            bytes_accessed=3 * (q.size + k.size + v.size) * q.dtype.itemsize),
+    )(q, k, v, g, lse, r)
+
+    q_spec2 = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, b * 0),
+                           memory_space=pltpu.VMEM)
+    k_spec2 = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, b * 0),
+                           memory_space=pltpu.VMEM)
+    row_spec2 = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, b * 0),
+                             memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nk=nk,
+                          causal_offset=causal_offset),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, b * 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=3 * bh * sq * sk * d, transcendentals=bh * sq * sk,
+            bytes_accessed=3 * (q.size + k.size + v.size) * q.dtype.itemsize),
+    )(q, k, v, g, lse, r)
+    return dq.astype(q.dtype), dkdv[0].astype(k.dtype), dkdv[1].astype(v.dtype)
+
+
+def _chunked_attn_bwd(q, k, v, g, scale, causal, causal_offset, chunk,
+                      row_corr=None):
     """Exact attention backward, q-chunked: recomputes the softmax per chunk
-    so peak memory is O(S * chunk), never the full S x S matrix."""
+    so peak memory is O(S * chunk), never the full S x S matrix.
+    ``row_corr`` [BH, S, 1] is subtracted inside the ds term (carries the
+    -g_lse correction when differentiating the (o, lse) pair)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq = sq // chunk
@@ -163,7 +350,10 @@ def _chunked_attn_bwd(q, k, v, g, scale, causal, causal_offset, chunk):
         p = jax.nn.softmax(s, axis=-1)
         dv_c = jnp.einsum("bck,bcd->bkd", p, do)
         dp = jnp.einsum("bcd,bkd->bck", do, vf)
-        ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True)) * scale32
+        corr = jnp.sum(dp * p, axis=-1, keepdims=True)
+        if row_corr is not None:
+            corr = corr + jax.lax.dynamic_slice_in_dim(row_corr, start, chunk, 1)
+        ds = p * (dp - corr) * scale32
         dq_c = jnp.einsum("bck,bkd->bcd", ds, kf)
         dk_c = jnp.einsum("bck,bcd->bkd", ds, qc)
         return (dk_acc + dk_c, dv_acc + dv_c), dq_c
@@ -174,26 +364,80 @@ def _chunked_attn_bwd(q, k, v, g, scale, causal, causal_offset, chunk):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _bwd_dispatch(q, k, v, o, g, lse, g_lse, scale, causal, block_q,
+                  causal_offset):
+    """delta/r prep + Pallas-vs-chunked-XLA backward selection."""
+    sq, sk = q.shape[1], k.shape[1]
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)
+    r = delta if g_lse is None else delta - g_lse.astype(jnp.float32)
+    pallas_ok = (jax.default_backend() == "tpu"
+                 and sq % min(BWD_BLOCK_Q, sq) == 0
+                 and sk % min(BWD_BLOCK_K, sk) == 0
+                 and sq % 128 == 0 and sk % 128 == 0)
+    if pallas_ok:
+        return _flash_bwd_pallas(q, k, v, g, lse, r, scale, causal,
+                                 causal_offset)
+    chunk = block_q
+    while q.shape[1] % chunk:
+        chunk //= 2
+    row_corr = None if g_lse is None else -g_lse.astype(jnp.float32)
+    return _chunked_attn_bwd(q, k, v, g, scale, causal, causal_offset,
+                             max(chunk, 1), row_corr=row_corr)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, scale, causal, block_q, block_k, causal_offset):
     return _flash_fwd(q, k, v, scale, causal, block_q, block_k, causal_offset)
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, causal_offset):
-    o = _flash_fwd(q, k, v, scale, causal, block_q, block_k, causal_offset)
-    return o, (q, k, v)
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        causal_offset, with_lse=True)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, causal_offset, res, g):
-    q, k, v = res
-    chunk = block_q
-    while q.shape[1] % chunk:
-        chunk //= 2
-    return _chunked_attn_bwd(q, k, v, g, scale, causal, causal_offset,
-                             max(chunk, 1))
+    q, k, v, o, lse = res
+    return _bwd_dispatch(q, k, v, o, g, lse, None, scale, causal, block_q,
+                         causal_offset)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, scale, causal, block_q, block_k, causal_offset):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, causal_offset,
+                      with_lse=True)
+
+
+def _flash_lse_vjp_fwd(q, k, v, scale, causal, block_q, block_k, causal_offset):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        causal_offset, with_lse=True)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_vjp_bwd(scale, causal, block_q, block_k, causal_offset, res, g):
+    q, k, v, o, lse = res
+    g_o, g_lse = g
+    return _bwd_dispatch(q, k, v, o, g_o, lse, g_lse, scale, causal, block_q,
+                         causal_offset)
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+def flash_attention_with_lse(q, k, v, scale, causal, block_q=None,
+                             block_k=None):
+    """[BH, S, D] block attention returning (o, lse [BH, S, 1] f32),
+    differentiable in both outputs — the ring-attention per-step primitive.
+    Shapes must already be block-aligned (the ring guarantees this)."""
+    bq = block_q or max(MIN_BLOCK, min(DEFAULT_BLOCK_Q,
+                                       (q.shape[1] // MIN_BLOCK) * MIN_BLOCK))
+    bk = block_k or max(MIN_BLOCK, min(DEFAULT_BLOCK_K,
+                                       (k.shape[1] // MIN_BLOCK) * MIN_BLOCK))
+    return _flash_lse(q, k, v, scale, causal, bq, bk, k.shape[1] - q.shape[1])
 
 
 def _pad_to(x, target, axis):

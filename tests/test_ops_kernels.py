@@ -144,3 +144,98 @@ def test_chunked_backward_matches_autodiff(causal):
     np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_ref), rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(np.asarray(dk), np.asarray(dk_ref), rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(np.asarray(dv), np.asarray(dv_ref), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_backward_kernels_interpret(causal):
+    """dq/dk/dv Pallas kernels (interpret mode) == autodiff of the reference."""
+    from jax.experimental import pallas as pl
+
+    rng = np.random.RandomState(3)
+    BH, S, D = 2, 512, 64
+    q = jnp.asarray(rng.randn(BH, S, D).astype("float32"))
+    k = jnp.asarray(rng.randn(BH, S, D).astype("float32"))
+    v = jnp.asarray(rng.randn(BH, S, D).astype("float32"))
+    g = jnp.asarray(rng.randn(BH, S, D).astype("float32"))
+    scale = 0.125
+
+    orig = pl.pallas_call
+    pl.pallas_call = functools.partial(orig, interpret=True)
+    try:
+        o, lse = fa._flash_fwd(q, k, v, scale, causal, 128, 128, with_lse=True)
+        delta = jnp.sum(g * o, axis=-1, keepdims=True)
+        dq, dk, dv = fa._flash_bwd_pallas(q, k, v, g, lse, delta, scale,
+                                          causal, 0)
+    finally:
+        pl.pallas_call = orig
+
+    def loss(q, k, v):
+        return (fa._ref_attention(q, k, v, scale, causal) * g).sum()
+
+    rq, rk, rv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), rtol=2e-4, atol=2e-4)
+
+
+def test_flash_lse_grads_match_reference():
+    """The (o, lse) primitive is differentiable in BOTH outputs — a loss that
+    mixes o and lse (like the ring merge) must match pure-autodiff grads."""
+    rng = np.random.RandomState(4)
+    BH, S, D = 2, 128, 32
+    q = jnp.asarray(rng.randn(BH, S, D).astype("float32"))
+    k = jnp.asarray(rng.randn(BH, S, D).astype("float32"))
+    v = jnp.asarray(rng.randn(BH, S, D).astype("float32"))
+    scale = 1.0 / np.sqrt(D)
+
+    def ref_ol(q, k, v):
+        s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+        m = jnp.max(s, -1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, -1, keepdims=True)
+        return jnp.einsum("bqk,bkd->bqd", p, v) / l, m + jnp.log(l)
+
+    def loss_flash(q, k, v):
+        o, lse = fa.flash_attention_with_lse(q, k, v, scale, causal=False,
+                                             block_q=128, block_k=128)
+        return (o.astype(jnp.float32) ** 2).sum() + (lse * 0.3).sum()
+
+    def loss_ref(q, k, v):
+        o, lse = ref_ol(q, k, v)
+        return (o ** 2).sum() + (lse * 0.3).sum()
+
+    from jax.experimental import pallas as pl
+    orig = pl.pallas_call
+    pl.pallas_call = functools.partial(orig, interpret=True)
+    try:
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        pl.pallas_call = orig
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_sdpa_routes_to_ring_when_sep_mesh_live():
+    """A live hcg with sep>1 makes scaled_dot_product_attention run ring
+    attention over the sep axis (and still match the reference einsum)."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import topology as topo
+
+    rng = np.random.RandomState(5)
+    q = paddle.to_tensor(rng.randn(2, 64, 2, 16).astype("float32"))
+    k = paddle.to_tensor(rng.randn(2, 64, 2, 16).astype("float32"))
+    v = paddle.to_tensor(rng.randn(2, 64, 2, 16).astype("float32"))
+    ref = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                         training=False).numpy()
+
+    t = topo.CommunicateTopology(["sep"], [4])
+    hcg = topo.HybridCommunicateGroup(t)
+    topo.set_hybrid_communicate_group(hcg)
+    try:
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=False).numpy()
+    finally:
+        topo.set_hybrid_communicate_group(None)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
