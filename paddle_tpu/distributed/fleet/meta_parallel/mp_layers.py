@@ -184,14 +184,87 @@ class VocabParallelEmbedding(Layer):
         return _constrain(y, P(*spec_tail, None), self.mesh)
 
 
+def parallel_softmax_cross_entropy(local_logits, labels, axis="mp",
+                                   ignore_index=-100):
+    """``c_softmax_with_cross_entropy`` analog for MANUAL regions: logits are
+    vocab-sharded [..., V/mp] per rank; full-vocab logits never materialize.
+
+    local max -> pmax; local sum-exp -> psum (sharded logsumexp); the true
+    class logit is gathered locally under an ownership mask and psum'd.
+    Autodiff yields the exact sharded softmax gradient
+    (softmax_local - onehot_local).  Returns per-token loss (f32).
+    """
+    v_loc = local_logits.shape[-1]
+    lf = local_logits.astype(jnp.float32)
+    # stop_gradient BEFORE pmax: the shift cancels in the loss gradient and
+    # pmax has no differentiation rule
+    m = jax.lax.pmax(jax.lax.stop_gradient(jnp.max(lf, axis=-1)), axis)
+    e = jnp.exp(lf - m[..., None])
+    denom = jax.lax.psum(jnp.sum(e, axis=-1), axis)
+    lse = m + jnp.log(denom)
+    start = jax.lax.axis_index(axis) * v_loc
+    loc = labels.astype(jnp.int32) - start
+    ok = (loc >= 0) & (loc < v_loc)
+    safe = jnp.clip(loc, 0, v_loc - 1)
+    picked = jnp.take_along_axis(lf, safe[..., None], axis=-1)[..., 0]
+    logit_y = jax.lax.psum(jnp.where(ok, picked, jnp.float32(0.0)), axis)
+    loss = lse - logit_y
+    if ignore_index is not None:
+        loss = jnp.where(labels != ignore_index, loss, jnp.float32(0.0))
+    return loss
+
+
+def sharded_vocab_head_loss(hidden, weight, labels, mesh, batch_axis=None,
+                            axis="mp", shift=True):
+    """Tied-embedding LM head + CE with the vocab dim sharded over ``axis``:
+    each rank computes only its [*, V/mp] logits slab and the loss comes out
+    of :func:`parallel_softmax_cross_entropy` — the full-vocab logits tensor
+    never exists on any rank (reference: the GPT pipe head built on
+    c_softmax_with_cross_entropy).
+
+    hidden: [B, S, H]; weight: [V, H] row-sharded over ``axis``;
+    labels: [B, S].  Returns the scalar mean next-token loss.
+    """
+    from ..meta_parallel.pipeline_schedule import _shard_map
+
+    bspec = batch_axis if batch_axis else None
+
+    def body(h, w, y):
+        if shift:
+            h = h[:, :-1]
+            y = y[:, 1:]
+        logits = jnp.einsum("bsh,vh->bsv", h.astype(jnp.float32),
+                            w.astype(jnp.float32))
+        loss = parallel_softmax_cross_entropy(logits, y, axis=axis)
+        loss = jnp.mean(loss)
+        if bspec is not None:
+            loss = jax.lax.pmean(loss, bspec)
+        return loss
+
+    mapped = _shard_map(
+        body, mesh,
+        in_specs=(P(bspec, None, None), P(axis, None), P(bspec, None)),
+        out_specs=P())
+    return _apply(mapped, hidden, weight, labels,
+                  op_name="sharded_vocab_head_loss")
+
+
 class ParallelCrossEntropy(Layer):
     """CE over mp-sharded logits (reference: c_softmax_with_cross_entropy).
-    Plain softmax-CE here — the partitioner performs the sharded logsumexp."""
+    In a manual-mp region the input is the LOCAL vocab shard and the sharded
+    logsumexp runs explicitly; otherwise plain softmax-CE — the partitioner
+    performs the sharded logsumexp."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
+        if _in_manual_mp():
+            def fn(logits, y):
+                return parallel_softmax_cross_entropy(
+                    logits, y, axis="mp", ignore_index=self.ignore_index)
+
+            return _apply(fn, input, label, op_name="parallel_cross_entropy")
         return F.cross_entropy(input, label, reduction="none",
                                ignore_index=self.ignore_index)

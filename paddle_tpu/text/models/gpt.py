@@ -309,6 +309,15 @@ class GPTForCausalLMPipe(Layer):
                                   batch_axis=self._batch_axis,
                                   schedule=self._schedule)
         w = self.lm.gpt.word_embeddings.weight
+        mp = dict(zip(self._mesh.axis_names, self._mesh.devices.shape)).get("mp", 1)
+        if labels is not None and mp > 1:
+            # vocab-sharded head + CE: the [B, S, V] logits tensor never
+            # materializes per rank (c_softmax_with_cross_entropy analog)
+            from ...distributed.fleet.meta_parallel.mp_layers import (
+                sharded_vocab_head_loss)
+
+            return sharded_vocab_head_loss(hidden, w, labels, self._mesh,
+                                           batch_axis=self._batch_axis)
         logits = _apply(lambda h, wv: h @ wv.T, hidden, w, op_name="matmul")
         if labels is not None:
             return F.cross_entropy(
