@@ -462,7 +462,9 @@ def save(layer, path, input_spec=None, **configs):
         b_struct = [jax.ShapeDtypeStruct(b._value.shape, b._value.dtype) for _, b in named_b]
 
         exported = jax.export.export(jax.jit(pure))(p_struct, b_struct, *arg_shapes)
-        blob = exported.serialize()
+        # vjp_order=1: the artifact ships its backward too, so jit.load can
+        # fine-tune (reference: loaded programs keep their grad ops)
+        blob = exported.serialize(vjp_order=1)
     finally:
         for l, t in modes:
             l.training = t
@@ -484,8 +486,11 @@ def save(layer, path, input_spec=None, **configs):
 
 
 class TranslatedLayer:
-    """Loaded inference artifact (reference: TranslatedLayer from jit.load):
-    calls the deserialized StableHLO module with the saved weights."""
+    """Loaded artifact (reference: TranslatedLayer from jit.load): calls the
+    deserialized StableHLO module with the saved weights.  Artifacts saved
+    by this framework carry their VJP (serialize(vjp_order=1)), so the
+    loaded layer FINE-TUNES: the call is differentiable w.r.t. its
+    parameters and ``train()`` marks them trainable."""
 
     def __init__(self, exported, params, buffers, meta):
         self._exported = exported
@@ -495,14 +500,24 @@ class TranslatedLayer:
         self.training = False
 
     def __call__(self, *args):
-        pvals = [self._params[k]._value for k in self._meta["pnames"]]
-        bvals = [self._buffers[k]._value for k in self._meta["bnames"]]
-        xs = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
-        out = self._exported.call(pvals, bvals, *xs)
+        from ..tensor.dispatch import apply as _dispatch_apply
+
+        pnames = self._meta["pnames"]
+        bnames = self._meta["bnames"]
+        np_, nb = len(pnames), len(bnames)
+        ptensors = [self._params[k] for k in pnames]
+        btensors = [self._buffers[k] for k in bnames]
+
+        def fn(*flat):
+            return self._exported.call(list(flat[:np_]),
+                                       list(flat[np_:np_ + nb]),
+                                       *flat[np_ + nb:])
+
+        out = _dispatch_apply(fn, *ptensors, *btensors, *args, n_outs=None,
+                              op_name="translated_layer")
         if isinstance(out, (tuple, list)):
-            outs = [Tensor(o) for o in out]
-            return outs[0] if len(outs) == 1 else tuple(outs)
-        return Tensor(out)
+            return out[0] if len(out) == 1 else tuple(out)
+        return out
 
     forward = __call__
 
@@ -511,11 +526,25 @@ class TranslatedLayer:
         return self
 
     def train(self):
-        raise RuntimeError("TranslatedLayer is an inference artifact; rebuild the "
-                           "python model and load .pdparams to fine-tune")
+        """Enable fine-tuning: parameters become trainable (the artifact's
+        serialized VJP provides the backward)."""
+        if not self._exported.has_vjp():
+            raise RuntimeError(
+                "this artifact was saved without its VJP (vjp_order=0); "
+                "re-save with paddle.jit.save to fine-tune")
+        self.training = True
+        for p in self._params.values():
+            p.stop_gradient = False
+        return self
 
     def parameters(self):
         return list(self._params.values())
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return [(k, v) for k, v in self._params.items()]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        return [(k, v) for k, v in self._buffers.items()]
 
     def state_dict(self):
         d = dict(self._params)

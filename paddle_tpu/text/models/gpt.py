@@ -19,6 +19,8 @@ TPU-first structure:
 
 from __future__ import annotations
 
+import functools
+
 import jax.numpy as jnp
 
 from ...nn import functional as F
@@ -93,6 +95,38 @@ class GPTDecoderLayer(Layer):
         heads_here = qkv.shape[-1] // (3 * self.head_dim)
         qkv = qkv.reshape([B, S, heads_here, 3, self.head_dim])
         q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        if cache is not None and len(cache) == 3:
+            # STATIC cache (jitted decode): fixed [B, T, h, d] buffers written
+            # in place at ``pos`` — shapes never change, so every decode step
+            # reuses one compiled program (donated cache, no concat growth)
+            import jax as _jax
+
+            k_buf, v_buf, pos = cache
+
+            def write(buf, new, p):
+                return _jax.lax.dynamic_update_slice_in_dim(buf, new, p, 1)
+
+            k_buf = _apply(write, k_buf, k, pos, op_name="cache_write")
+            v_buf = _apply(write, v_buf, v, pos, op_name="cache_write")
+            T = k_buf.shape[1]
+
+            def build_mask(p):
+                i = jnp.arange(S, dtype=jnp.int32)[:, None]
+                j = jnp.arange(T, dtype=jnp.int32)[None, :]
+                return jnp.where(j <= p + i, jnp.float32(0.0),
+                                 jnp.float32(-1e30))[None, None]
+
+            mask = _apply(build_mask, pos, op_name="cache_mask")
+            attn = F.scaled_dot_product_attention(
+                q, k_buf, v_buf, attn_mask=mask, dropout_p=0.0,
+                training=False)
+            attn = attn.reshape([B, S, heads_here * self.head_dim])
+            x = residual + self.dropout(self.out_proj(attn))
+            residual = x
+            h = self.ln2(x)
+            h = self.ffn2(self.act(self.ffn1(h)))
+            x = residual + self.dropout(h)
+            return x, (k_buf, v_buf, pos)
         if cache is not None:
             from ...tensor import manipulation as M
 
@@ -174,7 +208,98 @@ class GPTForCausalLM(Layer):
 
     # ------------------------------------------------------------ generation
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
-                 seed=None):
+                 seed=None, use_cache=True):
+        """Autoregressive generation.
+
+        ``use_cache=True`` (default): jitted two-phase decode — one compiled
+        prefill writes the prompt's K/V into fixed [B, T, h, d] buffers, then
+        ONE compiled single-token step (donated cache, static shapes) runs
+        per new token.  Greedy (temperature=0) output is identical to the
+        eager loop; sampled output uses jax PRNG instead of numpy's.
+        ``use_cache=False``: the eager full-prefix loop (reference parity /
+        debug path)."""
+        if not use_cache:
+            return self._generate_eager(input_ids, max_new_tokens, temperature,
+                                        top_k, seed)
+        import jax
+        import numpy as np
+
+        from ...framework import random as _rng
+        from ...framework.state import no_grad_ctx
+
+        ids0 = np.asarray(input_ids.numpy()).astype("int64")
+        B, S0 = ids0.shape
+        T = S0 + max_new_tokens
+        max_pos = self.gpt.position_embeddings.weight.shape[0]
+        if T > max_pos:
+            raise ValueError(
+                f"generate: prompt {S0} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_position_embeddings {max_pos}")
+        gpt = self.gpt
+        L = len(gpt.layers)
+        blk = gpt.layers[0]
+        h_heads = blk.qkv.weight.shape[-1] // (3 * blk.head_dim)
+        dt = gpt.word_embeddings.weight._value.dtype
+        params = {k: p._value for k, p in self.named_parameters()}
+        bufs = {k: b._value for k, b in self.named_buffers()}
+        was = self.training
+        self.training = False
+
+        def fwd(params, bufs, ids, ks, vs, pos):
+            with no_grad_ctx(), _rng.rng_scope(jax.random.key(0)), \
+                    self.bind(params, bufs):
+                S = ids.shape[1]
+                pos_ids = pos + jnp.arange(S, dtype=jnp.int32)[None, :]
+                cache = [(Tensor(ks[i]), Tensor(vs[i]), Tensor(pos))
+                         for i in range(L)]
+                x, new_cache = gpt(Tensor(ids), position_ids=Tensor(pos_ids),
+                                   cache=cache)
+                w = gpt.word_embeddings.weight._value
+                logits = (x._value[:, -1].astype(jnp.float32)
+                          @ w.T.astype(jnp.float32))
+                ks = jnp.stack([c[0]._value for c in new_cache])
+                vs = jnp.stack([c[1]._value for c in new_cache])
+            return logits, ks, vs
+
+        def sample(logits, key):
+            if temperature == 0.0:
+                return jnp.argmax(logits, axis=-1)
+            l = logits / jnp.float32(max(temperature, 1e-6))
+            if top_k:
+                kth = jax.lax.top_k(l, top_k)[0][:, -1][:, None]
+                l = jnp.where(l < kth, -jnp.inf, l)
+            return jax.random.categorical(key, l, axis=-1)
+
+        @jax.jit
+        def prefill(params, bufs, ids, ks, vs, key):
+            logits, ks, vs = fwd(params, bufs, ids, ks, vs, jnp.int32(0))
+            return sample(logits, key), ks, vs
+
+        @functools.partial(jax.jit, donate_argnums=(3, 4))
+        def step(params, bufs, last, ks, vs, pos, key):
+            logits, ks, vs = fwd(params, bufs, last, ks, vs, pos)
+            return sample(logits, key), ks, vs
+
+        try:
+            ks = jnp.zeros((L, B, T, h_heads, blk.head_dim), dt)
+            vs = jnp.zeros_like(ks)
+            base = jax.random.key(seed if seed is not None else 0)
+            nxt, ks, vs = prefill(params, bufs, jnp.asarray(ids0), ks, vs,
+                                  jax.random.fold_in(base, 0))
+            out = [np.asarray(nxt)[:, None]]
+            for t in range(1, max_new_tokens):
+                nxt, ks, vs = step(params, bufs,
+                                   jnp.asarray(nxt)[:, None].astype(jnp.int64),
+                                   ks, vs, jnp.int32(S0 + t - 1),
+                                   jax.random.fold_in(base, t))
+                out.append(np.asarray(nxt)[:, None])
+        finally:
+            self.training = was
+        new = np.concatenate(out, axis=1)
+        return Tensor(jnp.asarray(np.concatenate([ids0, new], axis=1)))
+
+    def _generate_eager(self, input_ids, max_new_tokens=32, temperature=1.0,
+                        top_k=0, seed=None):
         """Greedy/top-k sampling loop (eager; each step reuses the jit cache
         for its shape)."""
         import numpy as np
