@@ -1,0 +1,273 @@
+"""paddle.incubate.optimizer — optimizer wrappers (reference:
+python/paddle/incubate/optimizer/: LookAhead, ModelAverage) plus
+ExponentialMovingAverage (reference: paddle.static.ExponentialMovingAverage,
+re-homed here for the dygraph-first rebuild).
+
+TPU-first: every wrapper keeps its auxiliary weights as a jax pytree and
+exposes the same pure ``functional_init/functional_update`` contract the
+fused :class:`~paddle_tpu.jit.train_step.TrainStep` compiles — the slow/EMA
+updates are traced ops (``jnp.where`` on a carried counter), not host-side
+Python, so wrapping an optimizer does not break the one-XLA-program step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+
+def _tree_val(t):
+    return t._value if isinstance(t, Tensor) else t
+
+
+class LookAhead:
+    """Lookahead (k steps forward, 1 step back): fast weights follow the
+    inner optimizer; every ``k`` steps the slow weights move ``alpha`` of the
+    way toward the fast weights and the fast weights reset to them.
+
+    Wraps any paddle_tpu optimizer; usable eagerly (``step()``) and inside
+    TrainStep (functional path, the sync is a traced ``jnp.where``).
+    """
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0,1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow = {}  # id(param) -> slow array (eager path)
+        self._eager_count = 0
+
+    # delegate everything the trainer/model code reads off an optimizer
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+    # ------------------------------------------------------------ eager
+    def step(self):
+        if self._eager_count == 0:
+            # slow weights start at theta_0 (same seeding as functional_init)
+            for p in self.inner_optimizer._parameter_list:
+                pv = p._master if getattr(p, "_master", None) is not None else p._value
+                self._slow[id(p)] = pv
+        self.inner_optimizer.step()
+        self._eager_count += 1
+        if self._eager_count % self.k == 0:
+            for p in self.inner_optimizer._parameter_list:
+                pv = p._master if getattr(p, "_master", None) is not None else p._value
+                slow = self._slow[id(p)]
+                new_slow = slow + self.alpha * (pv - slow)
+                self._slow[id(p)] = new_slow
+                if getattr(p, "_master", None) is not None:
+                    p._master = new_slow
+                    p._value = new_slow.astype(p._value.dtype)
+                else:
+                    p._value = new_slow.astype(pv.dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # ------------------------------------------------- functional (jit)
+    def functional_init(self, param_tree):
+        return {
+            "inner": self.inner_optimizer.functional_init(param_tree),
+            # copy: slow weights live in the (donated) opt-state tree, so they
+            # must not alias the (also donated) param buffers
+            "slow": jax.tree_util.tree_map(
+                lambda p: jnp.array(_tree_val(p), copy=True), param_tree),
+            "count": jnp.zeros((), jnp.int32),
+        }
+
+    def resolve_leaf_meta(self, param_tree):
+        return self.inner_optimizer.resolve_leaf_meta(param_tree)
+
+    def functional_update(self, param_tree, grad_tree, state_tree, lr, leaf_meta=None):
+        new_p, new_inner = self.inner_optimizer.functional_update(
+            param_tree, grad_tree, state_tree["inner"], lr, leaf_meta=leaf_meta)
+        count = state_tree["count"] + 1
+        sync = (count % self.k) == 0
+        new_slow = jax.tree_util.tree_map(
+            lambda s, p: jnp.where(sync, s + self.alpha * (p.astype(s.dtype) - s), s),
+            state_tree["slow"], new_p)
+        new_p = jax.tree_util.tree_map(
+            lambda s, p: jnp.where(sync, s.astype(p.dtype), p), new_slow, new_p)
+        return new_p, {"inner": new_inner, "slow": new_slow, "count": count}
+
+    def sync_functional_state(self, named_diff, state_tree, step_count):
+        """TrainStep.sync() hook: route the {'inner','slow','count'} layout
+        back into the wrapped optimizer and the eager slow-weight store."""
+        inner = state_tree["inner"]
+        slow = state_tree["slow"]
+        for k, t in named_diff:
+            self.inner_optimizer._states[id(t)] = inner[k]
+            self._slow[id(t)] = slow[k]
+        self.inner_optimizer._step_count = step_count
+        self._eager_count = int(state_tree["count"])
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead"] = {"alpha": self.alpha, "k": self.k,
+                           "count": self._eager_count}
+        return sd
+
+    def set_state_dict(self, sd):
+        la = sd.get("lookahead")
+        inner_sd = {k: v for k, v in sd.items() if k != "lookahead"}
+        self.inner_optimizer.set_state_dict(inner_sd)
+        if la:
+            self._eager_count = la.get("count", 0)
+
+
+class _AveragerBase:
+    """Shared shadow-weight machinery: a name->array shadow tree over a
+    Layer's (or param list's) trainable parameters, an ``apply()`` context
+    that swaps the shadow in (optionally restoring on exit), and a single
+    jitted donated update so tracking costs one XLA call per step."""
+
+    def __init__(self, params_or_model):
+        if hasattr(params_or_model, "named_parameters"):
+            named = [(k, p) for k, p in params_or_model.named_parameters()
+                     if not p.stop_gradient]
+        else:
+            named = [(f"param_{i}", p) for i, p in enumerate(params_or_model)
+                     if not getattr(p, "stop_gradient", False)]
+        self._params = named
+        # zero-init: both averagers accumulate from zero (EMA debiases, the
+        # mean divides by t); no model-sized copy is materialized
+        self._shadow = {k: jnp.zeros_like(self._pval(p)) for k, p in named}
+        self._backup = None
+        self._jit_update = None
+
+    @staticmethod
+    def _pval(p):
+        return p._master if getattr(p, "_master", None) is not None else p._value
+
+    def _current_tree(self):
+        return {k: self._pval(p) for k, p in self._params}
+
+    def _swap_in(self, tree):
+        self._backup = {k: (p._value, getattr(p, "_master", None))
+                        for k, p in self._params}
+        for k, p in self._params:
+            v = tree[k]
+            if getattr(p, "_master", None) is not None:
+                p._master = v
+                p._value = v.astype(p._value.dtype)
+            else:
+                p._value = v.astype(p._value.dtype)
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for k, p in self._params:
+            v, m = self._backup[k]
+            p._value = v
+            if m is not None:
+                p._master = m
+        self._backup = None
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._swap_in(self._averaged_tree())
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def state_dict(self):
+        return {"shadow": dict(self._shadow)}
+
+    def set_state_dict(self, sd):
+        self._shadow.update(sd.get("shadow", {}))
+
+
+class ExponentialMovingAverage(_AveragerBase):
+    """EMA of model weights: ``shadow = decay * shadow + (1-decay) * param``,
+    with the standard zero-debias correction ``shadow / (1 - decay^t)``
+    applied at :meth:`apply` time.  Call :meth:`update` once per train step
+    (after ``opt.step()`` or a ``TrainStep`` call — it reads the live
+    parameter arrays either way).
+    """
+
+    def __init__(self, params_or_model, decay=0.999, thres_steps=None, name=None):
+        super().__init__(params_or_model)
+        self.decay = float(decay)
+        self._t = 0
+
+    def update(self):
+        self._t += 1
+        if self._jit_update is None:
+            decay = self.decay
+
+            @jax.jit
+            def upd(shadow, cur):  # donation skipped: tiny trees, keeps it simple
+                return jax.tree_util.tree_map(
+                    lambda s, c: decay * s + (1.0 - decay) * c.astype(s.dtype),
+                    shadow, cur)
+
+            self._jit_update = upd
+        self._shadow = self._jit_update(self._shadow, self._current_tree())
+
+    def _averaged_tree(self):
+        if self._t == 0:  # no update yet: apply() is the identity (reference
+            return self._current_tree()  # EMA seeds from the live weights)
+        debias = 1.0 - self.decay ** self._t
+        return {k: v / debias for k, v in self._shadow.items()}
+
+    def state_dict(self):
+        return {"shadow": dict(self._shadow), "t": self._t, "decay": self.decay}
+
+    def set_state_dict(self, sd):
+        self._shadow.update(sd.get("shadow", {}))
+        self._t = sd.get("t", self._t)
+
+
+class ModelAverage(_AveragerBase):
+    """Running (cumulative) average of parameters, the reference
+    incubate.ModelAverage simplified to the TPU-friendly exact mean: at
+    ``apply()`` the evaluated weights are ``sum_t(param_t) / t``.  The
+    window arguments are accepted for API parity; the exact mean over the
+    tracked steps is what evaluation uses.
+    """
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000, name=None,
+                 model=None):
+        target = model if model is not None else (parameters or [])
+        super().__init__(target)
+        self._shadow = {k: jnp.zeros_like(v) for k, v in self._shadow.items()}
+        self._t = 0
+
+    def update(self):
+        self._t += 1
+        if self._jit_update is None:
+            @jax.jit
+            def upd(shadow, cur):
+                return jax.tree_util.tree_map(
+                    lambda s, c: s + c.astype(s.dtype), shadow, cur)
+
+            self._jit_update = upd
+        self._shadow = self._jit_update(self._shadow, self._current_tree())
+
+    def _averaged_tree(self):
+        if self._t == 0:
+            return self._current_tree()
+        return {k: v / self._t for k, v in self._shadow.items()}
+
+    def state_dict(self):
+        return {"shadow": dict(self._shadow), "t": self._t}
+
+    def set_state_dict(self, sd):
+        self._shadow.update(sd.get("shadow", {}))
+        self._t = sd.get("t", self._t)

@@ -52,7 +52,7 @@ class TrainStep:
 
     def __init__(self, model, optimizer, loss_fn=None, amp_level=None,
                  amp_dtype="bfloat16", donate=True, return_outputs=False,
-                 accumulate_steps=1):
+                 accumulate_steps=1, scaler=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -60,6 +60,20 @@ class TrainStep:
         self.amp_dtype = amp_dtype
         self.return_outputs = return_outputs and accumulate_steps == 1
         self.accumulate_steps = int(accumulate_steps)
+        # fp16 loss scaling as TRACED ops (reference: GradScaler semantics —
+        # scale loss, unscale grads, skip the update on inf/nan, dynamic
+        # rescale).  The (scale, good, bad, found_inf) carry lives on device
+        # and is donated; no per-step host sync.
+        self._scaler = scaler if (scaler is not None
+                                  and getattr(scaler, "_enable", False)) else None
+        if self._scaler is not None:
+            s = self._scaler
+            self._scaler_state = (jnp.asarray(s._scale, jnp.float32),
+                                  jnp.asarray(s._good_steps, jnp.int32),
+                                  jnp.asarray(s._bad_steps, jnp.int32),
+                                  jnp.zeros((), jnp.bool_))
+        else:
+            self._scaler_state = None
 
         named_p = list(model.named_parameters())
         self._pnames = [k for k, _ in named_p]
@@ -121,10 +135,17 @@ class TrainStep:
         if fn is None:
             fn = self._build(treedef, bool(self.model.training))
             self._compiled[avals] = fn
-        out = fn(self._diff_params, self._opt_state, self._buffers,
-                 self._frozen_params, self._lr_dev, self._rng_carry, *vals)
+        if self._scaler_state is not None:
+            out = fn(self._diff_params, self._opt_state, self._buffers,
+                     self._frozen_params, self._lr_dev, self._rng_carry,
+                     self._scaler_state, *vals)
+        else:
+            out = fn(self._diff_params, self._opt_state, self._buffers,
+                     self._frozen_params, self._lr_dev, self._rng_carry, *vals)
         loss, self._diff_params, self._opt_state, self._buffers, outs, \
-            self._rng_carry = out
+            self._rng_carry, scaler_state = out
+        if scaler_state is not None:
+            self._scaler_state = scaler_state
         self._step_count += 1
         self._rebind()
         loss_t = Tensor(loss, stop_gradient=True)
@@ -148,8 +169,20 @@ class TrainStep:
         self_ref = self
 
         tree_box = [None]  # out-treedef recorded at trace time, per variant
+        use_scaler = self._scaler is not None
+        if use_scaler:
+            sc = self._scaler
+            sc_dynamic = bool(sc._dynamic)
+            sc_incr_every = int(sc._incr_every)
+            sc_decr_every = int(sc._decr_every)
+            sc_incr_ratio = float(sc._incr_ratio)
+            sc_decr_ratio = float(sc._decr_ratio)
 
-        def step(diff_params, opt_state, buffers, frozen, lr, rng_carry, *vals):
+        def step(diff_params, opt_state, buffers, frozen, lr, rng_carry, *rest):
+            if use_scaler:
+                (scale_in, good, bad, _), vals = rest[0], rest[1:]
+            else:
+                scale_in, vals = None, rest
             base_key, rng_counter = rng_carry
             key = jax.random.fold_in(base_key, rng_counter)
             def loss_of_with(dp, vals, buffers, key):
@@ -199,7 +232,10 @@ class TrainStep:
                 return loss_v.astype(jnp.float32), (newb, out_vals)
 
             def loss_of(dp):
-                return loss_of_with(dp, vals, buffers, key)
+                l, aux = loss_of_with(dp, vals, buffers, key)
+                if use_scaler:
+                    l = l * scale_in  # backprop runs on the scaled loss
+                return l, aux
 
             acc = self_ref.accumulate_steps
             if acc > 1:
@@ -220,6 +256,8 @@ class TrainStep:
                     g_acc, l_acc, bufs_c = carry
                     def loss_micro(dp):
                         loss_v, (nb, _o) = loss_of_with(dp, mv, bufs_c, mk)
+                        if use_scaler:
+                            loss_v = loss_v * scale_in
                         return loss_v, nb
                     (l, nb), g = jax.value_and_grad(loss_micro, has_aux=True)(diff_params)
                     g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
@@ -237,11 +275,44 @@ class TrainStep:
             else:
                 (loss, (newb, outs)), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(diff_params)
+            if use_scaler:
+                inv = 1.0 / scale_in
+                loss = loss * inv
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+                found = jnp.zeros((), jnp.bool_)
+                for g in jax.tree_util.tree_leaves(grads):
+                    found = found | ~jnp.all(jnp.isfinite(g))
             new_p, new_s = opt.functional_update(
                 diff_params, grads, opt_state, lr, leaf_meta=leaf_meta)
-            return loss, new_p, new_s, newb, outs, (base_key, rng_counter + 1)
+            if use_scaler:
+                # skip-step: keep old params/opt-state when any grad is
+                # non-finite (one jnp.where per leaf; XLA fuses into the copy)
+                new_p = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(found, o, n), new_p, diff_params)
+                new_s = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(found, o, n), new_s, opt_state)
+                if sc_dynamic:
+                    bad_n = jnp.where(found, bad + 1, 0).astype(jnp.int32)
+                    good_n = jnp.where(found, 0, good + 1).astype(jnp.int32)
+                    dec = found & (bad_n >= sc_decr_every)
+                    inc = (~found) & (good_n >= sc_incr_every)
+                    scale_n = jnp.where(
+                        dec, jnp.maximum(scale_in * sc_decr_ratio, 1.0),
+                        jnp.where(inc, scale_in * sc_incr_ratio, scale_in))
+                    bad_n = jnp.where(dec, 0, bad_n).astype(jnp.int32)
+                    good_n = jnp.where(inc, 0, good_n).astype(jnp.int32)
+                else:
+                    scale_n, good_n, bad_n = scale_in, good, bad
+                scaler_out = (scale_n, good_n, bad_n, found)
+            else:
+                scaler_out = None
+            return (loss, new_p, new_s, newb, outs,
+                    (base_key, rng_counter + 1), scaler_out)
 
-        donate = (0, 1, 2, 5) if self._donate else ()
+        if self._donate:
+            donate = (0, 1, 2, 5, 6) if use_scaler else (0, 1, 2, 5)
+        else:
+            donate = ()
         jitted = jax.jit(step, donate_argnums=donate)
 
         def runner(*args):
@@ -281,14 +352,37 @@ class TrainStep:
         eager ``opt.step()`` / ``opt.state_dict()`` see the trained state."""
         diff = [(k, t) for k, t, d in zip(self._pnames, self._ptensors, self._diff) if d]
         states = self._opt_state
-        for k, t in diff:
-            self.optimizer._states[id(t)] = states[k]
-        self.optimizer._step_count = self._step_count
+        hook = getattr(self.optimizer, "sync_functional_state", None)
+        if hook is not None:  # wrapper optimizers (LookAhead) own their layout
+            hook(diff, states, self._step_count)
+        else:
+            for k, t in diff:
+                self.optimizer._states[id(t)] = states[k]
+            self.optimizer._step_count = self._step_count
+        if self._scaler is not None and self._scaler_state is not None:
+            s, g, b, _ = self._scaler_state
+            self._scaler._scale = float(s)
+            self._scaler._good_steps = int(g)
+            self._scaler._bad_steps = int(b)
         return self
 
+    @property
+    def found_inf(self):
+        """Whether the LAST step skipped its update (traced scaler only)."""
+        return (bool(self._scaler_state[3])
+                if self._scaler_state is not None else False)
+
+    @property
+    def loss_scale(self):
+        return (float(self._scaler_state[0])
+                if self._scaler_state is not None else 1.0)
+
     def state_dict(self):
-        return {"params": dict(self._params), "buffers": dict(self._buffers),
-                "opt_state": self._opt_state, "step": self._step_count}
+        sd = {"params": dict(self._params), "buffers": dict(self._buffers),
+              "opt_state": self._opt_state, "step": self._step_count}
+        if self._scaler_state is not None:
+            sd["scaler_state"] = self._scaler_state
+        return sd
 
     def set_state_dict(self, sd):
         for k, v in sd["params"].items():
@@ -299,6 +393,8 @@ class TrainStep:
         self._buffers.update(sd["buffers"])
         self._opt_state = sd["opt_state"]
         self._step_count = sd.get("step", 0)
+        if "scaler_state" in sd and self._scaler is not None:
+            self._scaler_state = tuple(jnp.asarray(v) for v in sd["scaler_state"])
         self._rebind()
 
 

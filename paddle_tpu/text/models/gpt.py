@@ -221,6 +221,8 @@ class GPTForCausalLM(Layer):
         if not use_cache:
             return self._generate_eager(input_ids, max_new_tokens, temperature,
                                         top_k, seed)
+        if max_new_tokens <= 0:
+            return input_ids
         import jax
         import numpy as np
 
@@ -242,8 +244,9 @@ class GPTForCausalLM(Layer):
         dt = gpt.word_embeddings.weight._value.dtype
         params = {k: p._value for k, p in self.named_parameters()}
         bufs = {k: b._value for k, b in self.named_buffers()}
-        was = self.training
-        self.training = False
+        # eval mode must reach every sublayer (dropout lives in the blocks)
+        modes = [(m, m.training) for m in self.sublayers(include_self=True)]
+        self.eval()
 
         def fwd(params, bufs, ids, ks, vs, pos):
             with no_grad_ctx(), _rng.rng_scope(jax.random.key(0)), \
@@ -294,7 +297,8 @@ class GPTForCausalLM(Layer):
                                    jax.random.fold_in(base, t))
                 out.append(np.asarray(nxt)[:, None])
         finally:
-            self.training = was
+            for m, t in modes:
+                m.training = t
         new = np.concatenate(out, axis=1)
         return Tensor(jnp.asarray(np.concatenate([ids0, new], axis=1)))
 

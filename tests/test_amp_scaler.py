@@ -1,0 +1,198 @@
+"""Traced fp16 GradScaler inside TrainStep + EMA/LookAhead/ModelAverage.
+
+Reference semantics (SURVEY.md §2.2 AMP row: loss-scaling needed for fp16
+parity): scale loss, unscale grads, skip the optimizer update when any grad
+is non-finite, dynamic rescale — all as traced ops in the fused step.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate.optimizer import (
+    ExponentialMovingAverage, LookAhead, ModelAverage,
+)
+
+
+def _model(lr=0.05):
+    paddle.seed(11)
+    m = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 3))
+    o = opt.SGD(learning_rate=lr, parameters=m.parameters())
+    return m, o
+
+
+def _xy(b=8):
+    rs = np.random.RandomState(3)
+    x = paddle.to_tensor(rs.randn(b, 6).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 3, (b,)).astype("int64"))
+    return x, y
+
+
+def _w(m):
+    return [np.asarray(p._value).copy() for p in m.parameters()]
+
+
+class TestTracedScaler:
+    def test_skip_step_and_rescale(self):
+        m, o = _model()
+        sc = paddle.amp.GradScaler(init_loss_scaling=256.0, incr_every_n_steps=2,
+                                   incr_ratio=2.0, decr_ratio=0.5)
+        lossf = nn.CrossEntropyLoss()
+        step = paddle.jit.TrainStep(m, o, loss_fn=lossf, scaler=sc)
+        x, y = _xy()
+
+        step(x, y)
+        assert not step.found_inf and step.loss_scale == 256.0
+        w_good = _w(m)
+
+        bad = paddle.to_tensor(np.full((8, 6), np.inf, dtype="float32"))
+        step(bad, y)
+        assert step.found_inf, "inf grads must be detected inside the trace"
+        assert step.loss_scale == 128.0, "scale halves after a bad step"
+        for a, b in zip(w_good, _w(m)):
+            np.testing.assert_array_equal(a, b)  # update skipped
+
+        step(x, y)
+        step(x, y)
+        assert step.loss_scale == 256.0, "scale doubles after incr_every good steps"
+        changed = any(not np.array_equal(a, b) for a, b in zip(w_good, _w(m)))
+        assert changed
+
+    def test_scaled_matches_unscaled_training(self):
+        # with finite grads, scaling must be numerically invisible (f32)
+        x, y = _xy()
+        lossf = nn.CrossEntropyLoss()
+        m1, o1 = _model()
+        s1 = paddle.jit.TrainStep(m1, o1, loss_fn=lossf)
+        m2, o2 = _model()
+        sc = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 10)
+        s2 = paddle.jit.TrainStep(m2, o2, loss_fn=lossf, scaler=sc)
+        l1 = [float(s1(x, y)) for _ in range(3)]
+        l2 = [float(s2(x, y)) for _ in range(3)]
+        np.testing.assert_allclose(l1, l2, rtol=1e-5, atol=1e-6)
+
+    def test_sync_writes_back_scaler(self):
+        m, o = _model()
+        sc = paddle.amp.GradScaler(init_loss_scaling=64.0)
+        step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss(), scaler=sc)
+        x, y = _xy()
+        step(paddle.to_tensor(np.full((8, 6), np.nan, dtype="float32")), y)
+        step.sync()
+        assert sc._scale == 32.0
+
+    def test_scaler_with_accumulation(self):
+        m, o = _model()
+        sc = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss(),
+                                    scaler=sc, accumulate_steps=2)
+        x, y = _xy(8)
+        l = float(step(x, y))
+        assert np.isfinite(l) and not step.found_inf
+
+
+class TestLookAhead:
+    def test_eager_matches_functional(self):
+        x, y = _xy()
+        lossf = nn.CrossEntropyLoss()
+
+        m1, o1 = _model()
+        la1 = LookAhead(o1, alpha=0.5, k=2)
+        for _ in range(4):
+            l = lossf(m1(x), y)
+            l.backward()
+            la1.step()
+            la1.clear_grad()
+
+        m2, o2 = _model()
+        la2 = LookAhead(o2, alpha=0.5, k=2)
+        step = paddle.jit.TrainStep(m2, o2 := la2, loss_fn=lossf)
+        for _ in range(4):
+            step(x, y)
+        for a, b in zip(_w(m1), _w(m2)):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_sync_routes_wrapper_state(self):
+        x, y = _xy()
+        m, o = _model()
+        la = LookAhead(o, alpha=0.5, k=2)
+        step = paddle.jit.TrainStep(m, la, loss_fn=nn.CrossEntropyLoss())
+        for _ in range(3):
+            step(x, y)
+        step.sync()  # must not KeyError on the {'inner','slow','count'} layout
+        assert la._eager_count == 3
+        assert o._step_count == 3
+        assert len(o._states) == len(list(m.parameters()))
+        assert len(la._slow) == len(list(m.parameters()))
+
+    def test_slow_weights_pull_back(self):
+        # after a k-sync, params = slow + alpha*(fast-slow) != plain-SGD fast
+        x, y = _xy()
+        lossf = nn.CrossEntropyLoss()
+        m_plain, o_plain = _model()
+        s_plain = paddle.jit.TrainStep(m_plain, o_plain, loss_fn=lossf)
+        m_la, o_inner = _model()
+        s_la = paddle.jit.TrainStep(m_la, LookAhead(o_inner, alpha=0.5, k=2),
+                                    loss_fn=lossf)
+        for _ in range(2):
+            s_plain(x, y)
+            s_la(x, y)
+        diffs = [np.abs(a - b).max() for a, b in zip(_w(m_plain), _w(m_la))]
+        assert max(diffs) > 1e-7
+
+
+class TestAveragers:
+    def test_ema_apply_restore(self):
+        m, o = _model()
+        step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss())
+        ema = ExponentialMovingAverage(m, decay=0.5)
+        x, y = _xy()
+        for _ in range(3):
+            step(x, y)
+            ema.update()
+        live = _w(m)
+        with ema.apply():
+            avg = _w(m)
+        restored = _w(m)
+        for a, b in zip(live, restored):
+            np.testing.assert_array_equal(a, b)
+        assert any(not np.allclose(a, b) for a, b in zip(live, avg))
+
+    def test_apply_before_update_is_identity(self):
+        # t=0: no update yet — apply() must hand back the LIVE weights, not
+        # the zero-initialized shadow (reference EMA seeds from the weights)
+        m, _ = _model()
+        live = _w(m)
+        for avg in (ExponentialMovingAverage(m, decay=0.9), ModelAverage(model=m)):
+            with avg.apply():
+                got = _w(m)
+            for a, b in zip(live, got):
+                np.testing.assert_array_equal(a, b)
+
+    def test_ema_debias_first_step(self):
+        m, _ = _model()
+        ema = ExponentialMovingAverage(m, decay=0.9)
+        ema.update()  # t=1: debiased shadow == current weights exactly
+        live = _w(m)
+        with ema.apply():
+            avg = _w(m)
+        for a, b in zip(live, avg):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+    def test_model_average_exact_mean(self):
+        m, o = _model()
+        step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss())
+        ma = ModelAverage(model=m)
+        x, y = _xy()
+        snaps = []
+        for _ in range(3):
+            step(x, y)
+            ma.update()
+            snaps.append(_w(m))
+        expect = [np.mean([s[i] for s in snaps], axis=0)
+                  for i in range(len(snaps[0]))]
+        with ma.apply():
+            got = _w(m)
+        for e, g in zip(expect, got):
+            np.testing.assert_allclose(e, g, rtol=1e-5, atol=1e-6)

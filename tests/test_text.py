@@ -188,3 +188,12 @@ def test_gpt_generate_jitted_cache_matches_eager():
     out_s = m.generate(ids, max_new_tokens=5, temperature=0.8, top_k=4,
                        seed=7).numpy()
     assert out_s.shape == (2, 11)
+    # max_new_tokens=0 returns the prompt unchanged (both paths)
+    np.testing.assert_array_equal(
+        m.generate(ids, max_new_tokens=0).numpy(), ids.numpy())
+    # a train-mode model still decodes deterministically (dropout must be
+    # disabled recursively inside the traced decode, then restored)
+    m.train()
+    out_t = m.generate(ids, max_new_tokens=12, temperature=0.0).numpy()
+    np.testing.assert_array_equal(out_e, out_t)
+    assert m.training and all(l.training for l in m.sublayers())

@@ -1,0 +1,175 @@
+"""ZeRO stage-2/3 verification + Megatron-SP end-to-end (VERDICT r2 #8).
+
+Reference analogs: fleet GroupShardedStage2/3 (grad reduce-scatter, param
+sharding with JIT all-gather) and fleet/utils/sequence_parallel_utils
+(ScatterOp/GatherOp around the TP block).  Here both are sharding specs;
+these tests assert the specs actually land on the arrays (memory really
+drops per device) and that the SP annotations are numerically invisible
+and differentiable.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def _per_device_bytes(arr):
+    """Bytes this array holds on ONE device (its first addressable shard)."""
+    return arr.addressable_shards[0].data.nbytes
+
+
+class TestZero3:
+    def _build(self):
+        paddle.seed(5)
+        m = nn.Sequential(nn.Linear(32, 128), nn.ReLU(), nn.Linear(128, 8))
+        o = opt.AdamW(learning_rate=1e-2, parameters=m.parameters())
+        return m, o
+
+    def test_params_really_sharded(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import group_sharded_parallel
+
+        m, o = self._build()
+        m, o, _ = group_sharded_parallel(m, o, level="p_g_os")
+        n = jax.device_count()
+        for p in m.parameters():
+            v = p._value
+            if v.ndim and max(v.shape) % n == 0 and max(v.shape) >= n:
+                assert _per_device_bytes(v) == v.nbytes // n, \
+                    f"param {v.shape} not sharded: {v.sharding}"
+
+    def test_stage3_reduces_peak_param_memory(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import group_sharded_parallel
+
+        m, _ = self._build()
+        full = sum(p._value.nbytes for p in m.parameters())
+        m2, o2 = self._build()
+        m2, o2, _ = group_sharded_parallel(m2, o2, level="p_g_os")
+        per_dev = sum(_per_device_bytes(p._value) for p in m2.parameters())
+        # big matrices shard 8-way; biases replicate — well under half total
+        assert per_dev < 0.35 * full, (per_dev, full)
+
+    def test_stage3_trains_to_parity(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import group_sharded_parallel
+
+        x = paddle.to_tensor(np.random.RandomState(0).randn(16, 32).astype("float32"))
+        y = paddle.to_tensor(np.random.RandomState(1).randint(0, 8, (16,)).astype("int64"))
+        lossf = nn.CrossEntropyLoss()
+
+        m1, o1 = self._build()
+        s1 = paddle.jit.TrainStep(m1, o1, loss_fn=lossf)
+        ref = [float(s1(x, y)) for _ in range(3)]
+
+        m2, o2 = self._build()
+        m2, o2, _ = group_sharded_parallel(m2, o2, level="p_g_os")
+        s2 = paddle.jit.TrainStep(m2, o2, loss_fn=lossf)
+        got = [float(s2(x, y)) for _ in range(3)]
+        np.testing.assert_allclose(ref, got, rtol=1e-4, atol=1e-5)
+
+    def test_stage2_opt_state_sharded_and_step_sharding_stable(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import group_sharded_parallel
+
+        m, o = self._build()
+        m, o, _ = group_sharded_parallel(m, o, level="os_g")
+        step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss())
+        n = jax.device_count()
+
+        def sharded_leaves(state):
+            return [v for v in jax.tree_util.tree_leaves(state)
+                    if hasattr(v, "sharding") and v.ndim
+                    and max(v.shape) % n == 0 and max(v.shape) >= n]
+
+        before = sharded_leaves(step._opt_state)
+        assert before, "no shardable optimizer-state leaves found"
+        for v in before:
+            assert _per_device_bytes(v) == v.nbytes // n, str(v.sharding)
+
+        x = paddle.to_tensor(np.random.RandomState(0).randn(16, 32).astype("float32"))
+        y = paddle.to_tensor(np.random.RandomState(1).randint(0, 8, (16,)).astype("int64"))
+        step(x, y)
+        step(x, y)
+        # donation must preserve the ZeRO layout across steps
+        for v in sharded_leaves(step._opt_state):
+            assert _per_device_bytes(v) == v.nbytes // n, str(v.sharding)
+
+
+class TestSequenceParallel:
+    @pytest.fixture(autouse=True)
+    def _fleet(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 4, "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        yield
+
+    def test_scatter_gather_roundtrip_and_grad(self):
+        from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils as spu
+
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8, 16).astype("float32"),
+            stop_gradient=False)
+        s = spu.ScatterOp.apply(x)
+        g = spu.GatherOp.apply(s)
+        np.testing.assert_allclose(g.numpy(), x.numpy(), rtol=1e-6)
+        # the scattered activation is laid out over mp on the seq dim
+        assert "mp" in str(s._value.sharding.spec)
+        assert _per_device_bytes(s._value) * 4 == s._value.nbytes
+        (g * 2.0).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full((2, 8, 16), 2.0),
+                                   rtol=1e-6)
+
+    def test_sp_block_matches_dense(self):
+        """ScatterOp → ColumnParallel → gelu → RowParallel → GatherOp must
+        equal the same math on full weights (the Megatron-SP sandwich)."""
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils as spu
+
+        paddle.seed(3)
+        col = spu.ColumnSequenceParallelLinear(16, 64, gather_output=False)
+        row = spu.RowSequenceParallelLinear(64, 16, input_is_parallel=True)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 8, 16).astype("float32"))
+
+        xs = spu.ScatterOp.apply(x)
+        h = nn.functional.gelu(col(xs))
+        y = spu.GatherOp.apply(row(h))
+
+        import math
+        erf = np.vectorize(math.erf)
+        h_np = x.numpy() @ col.weight.numpy() + col.bias.numpy()
+        h_np = 0.5 * h_np * (1.0 + erf(h_np / np.sqrt(2.0)))  # exact-erf gelu
+        y_np = h_np @ row.weight.numpy() + row.bias.numpy()
+        np.testing.assert_allclose(y.numpy(), y_np, rtol=2e-4, atol=2e-4)
+
+    def test_sp_trains_through_fused_step(self):
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils as spu
+
+        class SPBlock(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.col = spu.ColumnSequenceParallelLinear(16, 64,
+                                                            gather_output=False)
+                self.row = spu.RowSequenceParallelLinear(64, 16,
+                                                         input_is_parallel=True)
+
+            def forward(self, x):
+                x = spu.ScatterOp.apply(x)
+                h = nn.functional.gelu(self.col(x))
+                return spu.GatherOp.apply(self.row(h))
+
+        paddle.seed(7)
+        m = SPBlock()
+        o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+        step = paddle.jit.TrainStep(
+            m, o, loss_fn=lambda out, t: ((out - t) ** 2).mean())
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 8, 16).astype("float32"))
+        t = paddle.to_tensor(np.random.RandomState(1).randn(2, 8, 16).astype("float32"))
+        losses = [float(step(x, t)) for _ in range(4)]
+        assert losses[-1] < losses[0]
