@@ -116,8 +116,12 @@ class LookAhead:
 
     def state_dict(self):
         sd = self.inner_optimizer.state_dict()
+        # slow weights serialized by parameter ORDER (ids don't survive a
+        # process restart)
+        plist = self.inner_optimizer._parameter_list
         sd["lookahead"] = {"alpha": self.alpha, "k": self.k,
-                           "count": self._eager_count}
+                           "count": self._eager_count,
+                           "slow": [self._slow.get(id(p)) for p in plist]}
         return sd
 
     def set_state_dict(self, sd):
@@ -126,6 +130,12 @@ class LookAhead:
         self.inner_optimizer.set_state_dict(inner_sd)
         if la:
             self._eager_count = la.get("count", 0)
+            slow = la.get("slow")
+            if slow is not None:
+                for p, s in zip(self.inner_optimizer._parameter_list, slow):
+                    if s is not None:
+                        self._slow[id(p)] = jnp.asarray(
+                            s._value if isinstance(s, Tensor) else s)
 
 
 class _AveragerBase:
@@ -231,6 +241,9 @@ class ExponentialMovingAverage(_AveragerBase):
     def set_state_dict(self, sd):
         self._shadow.update(sd.get("shadow", {}))
         self._t = sd.get("t", self._t)
+        if "decay" in sd and sd["decay"] != self.decay:
+            self.decay = sd["decay"]
+            self._jit_update = None  # old closure captured the old decay
 
 
 class ModelAverage(_AveragerBase):
@@ -245,8 +258,7 @@ class ModelAverage(_AveragerBase):
                  min_average_window=10000, max_average_window=10000, name=None,
                  model=None):
         target = model if model is not None else (parameters or [])
-        super().__init__(target)
-        self._shadow = {k: jnp.zeros_like(v) for k, v in self._shadow.items()}
+        super().__init__(target)  # base zero-inits the shadow
         self._t = 0
 
     def update(self):

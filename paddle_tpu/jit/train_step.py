@@ -223,6 +223,8 @@ class TrainStep:
                     newb = {k: model._captured_buffers[k] for k in bnames}
                 finally:
                     model.training = was
+                if isinstance(loss, dict):  # detection-style loss dicts
+                    loss = loss["loss"]
                 loss_v = loss._value if isinstance(loss, Tensor) else loss
                 out_leaves, out_tree = jax.tree_util.tree_flatten(
                     outs, is_leaf=lambda x: isinstance(x, Tensor))

@@ -1,0 +1,286 @@
+"""CSPRepResNet backbone + CustomCSPPAN neck — PP-YOLOE's actual
+architecture (reference analog: ppdet/modeling/backbones/cspresnet.py and
+ppdet/modeling/necks/custom_pan.py).
+
+TPU-first notes:
+- RepVGG blocks train with a 3x3 + 1x1 dual branch and re-parameterize
+  into ONE fused 3x3 conv for inference (``convert_to_deploy``) — the
+  fusion is pure weight algebra done once on host; both forms are static
+  graphs XLA maps straight onto the MXU.
+- Effective-SE attention is a per-channel sigmoid gate off the spatial
+  mean — one [B,C] matmul, fuses into the surrounding convs.
+- Everything is NCHW at the API (reference parity); the conv kernels
+  themselves run through the framework's layout-optimized conv path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nn
+from ...nn import functional as F
+from ...tensor.dispatch import apply as _apply
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, ch_in, ch_out, filter_size=3, stride=1, groups=1,
+                 padding=0, act="swish"):
+        super().__init__()
+        self.conv = nn.Conv2D(ch_in, ch_out, filter_size, stride=stride,
+                              padding=padding, groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(ch_out)
+        self.act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        if self.act == "swish":
+            x = F.silu(x)
+        elif self.act == "relu":
+            x = F.relu(x)
+        return x
+
+
+class RepVggBlock(nn.Layer):
+    """3x3 + 1x1 dual-branch block; ``convert_to_deploy`` folds both convs
+    and their BNs into one 3x3 conv (reference RepVGG re-parameterization)."""
+
+    def __init__(self, ch_in, ch_out, act="relu"):
+        super().__init__()
+        self.ch_in = ch_in
+        self.ch_out = ch_out
+        self.conv1 = ConvBNLayer(ch_in, ch_out, 3, stride=1, padding=1, act=None)
+        self.conv2 = ConvBNLayer(ch_in, ch_out, 1, stride=1, padding=0, act=None)
+        self.act = act
+        self.conv = None  # set by convert_to_deploy
+
+    def forward(self, x):
+        if self.conv is not None:
+            y = self.conv(x)
+        else:
+            y = self.conv1(x) + self.conv2(x)
+        return F.relu(y) if self.act == "relu" else F.silu(y)
+
+    # -------------------------------------------------- re-parameterization
+    def _fuse_conv_bn(self, branch):
+        """(conv W [Cout,Cin,k,k], bn) -> equivalent (W', b')."""
+        w = branch.conv.weight.numpy()
+        bn = branch.bn
+        gamma = bn.weight.numpy()
+        beta = bn.bias.numpy()
+        mean = bn._mean.numpy()
+        var = bn._variance.numpy()
+        eps = bn._epsilon
+        import numpy as np
+
+        std = np.sqrt(var + eps)
+        w_f = w * (gamma / std)[:, None, None, None]
+        b_f = beta - mean * gamma / std
+        return w_f, b_f
+
+    def convert_to_deploy(self):
+        import numpy as np
+
+        w3, b3 = self._fuse_conv_bn(self.conv1)
+        w1, b1 = self._fuse_conv_bn(self.conv2)
+        # pad the 1x1 kernel to 3x3 (centered) and sum the branches
+        w1_p = np.pad(w1, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        fused = nn.Conv2D(self.ch_in, self.ch_out, 3, stride=1, padding=1)
+        fused.weight.set_value((w3 + w1_p).astype("float32"))
+        fused.bias.set_value((b3 + b1).astype("float32"))
+        self.conv = fused
+        return self
+
+
+class EffectiveSELayer(nn.Layer):
+    """eSE channel attention (CenterMask): gate = hardsigmoid(fc(mean))."""
+
+    def __init__(self, channels):
+        super().__init__()
+        self.fc = nn.Conv2D(channels, channels, 1)
+
+    def forward(self, x):
+        def fn(v):
+            return v.mean(axis=(2, 3), keepdims=True)
+
+        s = _apply(fn, x, op_name="global_pool")
+        return x * F.hardsigmoid(self.fc(s))
+
+
+class BasicBlock(nn.Layer):
+    def __init__(self, ch_in, ch_out, act="relu", shortcut=True):
+        super().__init__()
+        self.conv1 = ConvBNLayer(ch_in, ch_out, 3, stride=1, padding=1, act=act)
+        self.conv2 = RepVggBlock(ch_out, ch_out, act=act)
+        self.shortcut = shortcut and ch_in == ch_out
+
+    def forward(self, x):
+        y = self.conv2(self.conv1(x))
+        return x + y if self.shortcut else y
+
+
+class CSPResStage(nn.Layer):
+    """Cross-stage-partial stage: downsample, split into two 1x1 paths, run
+    the block stack on one, concat, eSE-attend, project."""
+
+    def __init__(self, ch_in, ch_out, n, stride=2, act="relu", attn=True):
+        super().__init__()
+        ch_mid = (ch_in + ch_out) // 2
+        self.conv_down = (ConvBNLayer(ch_in, ch_mid, 3, stride=stride,
+                                      padding=1, act=act)
+                          if stride != 1 else None)
+        if self.conv_down is None:
+            ch_mid = ch_in
+        self.conv1 = ConvBNLayer(ch_mid, ch_mid // 2, 1, act=act)
+        self.conv2 = ConvBNLayer(ch_mid, ch_mid // 2, 1, act=act)
+        self.blocks = nn.Sequential(*[
+            BasicBlock(ch_mid // 2, ch_mid // 2, act=act, shortcut=True)
+            for _ in range(n)])
+        self.attn = EffectiveSELayer(ch_mid) if attn else None
+        self.conv3 = ConvBNLayer(ch_mid, ch_out, 1, act=act)
+
+    def forward(self, x):
+        if self.conv_down is not None:
+            x = self.conv_down(x)
+        y1 = self.conv1(x)
+        y2 = self.blocks(self.conv2(x))
+        from ...tensor import manipulation as M
+
+        y = M.concat([y1, y2], axis=1)
+        if self.attn is not None:
+            y = self.attn(y)
+        return self.conv3(y)
+
+
+class CSPRepResNet(nn.Layer):
+    """reference cspresnet: stem of three 3x3 convs + four CSP stages;
+    returns the C3/C4/C5 taps for the neck."""
+
+    def __init__(self, layers=(3, 6, 6, 3), channels=(64, 128, 256, 512, 1024),
+                 act="swish", return_idx=(1, 2, 3), width_mult=1.0,
+                 depth_mult=1.0):
+        super().__init__()
+        channels = [max(int(round(c * width_mult)), 16) for c in channels]
+        layers = [max(int(round(l * depth_mult)), 1) for l in layers]
+        self.stem = nn.Sequential(
+            ConvBNLayer(3, channels[0] // 2, 3, stride=2, padding=1, act=act),
+            ConvBNLayer(channels[0] // 2, channels[0] // 2, 3, stride=1,
+                        padding=1, act=act),
+            ConvBNLayer(channels[0] // 2, channels[0], 3, stride=1,
+                        padding=1, act=act))
+        self.stages = nn.LayerList([
+            CSPResStage(channels[i], channels[i + 1], layers[i], stride=2,
+                        act=act) for i in range(4)])
+        self.return_idx = tuple(return_idx)
+        self.out_channels = [channels[i + 1] for i in self.return_idx]
+
+    def forward(self, x):
+        x = self.stem(x)
+        outs = []
+        for i, stage in enumerate(self.stages):
+            x = stage(x)
+            if i in self.return_idx:
+                outs.append(x)
+        return outs
+
+    def convert_to_deploy(self):
+        for l in self.sublayers():
+            if isinstance(l, RepVggBlock):
+                l.convert_to_deploy()
+        return self
+
+
+class SPP(nn.Layer):
+    """Spatial pyramid pooling: parallel max-pools concat'd (static k)."""
+
+    def __init__(self, ch_in, ch_out, k=(5, 9, 13), act="swish"):
+        super().__init__()
+        self.pools = [nn.MaxPool2D(kernel_size=kk, stride=1, padding=kk // 2)
+                      for kk in k]
+        self.conv = ConvBNLayer(ch_in * (len(k) + 1), ch_out, 1, act=act)
+
+    def forward(self, x):
+        from ...tensor import manipulation as M
+
+        outs = [x] + [p(x) for p in self.pools]
+        return self.conv(M.concat(outs, axis=1))
+
+
+class CSPStage(nn.Layer):
+    """Neck CSP stage (custom_pan.CSPStage): split, BasicBlock chain
+    (+optional SPP), concat, project."""
+
+    def __init__(self, ch_in, ch_out, n, act="swish", spp=False):
+        super().__init__()
+        ch_mid = ch_out // 2
+        self.conv1 = ConvBNLayer(ch_in, ch_mid, 1, act=act)
+        self.conv2 = ConvBNLayer(ch_in, ch_mid, 1, act=act)
+        blocks = []
+        for i in range(n):
+            blocks.append(BasicBlock(ch_mid, ch_mid, act=act, shortcut=False))
+            if i == (n - 1) // 2 and spp:
+                blocks.append(SPP(ch_mid, ch_mid, act=act))
+        self.blocks = nn.Sequential(*blocks)
+        self.conv3 = ConvBNLayer(ch_mid * 2, ch_out, 1, act=act)
+
+    def forward(self, x):
+        from ...tensor import manipulation as M
+
+        y1 = self.conv1(x)
+        y2 = self.blocks(self.conv2(x))
+        return self.conv3(M.concat([y1, y2], axis=1))
+
+
+class CustomCSPPAN(nn.Layer):
+    """PP-YOLOE neck: top-down FPN then bottom-up PAN, CSPStage fusion at
+    every junction, SPP on the deepest level."""
+
+    def __init__(self, in_channels, out_channels=(768, 384, 192), act="swish",
+                 stage_num=1, block_num=3, spp=True):
+        super().__init__()
+        out_channels = list(out_channels)
+        self.fpn_stages = nn.LayerList()
+        self.fpn_routes = nn.LayerList()
+        ch_pre = 0
+        n_levels = len(in_channels)
+        for i, (ch_in, ch_out) in enumerate(zip(in_channels[::-1], out_channels)):
+            cin = ch_in + (ch_pre // 2 if i > 0 else 0)
+            self.fpn_stages.append(CSPStage(cin, ch_out, block_num, act=act,
+                                            spp=spp and i == 0))
+            if i < n_levels - 1:
+                self.fpn_routes.append(
+                    ConvBNLayer(ch_out, ch_out // 2, 1, act=act))
+            ch_pre = ch_out
+        self.pan_stages = nn.LayerList()
+        self.pan_routes = nn.LayerList()
+        for i in range(n_levels - 1):
+            ch_low = out_channels[n_levels - 1 - i]   # finer level
+            ch_high = out_channels[n_levels - 2 - i]  # coarser target
+            self.pan_routes.append(
+                ConvBNLayer(ch_low, ch_low, 3, stride=2, padding=1, act=act))
+            self.pan_stages.append(
+                CSPStage(ch_low + ch_high, ch_high, block_num, act=act))
+        self.out_channels = out_channels[::-1]  # finest-first, like inputs
+
+    def forward(self, feats):
+        from ...tensor import manipulation as M
+
+        # top-down
+        fpn_feats = []
+        route = None
+        for i, feat in enumerate(feats[::-1]):
+            if i > 0:
+                up = F.interpolate(route, size=feat.shape[2:], mode="nearest")
+                feat = M.concat([up, feat], axis=1)
+            feat = self.fpn_stages[i](feat)
+            fpn_feats.append(feat)
+            if i < len(feats) - 1:
+                route = self.fpn_routes[i](feat)
+        # bottom-up
+        pan_feats = [fpn_feats[-1]]
+        route = fpn_feats[-1]
+        for i in range(len(feats) - 1):
+            down = self.pan_routes[i](route)
+            block = fpn_feats[len(feats) - 2 - i]
+            route = self.pan_stages[i](M.concat([down, block], axis=1))
+            pan_feats.append(route)
+        return pan_feats  # finest-first

@@ -174,24 +174,7 @@ class YOLOv3(nn.Layer):
         C = self.num_classes
 
         def fn(cls, obj, box, gtb, gtl):
-            B, N = cls.shape[0], cls.shape[1]
-            M_ = gtb.shape[1]
-            cx, cy = centers[:, 0], centers[:, 1]
-            x1, y1, x2, y2 = gtb[..., 0], gtb[..., 1], gtb[..., 2], gtb[..., 3]
-            valid_gt = (gtl >= 0)
-            inside = ((cx[None, :, None] >= x1[:, None]) &
-                      (cx[None, :, None] <= x2[:, None]) &
-                      (cy[None, :, None] >= y1[:, None]) &
-                      (cy[None, :, None] <= y2[:, None]) &
-                      valid_gt[:, None, :])                     # [B,N,M]
-            area = jnp.maximum((x2 - x1) * (y2 - y1), 1.0)
-            area_big = jnp.where(valid_gt, area, 1e18)[:, None, :] * \
-                jnp.where(inside, 1.0, 1e9)
-            match = jnp.argmin(area_big, axis=-1)               # [B,N]
-            pos = inside.any(axis=-1)                           # [B,N]
-
-            tgt_label = jnp.take_along_axis(gtl, match, axis=1)
-            tgt_box = jnp.take_along_axis(gtb, match[..., None], axis=1)
+            pos, tgt_label, tgt_box = _center_inside_assign(centers, gtb, gtl)
 
             # objectness: BCE on all locations
             obj_t = pos.astype(jnp.float32)
@@ -242,6 +225,28 @@ class YOLOv3(nn.Layer):
 def _bce_logits(logits, targets):
     return jnp.maximum(logits, 0) - logits * targets + \
         jnp.log1p(jnp.exp(-jnp.abs(logits)))
+
+
+def _center_inside_assign(centers, gtb, gtl):
+    """FCOS-style static-shape assignment: each location is positive for the
+    smallest valid gt box containing it.  Returns (pos [B,N] bool,
+    tgt_label [B,N], tgt_box [B,N,4])."""
+    cx, cy = centers[:, 0], centers[:, 1]
+    x1, y1, x2, y2 = gtb[..., 0], gtb[..., 1], gtb[..., 2], gtb[..., 3]
+    valid_gt = (gtl >= 0)
+    inside = ((cx[None, :, None] >= x1[:, None]) &
+              (cx[None, :, None] <= x2[:, None]) &
+              (cy[None, :, None] >= y1[:, None]) &
+              (cy[None, :, None] <= y2[:, None]) &
+              valid_gt[:, None, :])                     # [B,N,M]
+    area = jnp.maximum((x2 - x1) * (y2 - y1), 1.0)
+    area_big = jnp.where(valid_gt, area, 1e18)[:, None, :] * \
+        jnp.where(inside, 1.0, 1e9)
+    match = jnp.argmin(area_big, axis=-1)               # [B,N]
+    pos = inside.any(axis=-1)                           # [B,N]
+    tgt_label = jnp.take_along_axis(gtl, match, axis=1)
+    tgt_box = jnp.take_along_axis(gtb, match[..., None], axis=1)
+    return pos, tgt_label, tgt_box
 
 
 def _pairwise_iou(a, b):
@@ -424,13 +429,197 @@ def _softmax_ce(logits, labels):
     return lse - picked
 
 
+def varifocal_loss(pred_logits, gt_score, label, alpha=0.75, gamma=2.0):
+    """VariFocal loss (reference: ppdet ppyoloe_head.varifocal_loss).
+
+    IoU-aware classification: positives are weighted by their quality target
+    ``gt_score`` (the IoU), negatives by ``alpha * p^gamma`` — the BCE runs
+    against the CONTINUOUS target q, so the classifier learns to predict
+    localization quality.  All-jnp, static shapes.
+
+    Args: pred_logits [..., C] raw logits; gt_score [..., C] targets in
+    [0,1] (onehot * iou); label [..., C] {0,1} positive-class indicator.
+    """
+    p = jax.nn.sigmoid(pred_logits)
+    weight = alpha * (p ** gamma) * (1.0 - label) + gt_score * label
+    bce = jnp.maximum(pred_logits, 0) - pred_logits * gt_score + \
+        jnp.log1p(jnp.exp(-jnp.abs(pred_logits)))
+    return bce * weight
+
+
+def _pairwise_giou(a, b):
+    """Elementwise GIoU of aligned box tensors [..., 4] (xyxy)."""
+    iou = _pairwise_iou(a, b)
+    ex1 = jnp.minimum(a[..., 0], b[..., 0])
+    ey1 = jnp.minimum(a[..., 1], b[..., 1])
+    ex2 = jnp.maximum(a[..., 2], b[..., 2])
+    ey2 = jnp.maximum(a[..., 3], b[..., 3])
+    hull = jnp.clip(ex2 - ex1, 0) * jnp.clip(ey2 - ey1, 0)
+    ix1 = jnp.maximum(a[..., 0], b[..., 0])
+    iy1 = jnp.maximum(a[..., 1], b[..., 1])
+    ix2 = jnp.minimum(a[..., 2], b[..., 2])
+    iy2 = jnp.minimum(a[..., 3], b[..., 3])
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    area_a = jnp.clip(a[..., 2] - a[..., 0], 0) * jnp.clip(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.clip(b[..., 2] - b[..., 0], 0) * jnp.clip(b[..., 3] - b[..., 1], 0)
+    union = jnp.maximum(area_a + area_b - inter, 1e-9)
+    return iou - (hull - union) / jnp.maximum(hull, 1e-9)
+
+
+class PPYOLOEHead(nn.Layer):
+    """Per-level decoupled head over the CSPPAN taps (channel counts differ
+    per level, so stems/preds are LayerLists): eSE-attended stems, then
+    cls [C] and reg [4] 1x1 preds.  No objectness branch — PP-YOLOE folds
+    quality into the classifier via VariFocal loss."""
+
+    def __init__(self, num_classes, in_channels):
+        super().__init__()
+        from .cspresnet import ConvBNLayer, EffectiveSELayer
+
+        self.num_classes = num_classes
+        self.stem_cls = nn.LayerList()
+        self.stem_reg = nn.LayerList()
+        self.pred_cls = nn.LayerList()
+        self.pred_reg = nn.LayerList()
+        self.attn_cls = nn.LayerList()
+        for c in in_channels:
+            self.stem_cls.append(ConvBNLayer(c, c, 3, padding=1, act="swish"))
+            self.attn_cls.append(EffectiveSELayer(c))
+            self.stem_reg.append(ConvBNLayer(c, c, 3, padding=1, act="swish"))
+            self.pred_cls.append(nn.Conv2D(c, num_classes, 1))
+            self.pred_reg.append(nn.Conv2D(c, 4, 1))
+
+    def forward(self, feats):
+        outs = []
+        for i, f in enumerate(feats):
+            c = self.attn_cls[i](self.stem_cls[i](f)) + f
+            r = self.stem_reg[i](f)
+            outs.append((self.pred_cls[i](c), self.pred_reg[i](r)))
+        return outs
+
+
+class PPYOLOE(nn.Layer):
+    """PP-YOLOE (reference: ppdet configs/ppyoloe): CSPRepResNet backbone,
+    CustomCSPPAN neck, anchor-free head, VariFocal cls + GIoU box losses.
+    Same static-shape train/eval contract as :class:`YOLOv3`.
+
+    size: 's'/'m'/'l'/'x' — the reference's width/depth multiplier table.
+    """
+
+    strides = (8, 16, 32)
+    _sizes = {"s": (0.50, 0.33), "m": (0.75, 0.67),
+              "l": (1.00, 1.00), "x": (1.25, 1.33)}
+
+    def __init__(self, num_classes=80, size="s", max_boxes=50,
+                 score_thresh=0.05, nms_thresh=0.6, top_k=100):
+        super().__init__()
+        from .cspresnet import CSPRepResNet, CustomCSPPAN
+
+        width, depth = self._sizes[size]
+        self.backbone = CSPRepResNet(
+            width_mult=width, depth_mult=depth)
+        neck_out = tuple(max(int(round(c * width)), 16)
+                         for c in (768, 384, 192))
+        self.neck = CustomCSPPAN(self.backbone.out_channels,
+                                 out_channels=neck_out,
+                                 block_num=max(int(round(3 * depth)), 1))
+        self.head = PPYOLOEHead(num_classes, self.neck.out_channels)
+        self.num_classes = num_classes
+        self.max_boxes = max_boxes
+        self.score_thresh = score_thresh
+        self.nms_thresh = nms_thresh
+        self.top_k = top_k
+
+    def convert_to_deploy(self):
+        from .cspresnet import RepVggBlock
+
+        for l in self.sublayers():  # backbone AND neck rep blocks
+            if isinstance(l, RepVggBlock):
+                l.convert_to_deploy()
+        return self
+
+    def _dense_predictions(self, img):
+        feats = self.neck(self.backbone(img))
+        outs = self.head(feats)
+        all_cls, all_box = [], []
+        for (cls, reg), stride in zip(outs, self.strides):
+            B, C, H, W = cls.shape
+            centers = _grid_centers(H, W, float(stride))
+
+            def flat(t):
+                return t.transpose([0, 2, 3, 1]).reshape([B, H * W, -1])
+
+            all_cls.append(flat(cls))
+            box = _apply(lambda r, c=centers, s=float(stride):
+                         _decode_ltrb(c[None], r, s), flat(reg),
+                         op_name="decode_box")
+            all_box.append(box)
+        from ...tensor import manipulation as M
+
+        return M.concat(all_cls, axis=1), M.concat(all_box, axis=1)
+
+    def forward(self, img, gt_boxes=None, gt_labels=None):
+        cls, box = self._dense_predictions(img)
+        if gt_boxes is not None:
+            return self._loss(cls, box, img.shape[2:], gt_boxes, gt_labels)
+        return self._postprocess(cls, box)
+
+    def _loss(self, cls, box, img_hw, gt_boxes, gt_labels):
+        C = self.num_classes
+        centers = jnp.concatenate([
+            _grid_centers(img_hw[0] // s, img_hw[1] // s, float(s))
+            for s in self.strides], axis=0)
+
+        def fn(cls, box, gtb, gtl):
+            pos, tgt_label, tgt_box = _center_inside_assign(centers, gtb, gtl)
+            posf = pos.astype(jnp.float32)
+
+            iou = _pairwise_iou(box, tgt_box)                   # quality q
+            onehot = jax.nn.one_hot(jnp.clip(tgt_label, 0, C - 1), C)
+            label = onehot * posf[..., None]
+            gt_score = label * jax.lax.stop_gradient(iou)[..., None]
+            l_vfl = varifocal_loss(cls, gt_score, label).sum() / \
+                jnp.maximum(posf.sum(), 1.0)
+
+            giou = _pairwise_giou(box, tgt_box)
+            l_box = ((1.0 - giou) * posf).sum() / jnp.maximum(posf.sum(), 1.0)
+            return l_vfl, l_box
+
+        l_vfl, l_box = _apply(fn, cls, box, gt_boxes, gt_labels,
+                              op_name="ppyoloe_loss", n_outs=None)
+        total = l_vfl + 2.5 * l_box
+        return {"loss": total, "loss_vfl": l_vfl, "loss_box": l_box}
+
+    def _postprocess(self, cls, box):
+        import numpy as np
+
+        results = []
+        for b in range(cls.shape[0]):
+            scores = F.sigmoid(cls[b])                          # [N, C]
+            best = scores.max(axis=-1)
+            label = scores.argmax(axis=-1)
+            idx, valid = vops.nms_padded(box[b], best, self.nms_thresh,
+                                         top_k=self.top_k, category_idxs=label)
+            iv = np.asarray(idx.numpy())
+            vv = np.asarray(valid.numpy())
+            sc = best.numpy()[np.maximum(iv, 0)]
+            keep = vv & (sc > self.score_thresh)
+            results.append({
+                "boxes": Tensor(box[b].numpy()[np.maximum(iv, 0)]),
+                "scores": Tensor(sc),
+                "labels": Tensor(label.numpy()[np.maximum(iv, 0)]),
+                "valid": Tensor(keep),
+            })
+        return results
+
+
 def yolov3(num_classes=80, **kwargs):
     return YOLOv3(num_classes=num_classes, **kwargs)
 
 
 def ppyoloe(num_classes=80, **kwargs):
-    """PP-YOLOE-shaped constructor (anchor-free decoupled head)."""
-    return YOLOv3(num_classes=num_classes, **kwargs)
+    """PP-YOLOE proper: CSPRepResNet + CustomCSPPAN + VariFocal/GIoU."""
+    return PPYOLOE(num_classes=num_classes, **kwargs)
 
 
 def faster_rcnn(num_classes=80, **kwargs):

@@ -217,11 +217,21 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0., nms_top_k=400
     sv = _v(scores)
     C, n = sv.shape
 
+    off = 0.0 if normalized else 1.0
+
     def per_class(sc):
+        # one traced program vmapped over classes — no per-class Python loop
+        # (compile variants don't scale with C; the MXU-unfriendly branchy
+        # NMS is exactly why SOLOv2's decay formulation is the TPU variant)
         order = jnp.argsort(-sc)[:nms_top_k]
         b = bv[order]
         s = sc[order]
-        iou = jnp.asarray(_v(box_iou(Tensor(b), Tensor(b))))
+        tl = jnp.maximum(b[:, None, :2], b[None, :, :2])
+        br = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+        wh = jnp.maximum(br - tl + off, 0.0)
+        inter = wh[..., 0] * wh[..., 1]
+        area = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+        iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-9)
         m = iou.shape[0]
         upper = jnp.triu(iou, k=1)              # [i,j] valid for i < j
         comp = upper.max(axis=0)                # comp_i: overlap with above-i
@@ -234,17 +244,8 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0., nms_top_k=400
         decay = jnp.minimum(ratio.min(axis=0), 1.0)
         return s * decay, order
 
-    outs, idxs = [], []
-    for c in range(C):
-        if c == background_label:
-            continue
-        s_dec, order = per_class(sv[c])
-        m = min(nms_top_k, n)
-        cls_col = jnp.full((m, 1), float(c))
-        outs.append(jnp.concatenate(
-            [cls_col, s_dec[:m, None], bv[order[:m]]], axis=1))
-        idxs.append(order[:m])
-    if not outs:
+    cls_keep = _np.asarray([c for c in range(C) if c != background_label])
+    if cls_keep.size == 0:
         empty = Tensor(jnp.zeros((0, 6), jnp.float32))
         parts = [empty]  # reference order: out, rois_num, index
         if return_rois_num:
@@ -252,8 +253,14 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0., nms_top_k=400
         if return_index:
             parts.append(Tensor(jnp.zeros((0,), jnp.int64)))
         return parts[0] if len(parts) == 1 else tuple(parts)
-    all_out = jnp.concatenate(outs, axis=0)
-    all_idx = jnp.concatenate(idxs, axis=0)
+    s_dec_all, order_all = jax.vmap(per_class)(sv[cls_keep])  # [Ck, m]
+    m = s_dec_all.shape[1]
+    cls_col = jnp.broadcast_to(
+        jnp.asarray(cls_keep, jnp.float32)[:, None, None], (len(cls_keep), m, 1))
+    entries = jnp.concatenate(
+        [cls_col, s_dec_all[:, :, None], bv[order_all]], axis=2)  # [Ck, m, 6]
+    all_out = entries.reshape(-1, 6)
+    all_idx = order_all.reshape(-1)
     sel = jnp.argsort(-all_out[:, 1])[:keep_top_k]
     out = all_out[sel]
     out_idx = all_idx[sel]

@@ -126,6 +126,35 @@ class TestLookAhead:
         assert len(o._states) == len(list(m.parameters()))
         assert len(la._slow) == len(list(m.parameters()))
 
+    def test_checkpoint_roundtrip_keeps_slow_weights(self):
+        x, y = _xy()
+        lossf = nn.CrossEntropyLoss()
+        m1, o1 = _model()
+        la1 = LookAhead(o1, alpha=0.5, k=2)
+        for _ in range(3):
+            l = lossf(m1(x), y)
+            l.backward()
+            la1.step()
+            la1.clear_grad()
+        sd = la1.state_dict()
+        w_ckpt = _w(m1)
+
+        m2, o2 = _model()
+        for p2, w in zip(m2.parameters(), w_ckpt):
+            p2.set_value(w)
+        la2 = LookAhead(o2, alpha=0.5, k=2)
+        la2.set_state_dict(sd)
+        # one more step on BOTH must stay in lockstep (step 4 is a k-sync:
+        # it reads the restored slow weights, so a dropped _slow would
+        # KeyError or diverge here)
+        for la, m in ((la1, m1), (la2, m2)):
+            l = lossf(m(x), y)
+            l.backward()
+            la.step()
+            la.clear_grad()
+        for a, b in zip(_w(m1), _w(m2)):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
     def test_slow_weights_pull_back(self):
         # after a k-sync, params = slow + alpha*(fast-slow) != plain-SGD fast
         x, y = _xy()

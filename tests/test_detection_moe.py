@@ -232,3 +232,80 @@ def test_native_pipeline_kernels():
     xs = np.array([3, 2, 1, 0], np.int32)
     crop = native.crop_batch(imgs, ys, xs, 16, 16)
     np.testing.assert_array_equal(crop[2], imgs[2, 2:18, 1:17])
+
+
+# ================================================== PP-YOLOE proper (r3)
+def test_cspresnet_backbone_and_pan():
+    from paddle_tpu.vision.models.cspresnet import CSPRepResNet, CustomCSPPAN
+
+    paddle.seed(0)
+    bb = CSPRepResNet(layers=(1, 1, 1, 1), channels=(16, 16, 32, 64, 128))
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 64, 64)
+                         .astype("float32"))
+    feats = bb(x)
+    assert [tuple(f.shape) for f in feats] == \
+        [(1, 32, 8, 8), (1, 64, 4, 4), (1, 128, 2, 2)]
+    neck = CustomCSPPAN(bb.out_channels, out_channels=(48, 32, 24), block_num=1)
+    outs = neck(feats)
+    # finest-first, matching head strides (8, 16, 32)
+    assert [tuple(o.shape) for o in outs] == \
+        [(1, 24, 8, 8), (1, 32, 4, 4), (1, 48, 2, 2)]
+
+
+def test_repvgg_fusion_exact():
+    """Re-parameterized single 3x3 conv must equal the dual-branch form."""
+    from paddle_tpu.vision.models.cspresnet import RepVggBlock
+
+    paddle.seed(1)
+    blk = RepVggBlock(8, 8, act="relu").eval()
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 8, 16, 16)
+                         .astype("float32"))
+    y0 = blk(x).numpy()
+    blk.convert_to_deploy()
+    y1 = blk(x).numpy()
+    np.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-5)
+
+
+def test_varifocal_loss_formula():
+    from paddle_tpu.vision.models.detection import varifocal_loss
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    logits = rs.randn(6, 3).astype("float32")
+    q = np.zeros((6, 3), "float32")
+    lab = np.zeros((6, 3), "float32")
+    q[0, 1] = 0.7
+    lab[0, 1] = 1.0
+    got = np.asarray(varifocal_loss(jnp.asarray(logits), jnp.asarray(q),
+                                    jnp.asarray(lab), alpha=0.75, gamma=2.0))
+    p = 1 / (1 + np.exp(-logits))
+    bce = -(q * np.log(p) + (1 - q) * np.log(1 - p))
+    w = 0.75 * p ** 2 * (1 - lab) + q * lab
+    np.testing.assert_allclose(got, bce * w, rtol=1e-4, atol=1e-5)
+
+
+def test_ppyoloe_trains_and_evals():
+    from paddle_tpu.vision.models.detection import ppyoloe
+
+    paddle.seed(0)
+    m = ppyoloe(num_classes=4, size="s")
+    img = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 64, 64)
+                           .astype("float32"))
+    gtb = np.zeros((2, 5, 4), "float32")
+    gtl = np.full((2, 5), -1, "int64")
+    gtb[0, 0] = [8, 8, 40, 40]; gtl[0, 0] = 1
+    gtb[1, 0] = [16, 16, 56, 56]; gtl[1, 0] = 3
+    opt_ = opt.Adam(learning_rate=1e-3, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, opt_)  # dict-loss model, no loss_fn
+    batch = {"img": img, "gt_boxes": paddle.to_tensor(gtb),
+             "gt_labels": paddle.to_tensor(gtl)}
+    losses = [float(step(batch)) for _ in range(3)]
+    assert losses[-1] < losses[0], losses
+    m.eval()
+    res = m(img)
+    assert res[0]["boxes"].shape[1] == 4
+    # deploy-time rep fusion keeps eval outputs (scores) close
+    s0 = res[0]["scores"].numpy()
+    m.convert_to_deploy()
+    s1 = m(img)[0]["scores"].numpy()
+    np.testing.assert_allclose(s0, s1, rtol=1e-3, atol=1e-4)

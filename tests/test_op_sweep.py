@@ -1,0 +1,780 @@
+"""Systematic OpTest sweep (SURVEY.md §4 "the workhorse"): every op in the
+table is checked forward against a numpy reference, and — where marked
+differentiable — its tape gradient is checked against a central
+finite-difference DIRECTIONAL derivative (two op evals per input, so the
+sweep stays fast at f32 precision; per-element FD lives in op_test.OpTest
+for targeted debugging).
+
+Spec format: (id, fn(tensors)->Tensor, ref(arrays)->array, inputs, grad).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+# ---------------------------------------------------------------- helpers
+
+def _rs(seed):
+    return np.random.RandomState(seed)
+
+
+def rnd(*shape, lo=-1.0, hi=1.0, seed=0):
+    r = _rs(abs(hash((shape, lo, hi, seed))) % (2 ** 31))
+    return (r.uniform(lo, hi, size=shape)).astype("float32")
+
+
+def pos(*shape, lo=0.2, hi=2.0, seed=0):
+    return rnd(*shape, lo=lo, hi=hi, seed=seed)
+
+
+SPECS = []
+
+
+def spec(name, fn, ref, inputs, grad=True, rtol=1e-5, atol=1e-5,
+         grad_rtol=3e-2, grad_atol=3e-3):
+    SPECS.append(dict(id=name, fn=fn, ref=ref, inputs=inputs, grad=grad,
+                      rtol=rtol, atol=atol, grad_rtol=grad_rtol,
+                      grad_atol=grad_atol))
+
+
+def U(name, ref, lo=-0.9, hi=0.9, grad=True, fn=None, **kw):
+    """Unary op paddle.<name>(x)."""
+    f = fn or (lambda x, _n=name: getattr(paddle, _n)(x))
+    spec(name, f, ref, {"x": rnd(3, 4, lo=lo, hi=hi, seed=len(SPECS))},
+         grad=grad, **kw)
+
+
+def B(name, ref, lo=-0.9, hi=0.9, lo2=None, hi2=None, grad=True, **kw):
+    """Binary op paddle.<name>(x, y)."""
+    lo2 = lo if lo2 is None else lo2
+    hi2 = hi if hi2 is None else hi2
+    spec(name, lambda x, y, _n=name: getattr(paddle, _n)(x, y), ref,
+         {"x": rnd(3, 4, lo=lo, hi=hi, seed=len(SPECS)),
+          "y": rnd(3, 4, lo=lo2, hi=hi2, seed=len(SPECS) + 1000)},
+         grad=grad, **kw)
+
+
+def A(name, ref, grad=True, fn=None, **kw):
+    """Activation F.<name>(x)."""
+    f = fn or (lambda x, _n=name: getattr(F, _n)(x))
+    spec(f"F.{name}", f, ref, {"x": rnd(3, 4, lo=-2.0, hi=2.0, seed=len(SPECS))},
+         grad=grad, **kw)
+
+
+# ---------------------------------------------------- reference helpers
+
+def _softmax(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def _scipy(name):
+    from jax.scipy import special as jsp  # numpy refs via jax.scipy on host
+    import jax.numpy as jnp
+
+    def f(x):
+        return np.asarray(getattr(jsp, name)(jnp.asarray(x, jnp.float64)
+                                             if False else jnp.asarray(x)))
+    return f
+
+
+def _scipy_erfinv(v):
+    from jax.scipy.special import erfinv
+    import jax.numpy as jnp
+
+    return float(np.asarray(erfinv(jnp.float32(v))))
+
+
+def _cumtrapz(y):
+    out = np.cumsum((y[:, 1:] + y[:, :-1]) / 2.0, axis=1)
+    return out
+
+
+def _index_fill(x, index, v):
+    out = x.copy()
+    out[index] = v
+    return out
+
+
+def _index_add(x, index, value):
+    out = x.copy()
+    np.add.at(out, index, value)
+    return out
+
+
+def _put_along(arr, indices, values):
+    out = arr.copy()
+    np.put_along_axis(out, indices, values, 1)
+    return out
+
+
+def _scatter_overwrite(x, index, updates):
+    out = x.copy()
+    out[index] = updates
+    return out
+
+
+def _scatter_nd_add(x, index, updates):
+    out = x.copy()
+    for i, row in enumerate(index):
+        out[tuple(row)] += updates[i]
+    return out
+
+
+def _spd(n, seed=0):
+    a = rnd(n, n, seed=seed)
+    return (a @ a.T + n * np.eye(n)).astype("float32")
+
+
+def _qr_r(x):
+    r = np.linalg.qr(x)[1].astype("float32")
+    return np.abs(r)  # sign convention differs; compare magnitudes
+
+
+
+# ------------------------------------------------------------ unary math
+U("exp", np.exp)
+U("expm1", np.expm1)
+U("exp2", np.exp2)
+U("log", np.log, lo=0.2, hi=3.0)
+U("log2", np.log2, lo=0.2, hi=3.0)
+U("log10", np.log10, lo=0.2, hi=3.0)
+U("log1p", np.log1p, lo=-0.5, hi=2.0)
+U("sqrt", np.sqrt, lo=0.2, hi=3.0)
+U("rsqrt", lambda x: 1.0 / np.sqrt(x), lo=0.2, hi=3.0)
+U("square", np.square)
+U("abs", np.abs, lo=0.1, hi=2.0)
+U("sin", np.sin)
+U("cos", np.cos)
+U("tan", np.tan)
+U("asin", np.arcsin)
+U("acos", np.arccos)
+U("atan", np.arctan)
+U("sinh", np.sinh)
+U("cosh", np.cosh)
+U("tanh", np.tanh)
+U("asinh", np.arcsinh)
+U("acosh", np.arccosh, lo=1.2, hi=3.0)
+U("atanh", np.arctanh, lo=-0.7, hi=0.7)
+U("erf", lambda x: np.vectorize(math.erf)(x).astype("float32"))
+U("erfinv", lambda x: np.vectorize(_scipy_erfinv)(x).astype("float32"),
+  lo=-0.7, hi=0.7)
+U("sigmoid", lambda x: 1 / (1 + np.exp(-x)))
+U("reciprocal", lambda x: 1.0 / x, lo=0.3, hi=2.0)
+U("neg", np.negative)
+U("floor", np.floor, grad=False, lo=-3, hi=3)
+U("ceil", np.ceil, grad=False, lo=-3, hi=3)
+U("round", np.round, grad=False, lo=-3, hi=3)
+U("trunc", np.trunc, grad=False, lo=-3, hi=3)
+U("frac", lambda x: x - np.trunc(x), lo=0.1, hi=0.9)
+U("sign", np.sign, grad=False, lo=0.2, hi=2.0)
+U("lgamma", lambda x: np.vectorize(math.lgamma)(x).astype("float32"),
+  lo=0.5, hi=3.0, grad_rtol=5e-2)
+U("digamma", lambda x: _scipy("digamma")(x).astype("float32"), lo=0.8, hi=3.0,
+  grad_rtol=5e-2)
+U("i0", lambda x: _scipy("i0")(x).astype("float32"), lo=-2, hi=2)
+U("i1", lambda x: _scipy("i1")(x).astype("float32"), lo=-2, hi=2)
+U("sinc", lambda x: np.sinc(x), lo=0.1, hi=0.9)
+U("rad2deg", np.degrees)
+U("deg2rad", np.radians, lo=-90, hi=90)
+U("angle", lambda x: np.angle(x).astype("float32"), grad=False, lo=0.2, hi=2.0)
+U("signbit", np.signbit, grad=False)
+U("nan_to_num", np.nan_to_num, lo=-2, hi=2)
+U("logit", lambda x: np.log(x / (1 - x)), lo=0.2, hi=0.8,
+  fn=lambda x: paddle.logit(x)) if hasattr(paddle, "logit") else None
+U("stanh", lambda x: 1.7159 * np.tanh(0.67 * x), lo=-2, hi=2,
+  fn=lambda x: paddle.stanh(x))
+U("conj", np.conj, grad=False)
+U("real", lambda x: x.real, grad=False)
+U("imag", lambda x: np.imag(x).astype("float32"), grad=False)
+
+# ------------------------------------------------------------ binary math
+B("add", np.add)
+B("subtract", np.subtract)
+B("multiply", np.multiply)
+B("divide", np.divide, lo2=0.3, hi2=2.0)
+B("pow", np.power, lo=0.3, hi=2.0, lo2=0.5, hi2=2.0, grad_rtol=5e-2)
+B("maximum", np.maximum)
+B("minimum", np.minimum)
+B("fmax", np.fmax)
+B("fmin", np.fmin)
+B("atan2", np.arctan2, lo=0.2, hi=2.0, lo2=0.2, hi2=2.0)
+B("hypot", np.hypot, lo=0.2, hi=2.0, lo2=0.2, hi2=2.0)
+B("logaddexp", np.logaddexp)
+B("copysign", np.copysign, lo=0.2, hi=2.0, lo2=0.2, hi2=2.0)
+B("floor_divide", np.floor_divide, lo=1.0, hi=9.0, lo2=1.0, hi2=3.0, grad=False)
+B("remainder", lambda x, y: np.mod(x, y), lo=1.0, hi=9.0, lo2=1.0, hi2=3.0,
+  grad=False)
+B("mod", lambda x, y: np.mod(x, y), lo=1.0, hi=9.0, lo2=1.0, hi2=3.0, grad=False)
+B("heaviside", np.heaviside, lo=0.2, hi=2.0, grad=False)
+B("nextafter", np.nextafter, grad=False)
+B("ldexp", lambda x, y: np.ldexp(x, y.astype(np.int32)).astype("float32"),
+  lo2=1.0, hi2=3.9, grad=False)
+B("dist", lambda x, y: np.linalg.norm((x - y).ravel()).astype("float32"),
+  grad_rtol=5e-2)
+spec("lerp", lambda x, y, w: paddle.lerp(x, y, w),
+     lambda x, y, w: x + w * (y - x),
+     {"x": rnd(3, 4, seed=70), "y": rnd(3, 4, seed=71),
+      "w": rnd(3, 4, lo=0.1, hi=0.9, seed=72)})
+spec("gcd", lambda x, y: paddle.gcd(x, y), np.gcd,
+     {"x": _rs(1).randint(1, 20, (3, 4)).astype("int64"),
+      "y": _rs(2).randint(1, 20, (3, 4)).astype("int64")}, grad=False)
+spec("lcm", lambda x, y: paddle.lcm(x, y), np.lcm,
+     {"x": _rs(3).randint(1, 10, (3, 4)).astype("int64"),
+      "y": _rs(4).randint(1, 10, (3, 4)).astype("int64")}, grad=False)
+spec("scale", lambda x: paddle.scale(x, scale=2.5, bias=0.5),
+     lambda x: 2.5 * x + 0.5, {"x": rnd(3, 4, seed=80)})
+spec("clip", lambda x: paddle.clip(x, -0.5, 0.5),
+     lambda x: np.clip(x, -0.5, 0.5),
+     {"x": rnd(3, 4, lo=-2, hi=2, seed=81)})
+spec("multiplex", lambda a, b, index: paddle.multiplex([a, b], index),
+     lambda a, b, index: np.stack([(a, b)[int(i)][r] for r, i in
+                                   enumerate(index.ravel())]),
+     {"a": rnd(3, 4, seed=82), "b": rnd(3, 4, seed=83),
+      "index": np.array([[0], [1], [0]], dtype="int64")}, grad=False)
+
+# ------------------------------------------------------------- reductions
+def _sep(rows, cols, seed=0):
+    """Well-separated values (shuffled grid): order-statistic grads need
+    gaps wider than the FD perturbation."""
+    v = np.linspace(-0.9, 0.9, rows * cols).astype("float32")
+    _rs(seed).shuffle(v)
+    return v.reshape(rows, cols)
+
+
+def R(name, ref, lo=-0.9, hi=0.9, grad=True, axis_variants=(None, 0, 1),
+      separated=False, **kw):
+    for ax in axis_variants:
+        x = (_sep(3, 4, seed=len(SPECS)) if separated
+             else rnd(3, 4, lo=lo, hi=hi, seed=len(SPECS)))
+        spec(f"{name}[axis={ax}]",
+             lambda x, _n=name, _a=ax: getattr(paddle, _n)(x, axis=_a)
+             if _a is not None else getattr(paddle, _n)(x),
+             lambda x, _r=ref, _a=ax: _r(x, axis=_a) if _a is not None else _r(x),
+             {"x": x}, grad=grad, **kw)
+
+
+R("sum", np.sum)
+R("mean", np.mean)
+R("prod", np.prod)
+R("max", np.max, separated=True)
+R("min", np.min, separated=True)
+R("amax", np.amax, separated=True)
+R("amin", np.amin, separated=True)
+R("logsumexp", lambda x, axis=None: np.log(np.sum(np.exp(x), axis=axis)))
+R("std", lambda x, axis=None: np.std(x, axis=axis, ddof=1), grad_rtol=5e-2)
+R("var", lambda x, axis=None: np.var(x, axis=axis, ddof=1), grad_rtol=5e-2)
+R("nansum", np.nansum)
+R("nanmean", np.nanmean)
+R("median", np.median, grad=False, axis_variants=(None, 1))
+R("nanmedian", np.nanmedian, grad=False, axis_variants=(None,))
+spec("norm-fro", lambda x: paddle.norm(x),
+     lambda x: np.linalg.norm(x.ravel()).astype("float32"),
+     {"x": rnd(3, 4, seed=90)}, grad_rtol=5e-2)
+spec("norm-1", lambda x: paddle.norm(x, p=1, axis=1),
+     lambda x: np.abs(x).sum(axis=1),
+     {"x": rnd(3, 4, lo=0.2, hi=2.0, seed=91)})
+spec("count_nonzero", lambda x: paddle.count_nonzero(x),
+     lambda x: np.count_nonzero(x), {"x": rnd(3, 4, seed=92)}, grad=False)
+spec("numel", lambda x: paddle.numel(x), lambda x: np.int64(x.size),
+     {"x": rnd(3, 4, seed=93)}, grad=False)
+spec("quantile", lambda x: paddle.quantile(x, 0.5),
+     lambda x: np.quantile(x, 0.5).astype("float32"),
+     {"x": rnd(3, 4, seed=94)}, grad=False)
+spec("trapezoid", lambda y: paddle.trapezoid(y, axis=1),
+     lambda y: np.trapz(y, axis=1), {"y": rnd(3, 8, seed=95)})
+spec("cumulative_trapezoid", lambda y: paddle.cumulative_trapezoid(y, axis=1),
+     lambda y: _cumtrapz(y), {"y": rnd(3, 8, seed=96)})
+
+# -------------------------------------------------------------- cumulative
+spec("cumsum", lambda x: paddle.cumsum(x, axis=1),
+     lambda x: np.cumsum(x, axis=1), {"x": rnd(3, 4, seed=100)})
+spec("cumprod", lambda x: paddle.cumprod(x, dim=1),
+     lambda x: np.cumprod(x, axis=1),
+     {"x": rnd(3, 4, lo=0.5, hi=1.5, seed=101)})
+spec("logcumsumexp", lambda x: paddle.logcumsumexp(x, axis=1),
+     lambda x: np.log(np.cumsum(np.exp(x), axis=1)), {"x": rnd(3, 4, seed=102)})
+spec("cummax", lambda x: paddle.cummax(x, axis=1)[0],
+     lambda x: np.maximum.accumulate(x, axis=1), {"x": rnd(3, 4, seed=103)},
+     grad=False)
+spec("cummin", lambda x: paddle.cummin(x, axis=1)[0],
+     lambda x: np.minimum.accumulate(x, axis=1), {"x": rnd(3, 4, seed=104)},
+     grad=False)
+spec("diff", lambda x: paddle.diff(x, axis=1),
+     lambda x: np.diff(x, axis=1), {"x": rnd(3, 4, seed=105)})
+
+# ------------------------------------------------------------ activations
+A("relu", lambda x: np.maximum(x, 0))
+A("relu6", lambda x: np.clip(x, 0, 6))
+A("leaky_relu", lambda x: np.where(x > 0, x, 0.01 * x))
+A("elu", lambda x: np.where(x > 0, x, np.expm1(x)))
+A("selu", lambda x: 1.0507009873554805 * np.where(
+    x > 0, x, 1.6732632423543772 * np.expm1(x)))
+A("celu", lambda x: np.maximum(x, 0) + np.minimum(0, np.expm1(x)))
+A("gelu", lambda x: 0.5 * x * (1 + np.vectorize(math.erf)(x / np.sqrt(2.0))),
+  rtol=1e-4, atol=1e-5)
+A("silu", lambda x: x / (1 + np.exp(-x)))
+A("swish", lambda x: x / (1 + np.exp(-x)))
+A("mish", lambda x: x * np.tanh(np.log1p(np.exp(x))))
+A("softplus", lambda x: np.log1p(np.exp(x)))
+A("softsign", lambda x: x / (1 + np.abs(x)))
+A("tanhshrink", lambda x: x - np.tanh(x))
+A("softshrink", lambda x: np.where(x > 0.5, x - 0.5,
+                                   np.where(x < -0.5, x + 0.5, 0.0)))
+A("hardshrink", lambda x: np.where(np.abs(x) > 0.5, x, 0.0))
+A("hardtanh", lambda x: np.clip(x, -1, 1))
+A("hardsigmoid", lambda x: np.clip(x / 6 + 0.5, 0, 1))
+A("hardswish", lambda x: x * np.clip(x + 3, 0, 6) / 6)
+A("log_sigmoid", lambda x: -np.log1p(np.exp(-x)))
+A("thresholded_relu", lambda x: np.where(x > 1.0, x, 0.0))
+A("sigmoid", lambda x: 1 / (1 + np.exp(-x)))
+A("tanh", np.tanh)
+spec("F.softmax", lambda x: F.softmax(x, axis=-1), lambda x: _softmax(x),
+     {"x": rnd(3, 4, lo=-2, hi=2, seed=110)})
+spec("F.log_softmax", lambda x: F.log_softmax(x, axis=-1),
+     lambda x: np.log(_softmax(x)), {"x": rnd(3, 4, lo=-2, hi=2, seed=111)})
+spec("F.glu", lambda x: F.glu(x, axis=-1),
+     lambda x: x[..., :2] / (1 + np.exp(-x[..., 2:])),
+     {"x": rnd(3, 4, lo=-2, hi=2, seed=112)})
+spec("F.prelu", lambda x, w: F.prelu(x, w),
+     lambda x, w: np.where(x > 0, x, w * x),
+     {"x": rnd(3, 4, lo=-2, hi=2, seed=113),
+      "w": np.asarray([0.25], dtype="float32")})
+spec("F.normalize", lambda x: F.normalize(x, axis=1),
+     lambda x: x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), 1e-12),
+     {"x": rnd(3, 4, seed=114)})
+spec("F.cosine_similarity", lambda x, y: F.cosine_similarity(x, y, axis=1),
+     lambda x, y: (x * y).sum(1) / (np.linalg.norm(x, axis=1) *
+                                    np.linalg.norm(y, axis=1)),
+     {"x": rnd(3, 4, lo=0.2, hi=1.0, seed=115),
+      "y": rnd(3, 4, lo=0.2, hi=1.0, seed=116)})
+
+# ------------------------------------------------------------ manipulation
+spec("reshape", lambda x: paddle.reshape(x, [4, 3]),
+     lambda x: x.reshape(4, 3), {"x": rnd(3, 4, seed=120)})
+spec("transpose", lambda x: paddle.transpose(x, [1, 0]),
+     lambda x: x.T, {"x": rnd(3, 4, seed=121)})
+spec("flatten", lambda x: paddle.flatten(x),
+     lambda x: x.ravel(), {"x": rnd(3, 4, seed=122)})
+spec("squeeze", lambda x: paddle.squeeze(x, axis=1),
+     lambda x: x.squeeze(1), {"x": rnd(3, 1, 4, seed=123)})
+spec("unsqueeze", lambda x: paddle.unsqueeze(x, axis=1),
+     lambda x: x[:, None], {"x": rnd(3, 4, seed=124)})
+spec("concat", lambda x, y: paddle.concat([x, y], axis=1),
+     lambda x, y: np.concatenate([x, y], axis=1),
+     {"x": rnd(3, 4, seed=125), "y": rnd(3, 4, seed=126)})
+spec("stack", lambda x, y: paddle.stack([x, y], axis=0),
+     lambda x, y: np.stack([x, y]),
+     {"x": rnd(3, 4, seed=127), "y": rnd(3, 4, seed=128)})
+spec("split", lambda x: paddle.split(x, 2, axis=1)[0],
+     lambda x: np.split(x, 2, axis=1)[0], {"x": rnd(3, 4, seed=129)})
+spec("chunk", lambda x: paddle.chunk(x, 2, axis=1)[1],
+     lambda x: np.split(x, 2, axis=1)[1], {"x": rnd(3, 4, seed=130)})
+spec("tile", lambda x: paddle.tile(x, [2, 1]),
+     lambda x: np.tile(x, (2, 1)), {"x": rnd(3, 4, seed=131)})
+spec("expand", lambda x: paddle.expand(x, [3, 4]),
+     lambda x: np.broadcast_to(x, (3, 4)), {"x": rnd(1, 4, seed=132)})
+spec("broadcast_to", lambda x: paddle.broadcast_to(x, [3, 4]),
+     lambda x: np.broadcast_to(x, (3, 4)), {"x": rnd(1, 4, seed=133)})
+spec("flip", lambda x: paddle.flip(x, axis=1),
+     lambda x: np.flip(x, axis=1), {"x": rnd(3, 4, seed=134)})
+spec("roll", lambda x: paddle.roll(x, 1, axis=1),
+     lambda x: np.roll(x, 1, axis=1), {"x": rnd(3, 4, seed=135)})
+spec("rot90", lambda x: paddle.rot90(x),
+     lambda x: np.rot90(x), {"x": rnd(3, 4, seed=136)})
+spec("moveaxis", lambda x: paddle.moveaxis(x, 0, 1),
+     lambda x: np.moveaxis(x, 0, 1), {"x": rnd(3, 4, seed=137)})
+spec("swapaxes", lambda x: paddle.swapaxes(x, 0, 1),
+     lambda x: np.swapaxes(x, 0, 1), {"x": rnd(3, 4, seed=138)})
+spec("t", lambda x: paddle.t(x), lambda x: x.T, {"x": rnd(3, 4, seed=139)})
+spec("tril", lambda x: paddle.tril(x), np.tril, {"x": rnd(4, 4, seed=140)})
+spec("triu", lambda x: paddle.triu(x), np.triu, {"x": rnd(4, 4, seed=141)})
+spec("diag", lambda x: paddle.diag(x), np.diag, {"x": rnd(4, seed=142)})
+spec("diagflat", lambda x: paddle.diagflat(x), np.diagflat,
+     {"x": rnd(4, seed=143)})
+spec("diag_embed", lambda x: paddle.diag_embed(x),
+     lambda x: np.stack([np.diag(r) for r in x]), {"x": rnd(3, 4, seed=144)})
+spec("kron", lambda x, y: paddle.kron(x, y), np.kron,
+     {"x": rnd(2, 2, seed=145), "y": rnd(2, 2, seed=146)})
+spec("repeat_interleave", lambda x: paddle.repeat_interleave(x, 2, axis=1),
+     lambda x: np.repeat(x, 2, axis=1), {"x": rnd(3, 4, seed=147)})
+spec("unbind", lambda x: paddle.unbind(x, axis=0)[0],
+     lambda x: x[0], {"x": rnd(3, 4, seed=148)})
+spec("unstack", lambda x: paddle.unstack(x, axis=0)[1],
+     lambda x: x[1], {"x": rnd(3, 4, seed=149)})
+spec("hstack", lambda x, y: paddle.hstack([x, y]),
+     lambda x, y: np.hstack([x, y]),
+     {"x": rnd(3, 4, seed=150), "y": rnd(3, 4, seed=151)})
+spec("vstack", lambda x, y: paddle.vstack([x, y]),
+     lambda x, y: np.vstack([x, y]),
+     {"x": rnd(3, 4, seed=152), "y": rnd(3, 4, seed=153)})
+spec("dstack", lambda x, y: paddle.dstack([x, y]),
+     lambda x, y: np.dstack([x, y]),
+     {"x": rnd(3, 4, seed=154), "y": rnd(3, 4, seed=155)})
+spec("column_stack", lambda x, y: paddle.column_stack([x, y]),
+     lambda x, y: np.column_stack([x, y]),
+     {"x": rnd(3, seed=156), "y": rnd(3, seed=157)})
+spec("row_stack", lambda x, y: paddle.row_stack([x, y]),
+     lambda x, y: np.vstack([x, y]),
+     {"x": rnd(3, 4, seed=158), "y": rnd(3, 4, seed=159)})
+spec("hsplit", lambda x: paddle.hsplit(x, 2)[0],
+     lambda x: np.hsplit(x, 2)[0], {"x": rnd(3, 4, seed=160)})
+spec("vsplit", lambda x: paddle.vsplit(x, 3)[0],
+     lambda x: np.vsplit(x, 3)[0], {"x": rnd(3, 4, seed=161)})
+spec("tensor_split", lambda x: paddle.tensor_split(x, 2, axis=1)[0],
+     lambda x: np.array_split(x, 2, axis=1)[0], {"x": rnd(3, 4, seed=162)})
+spec("as_strided", lambda x: paddle.as_strided(x, [2, 2], [4, 1]),
+     lambda x: np.lib.stride_tricks.as_strided(
+         x, (2, 2), (16, 4)), {"x": rnd(3, 4, seed=163)}, grad=False)
+spec("pad-constant", lambda x: paddle.pad(x, [1, 1, 1, 1], value=0.0),
+     lambda x: np.pad(x, ((1, 1), (1, 1))), {"x": rnd(3, 4, seed=164)})
+spec("crop", lambda x: paddle.crop(x, shape=[2, 2], offsets=[1, 1]),
+     lambda x: x[1:3, 1:3], {"x": rnd(3, 4, seed=165)})
+spec("slice", lambda x: paddle.slice(x, [0, 1], [0, 1], [2, 3]),
+     lambda x: x[0:2, 1:3], {"x": rnd(3, 4, seed=166)})
+spec("strided_slice", lambda x: paddle.strided_slice(
+    x, axes=[1], starts=[0], ends=[4], strides=[2]),
+     lambda x: x[:, 0:4:2], {"x": rnd(3, 4, seed=167)})
+
+# --------------------------------------------------------- index / gather
+spec("gather", lambda x, index: paddle.gather(x, index, axis=0),
+     lambda x, index: x[index],
+     {"x": rnd(4, 3, seed=170), "index": np.array([0, 2], dtype="int64")})
+spec("index_select", lambda x, index: paddle.index_select(x, index, axis=1),
+     lambda x, index: x[:, index],
+     {"x": rnd(3, 4, seed=171), "index": np.array([0, 3], dtype="int64")})
+spec("take_along_axis", lambda x, indices: paddle.take_along_axis(x, indices, 1),
+     lambda x, indices: np.take_along_axis(x, indices, 1),
+     {"x": rnd(3, 4, seed=172),
+      "indices": np.array([[0], [1], [2]], dtype="int64")})
+spec("gather_nd", lambda x, index: paddle.gather_nd(x, index),
+     lambda x, index: x[tuple(index.T)],
+     {"x": rnd(4, 3, seed=173),
+      "index": np.array([[0, 0], [2, 1]], dtype="int64")})
+spec("index_sample", lambda x, index: paddle.index_sample(x, index),
+     lambda x, index: np.take_along_axis(x, index, 1),
+     {"x": rnd(3, 4, seed=174),
+      "index": np.array([[0, 1], [2, 3], [1, 1]], dtype="int64")})
+spec("masked_select", lambda x, mask: paddle.masked_select(x, mask),
+     lambda x, mask: x[mask],
+     {"x": rnd(3, 4, seed=175),
+      "mask": np.tile(np.array([True, False, True, False]), (3, 1))},
+     grad=False)
+spec("masked_fill", lambda x, mask: paddle.masked_fill(x, mask, 9.0),
+     lambda x, mask: np.where(mask, np.float32(9.0), x),
+     {"x": rnd(3, 4, seed=176),
+      "mask": np.tile(np.array([True, False, False, True]), (3, 1))})
+spec("where", lambda c, x, y: paddle.where(c, x, y),
+     lambda c, x, y: np.where(c, x, y),
+     {"c": np.tile(np.array([True, False, True, False]), (3, 1)),
+      "x": rnd(3, 4, seed=177), "y": rnd(3, 4, seed=178)})
+spec("take", lambda x, index: paddle.take(x, index),
+     lambda x, index: np.take(x, index),
+     {"x": rnd(3, 4, seed=179), "index": np.array([0, 5, 11], dtype="int64")})
+spec("index_fill", lambda x, index: paddle.index_fill(x, index, 0, 7.0),
+     lambda x, index: _index_fill(x, index, 7.0),
+     {"x": rnd(4, 3, seed=180), "index": np.array([1, 3], dtype="int64")})
+spec("index_add", lambda x, index, value: paddle.index_add(x, index, 0, value),
+     lambda x, index, value: _index_add(x, index, value),
+     {"x": rnd(4, 3, seed=181), "index": np.array([0, 2], dtype="int64"),
+      "value": rnd(2, 3, seed=182)})
+spec("put_along_axis", lambda arr, indices, values:
+     paddle.put_along_axis(arr, indices, values, 1),
+     lambda arr, indices, values: _put_along(arr, indices, values),
+     {"arr": rnd(3, 4, seed=183),
+      "indices": np.array([[0], [1], [2]], dtype="int64"),
+      "values": rnd(3, 1, seed=184)}, grad=False)
+spec("scatter", lambda x, index, updates: paddle.scatter(x, index, updates),
+     lambda x, index, updates: _scatter_overwrite(x, index, updates),
+     {"x": rnd(4, 3, seed=185), "index": np.array([1, 3], dtype="int64"),
+      "updates": rnd(2, 3, seed=186)}, grad=False)
+spec("scatter_nd_add", lambda x, index, updates:
+     paddle.scatter_nd_add(x, index, updates),
+     lambda x, index, updates: _scatter_nd_add(x, index, updates),
+     {"x": rnd(4, 3, seed=187), "index": np.array([[0], [2]], dtype="int64"),
+      "updates": rnd(2, 3, seed=188)}, grad=False)
+
+# ------------------------------------------------------------ search/sort
+spec("argmax", lambda x: paddle.argmax(x, axis=1),
+     lambda x: np.argmax(x, axis=1), {"x": rnd(3, 4, seed=190)}, grad=False)
+spec("argmin", lambda x: paddle.argmin(x, axis=1),
+     lambda x: np.argmin(x, axis=1), {"x": rnd(3, 4, seed=191)}, grad=False)
+spec("argsort", lambda x: paddle.argsort(x, axis=1),
+     lambda x: np.argsort(x, axis=1), {"x": rnd(3, 4, seed=192)}, grad=False)
+spec("sort", lambda x: paddle.sort(x, axis=1),
+     lambda x: np.sort(x, axis=1), {"x": rnd(3, 4, seed=193)})
+spec("topk", lambda x: paddle.topk(x, 2, axis=1)[0],
+     lambda x: -np.sort(-x, axis=1)[:, :2], {"x": rnd(3, 4, seed=194)})
+spec("kthvalue", lambda x: paddle.kthvalue(x, 2, axis=1)[0],
+     lambda x: np.sort(x, axis=1)[:, 1], {"x": rnd(3, 4, seed=195)})
+spec("mode", lambda x: paddle.mode(x, axis=1)[0],
+     lambda x: np.sort(x, axis=1)[:, 0],  # all-distinct floats: max freq=1,
+     {"x": rnd(3, 4, seed=196)}, grad=False)  # the smallest candidate wins
+spec("nonzero", lambda x: paddle.nonzero(x),
+     lambda x: np.stack(np.nonzero(x), axis=1),
+     {"x": np.array([[1.0, 0.0], [0.0, 2.0]], dtype="float32")}, grad=False)
+spec("searchsorted", lambda sorted_sequence, values:
+     paddle.searchsorted(sorted_sequence, values),
+     lambda sorted_sequence, values: np.searchsorted(sorted_sequence, values),
+     {"sorted_sequence": np.array([1.0, 3.0, 5.0, 7.0], dtype="float32"),
+      "values": np.array([2.0, 6.0], dtype="float32")}, grad=False)
+spec("bucketize", lambda x: paddle.bucketize(
+    x, paddle.to_tensor(np.array([0.0, 1.0], dtype="float32"))),
+     lambda x: np.digitize(x, [0.0, 1.0]),
+     {"x": rnd(3, 4, lo=-2, hi=2, seed=197)}, grad=False)
+spec("unique", lambda x: paddle.unique(x),
+     lambda x: np.unique(x),
+     {"x": np.array([3.0, 1.0, 3.0, 2.0], dtype="float32")}, grad=False)
+spec("unique_consecutive", lambda x: paddle.unique_consecutive(x),
+     lambda x: np.array([1.0, 2.0, 1.0], dtype="float32"),
+     {"x": np.array([1.0, 1.0, 2.0, 1.0], dtype="float32")}, grad=False)
+spec("isin", lambda x, test_x: paddle.isin(x, test_x),
+     lambda x, test_x: np.isin(x, test_x),
+     {"x": np.array([1.0, 2.0, 3.0], dtype="float32"),
+      "test_x": np.array([2.0], dtype="float32")}, grad=False)
+spec("histogram", lambda x: paddle.histogram(x, bins=4, min=-1, max=1),
+     lambda x: np.histogram(x, bins=4, range=(-1, 1))[0],
+     {"x": rnd(3, 4, seed=198)}, grad=False)
+spec("bincount", lambda x: paddle.bincount(x),
+     np.bincount, {"x": np.array([0, 1, 1, 3], dtype="int64")}, grad=False)
+
+# ---------------------------------------------------------------- linalg
+spec("matmul", lambda x, y: paddle.matmul(x, y), lambda x, y: x @ y,
+     {"x": rnd(3, 4, seed=200), "y": rnd(4, 2, seed=201)})
+spec("mm", lambda x, y: paddle.mm(x, y), lambda x, y: x @ y,
+     {"x": rnd(3, 4, seed=202), "y": rnd(4, 2, seed=203)})
+spec("bmm", lambda x, y: paddle.bmm(x, y), lambda x, y: x @ y,
+     {"x": rnd(2, 3, 4, seed=204), "y": rnd(2, 4, 2, seed=205)})
+spec("dot", lambda x, y: paddle.dot(x, y), lambda x, y: np.dot(x, y),
+     {"x": rnd(4, seed=206), "y": rnd(4, seed=207)})
+spec("mv", lambda x, vec: paddle.mv(x, vec), lambda x, vec: x @ vec,
+     {"x": rnd(3, 4, seed=208), "vec": rnd(4, seed=209)})
+spec("inner", lambda x, y: paddle.inner(x, y), np.inner,
+     {"x": rnd(3, 4, seed=210), "y": rnd(2, 4, seed=211)})
+spec("outer", lambda x, y: paddle.outer(x, y), np.outer,
+     {"x": rnd(3, seed=212), "y": rnd(4, seed=213)})
+spec("cross", lambda x, y: paddle.cross(x, y),
+     lambda x, y: np.cross(x, y),
+     {"x": rnd(2, 3, seed=214), "y": rnd(2, 3, seed=215)})
+spec("trace", lambda x: paddle.trace(x), np.trace, {"x": rnd(4, 4, seed=216)})
+spec("addmm", lambda input, x, y: paddle.addmm(input, x, y),
+     lambda input, x, y: input + x @ y,
+     {"input": rnd(3, 2, seed=217), "x": rnd(3, 4, seed=218),
+      "y": rnd(4, 2, seed=219)})
+spec("inverse", lambda x: paddle.inverse(x),
+     lambda x: np.linalg.inv(x), {"x": _spd(4, seed=220)},
+     rtol=1e-4, atol=1e-4, grad_rtol=8e-2)
+spec("linalg.det", lambda x: paddle.linalg.det(x),
+     lambda x: np.linalg.det(x).astype("float32"), {"x": _spd(3, seed=221)},
+     rtol=1e-4, atol=1e-4, grad_rtol=8e-2)
+spec("linalg.slogdet", lambda x: paddle.linalg.slogdet(x)[1],
+     lambda x: np.linalg.slogdet(x)[1].astype("float32"),
+     {"x": _spd(3, seed=222)}, rtol=1e-4, atol=1e-4, grad_rtol=8e-2)
+spec("linalg.cholesky", lambda x: paddle.linalg.cholesky(x),
+     lambda x: np.linalg.cholesky(x), {"x": _spd(3, seed=223)},
+     rtol=1e-4, atol=1e-4, grad_rtol=8e-2)
+spec("linalg.solve", lambda x, y: paddle.linalg.solve(x, y),
+     lambda x, y: np.linalg.solve(x, y),
+     {"x": _spd(3, seed=224), "y": rnd(3, 2, seed=225)},
+     rtol=1e-4, atol=1e-4, grad_rtol=8e-2)
+spec("linalg.matrix_power", lambda x: paddle.linalg.matrix_power(x, 3),
+     lambda x: np.linalg.matrix_power(x, 3), {"x": rnd(3, 3, seed=226)},
+     rtol=1e-4, atol=1e-4, grad_rtol=8e-2)
+spec("linalg.norm", lambda x: paddle.linalg.norm(x),
+     lambda x: np.linalg.norm(x.ravel()).astype("float32"),
+     {"x": rnd(3, 4, seed=227)}, grad_rtol=5e-2)
+spec("linalg.svd-s", lambda x: paddle.linalg.svd(x)[1],
+     lambda x: np.linalg.svd(x, compute_uv=False).astype("float32"),
+     {"x": rnd(3, 4, seed=228)}, rtol=1e-4, atol=1e-4, grad=False)
+spec("linalg.qr-r", lambda x: paddle.abs(paddle.linalg.qr(x)[1]),
+     lambda x: _qr_r(x), {"x": rnd(4, 3, seed=229)}, rtol=1e-4, atol=1e-4,
+     grad=False)
+spec("linalg.eigvalsh", lambda x: paddle.linalg.eigvalsh(x),
+     lambda x: np.linalg.eigvalsh(x).astype("float32"),
+     {"x": _spd(3, seed=230)}, rtol=1e-4, atol=1e-4, grad=False)
+spec("linalg.pinv", lambda x: paddle.linalg.pinv(x),
+     lambda x: np.linalg.pinv(x).astype("float32"),
+     {"x": rnd(3, 4, seed=231)}, rtol=1e-3, atol=1e-3, grad=False)
+spec("linalg.matrix_rank", lambda x: paddle.linalg.matrix_rank(x),
+     lambda x: np.int64(np.linalg.matrix_rank(x)), {"x": rnd(3, 4, seed=232)},
+     grad=False)
+spec("linalg.cond", lambda x: paddle.linalg.cond(x),
+     lambda x: np.float32(np.linalg.cond(x)), {"x": _spd(3, seed=233)},
+     rtol=1e-3, atol=1e-3, grad=False)
+spec("linalg.cov", lambda x: paddle.linalg.cov(x),
+     lambda x: np.cov(x).astype("float32"), {"x": rnd(3, 6, seed=234)},
+     rtol=1e-4, atol=1e-4, grad=False)
+spec("linalg.multi_dot", lambda x, y, z: paddle.linalg.multi_dot([x, y, z]),
+     lambda x, y, z: x @ y @ z,
+     {"x": rnd(2, 3, seed=235), "y": rnd(3, 4, seed=236),
+      "z": rnd(4, 2, seed=237)})
+spec("einsum-ij,jk", lambda x, y: paddle.einsum("ij,jk->ik", x, y),
+     lambda x, y: x @ y, {"x": rnd(3, 4, seed=238), "y": rnd(4, 2, seed=239)})
+spec("einsum-bij->bi", lambda x: paddle.einsum("bij->bi", x),
+     lambda x: x.sum(-1), {"x": rnd(2, 3, 4, seed=240)})
+
+# ------------------------------------------------------------ logic / cmp
+def C(name, ref):
+    spec(name, lambda x, y, _n=name: getattr(paddle, _n)(x, y), ref,
+         {"x": _rs(len(SPECS)).randint(0, 3, (3, 4)).astype("float32"),
+          "y": _rs(len(SPECS) + 1).randint(0, 3, (3, 4)).astype("float32")},
+         grad=False)
+
+
+C("equal", np.equal)
+C("not_equal", np.not_equal)
+C("greater_than", np.greater)
+C("greater_equal", np.greater_equal)
+C("less_than", np.less)
+C("less_equal", np.less_equal)
+C("logical_and", np.logical_and)
+C("logical_or", np.logical_or)
+C("logical_xor", np.logical_xor)
+spec("logical_not", lambda x: paddle.logical_not(x), np.logical_not,
+     {"x": np.array([[True, False], [False, True]])}, grad=False)
+spec("isnan", lambda x: paddle.isnan(x), np.isnan,
+     {"x": np.array([1.0, np.nan], dtype="float32")}, grad=False)
+spec("isinf", lambda x: paddle.isinf(x), np.isinf,
+     {"x": np.array([1.0, np.inf], dtype="float32")}, grad=False)
+spec("isfinite", lambda x: paddle.isfinite(x), np.isfinite,
+     {"x": np.array([1.0, np.inf, np.nan], dtype="float32")}, grad=False)
+spec("isclose", lambda x, y: paddle.isclose(x, y), np.isclose,
+     {"x": rnd(3, 4, seed=250), "y": rnd(3, 4, seed=251)}, grad=False)
+spec("allclose", lambda x, y: paddle.allclose(x, y),
+     lambda x, y: np.allclose(x, y),
+     {"x": rnd(3, 4, seed=252), "y": rnd(3, 4, seed=253)}, grad=False)
+spec("equal_all", lambda x, y: paddle.equal_all(x, y),
+     lambda x, y: np.array_equal(x, y),
+     {"x": rnd(3, 4, seed=254), "y": rnd(3, 4, seed=255)}, grad=False)
+spec("all", lambda x: paddle.all(x, axis=1),
+     lambda x: np.all(x, axis=1),
+     {"x": np.array([[True, True], [True, False]])}, grad=False)
+spec("any", lambda x: paddle.any(x, axis=1),
+     lambda x: np.any(x, axis=1),
+     {"x": np.array([[False, False], [True, False]])}, grad=False)
+
+
+def BW(name, ref):
+    spec(name, lambda x, y, _n=name: getattr(paddle, _n)(x, y), ref,
+         {"x": _rs(len(SPECS)).randint(0, 16, (3, 4)).astype("int32"),
+          "y": _rs(len(SPECS) + 1).randint(0, 16, (3, 4)).astype("int32")},
+         grad=False)
+
+
+BW("bitwise_and", np.bitwise_and)
+BW("bitwise_or", np.bitwise_or)
+BW("bitwise_xor", np.bitwise_xor)
+spec("bitwise_not", lambda x: paddle.bitwise_not(x), np.bitwise_not,
+     {"x": _rs(9).randint(0, 16, (3, 4)).astype("int32")}, grad=False)
+
+# --------------------------------------------------------------- creation
+spec("zeros_like", lambda x: paddle.zeros_like(x), np.zeros_like,
+     {"x": rnd(3, 4, seed=260)}, grad=False)
+spec("ones_like", lambda x: paddle.ones_like(x), np.ones_like,
+     {"x": rnd(3, 4, seed=261)}, grad=False)
+spec("full_like", lambda x: paddle.full_like(x, 3.5),
+     lambda x: np.full_like(x, 3.5), {"x": rnd(3, 4, seed=262)}, grad=False)
+spec("cast", lambda x: paddle.cast(x, "float64"),
+     lambda x: x.astype("float64"), {"x": rnd(3, 4, seed=263)}, grad=False,
+     rtol=1e-6, atol=1e-6)
+spec("one_hot", lambda x: F.one_hot(x, 4),
+     lambda x: np.eye(4, dtype="float32")[x],
+     {"x": np.array([0, 2, 3], dtype="int64")}, grad=False)
+spec("vander", lambda x: paddle.vander(x, 3),
+     lambda x: np.vander(x, 3),
+     {"x": rnd(4, seed=264)}, grad=False)
+spec("complex", lambda real, imag: paddle.complex(real, imag),
+     lambda real, imag: real + 1j * imag,
+     {"real": rnd(3, 4, seed=265), "imag": rnd(3, 4, seed=266)}, grad=False)
+
+SPECS = [s for s in SPECS if s is not None]
+_IDS = [s["id"] for s in SPECS]
+assert len(set(_IDS)) == len(_IDS), "duplicate spec ids"
+
+
+# --------------------------------------------------------------- the tests
+
+def _to_tensors(inputs):
+    out = {}
+    for k, v in inputs.items():
+        t = paddle.to_tensor(v)
+        if v.dtype.kind == "f":
+            t.stop_gradient = False
+        out[k] = t
+    return out
+
+
+@pytest.mark.parametrize("case", SPECS, ids=_IDS)
+def test_forward(case):
+    ts = _to_tensors(case["inputs"])
+    out = case["fn"](**ts)
+    ref = case["ref"](*case["inputs"].values())
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for o, r in zip(outs, refs):
+        r = np.asarray(r)
+        o_np = o.numpy()
+        if r.dtype != o_np.dtype and r.dtype.kind == o_np.dtype.kind:
+            r = r.astype(o_np.dtype)
+        np.testing.assert_allclose(o_np, r, rtol=case["rtol"], atol=case["atol"],
+                                   err_msg=case["id"])
+
+
+GRAD_SPECS = [s for s in SPECS if s["grad"]]
+
+
+@pytest.mark.parametrize("case", GRAD_SPECS, ids=[s["id"] for s in GRAD_SPECS])
+def test_grad(case):
+    """Tape gradient vs central-difference directional derivative."""
+    float_keys = [k for k, v in case["inputs"].items() if v.dtype.kind == "f"]
+    assert float_keys
+
+    def loss_value(inputs):
+        ts = _to_tensors(inputs)
+        out = case["fn"](**ts)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        total = 0.0
+        for o in outs:
+            total += float(np.asarray(o.numpy(), np.float64).sum())
+        return ts, total
+
+    ts, _ = loss_value(case["inputs"])
+    out = case["fn"](**ts)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    loss = None
+    for o in outs:
+        s = o.sum()
+        loss = s if loss is None else loss + s
+    loss.backward()
+
+    eps = 1e-2
+    for k in float_keys:
+        if ts[k].grad is None:
+            raise AssertionError(f"{case['id']}: no grad for {k}")
+        g = np.asarray(ts[k].grad.numpy(), np.float64)
+        r = _rs(hash(case["id"] + k) % (2 ** 31)).uniform(
+            -1, 1, size=case["inputs"][k].shape).astype("float32")
+        plus = {kk: vv.copy() for kk, vv in case["inputs"].items()}
+        minus = {kk: vv.copy() for kk, vv in case["inputs"].items()}
+        plus[k] = plus[k] + eps * r
+        minus[k] = minus[k] - eps * r
+        _, lp = loss_value(plus)
+        _, lm = loss_value(minus)
+        numeric = (lp - lm) / (2 * eps)
+        analytic = float((g * r).sum())
+        denom = max(abs(numeric), abs(analytic), 1.0)
+        assert abs(numeric - analytic) <= case["grad_rtol"] * denom + case["grad_atol"], (
+            f"{case['id']} d/d{k}: analytic {analytic:.6f} vs numeric "
+            f"{numeric:.6f}")
+
+
+def test_sweep_scale():
+    """The harness really is the systematic sweep the survey calls for."""
+    assert len(SPECS) >= 150, len(SPECS)
+    assert len(GRAD_SPECS) >= 90, len(GRAD_SPECS)
