@@ -10,6 +10,15 @@ from __future__ import annotations
 
 import jax as _jax
 
+# Multi-process contract (SURVEY.md §3.5): the launch CLI exports
+# PADDLE_TRAINER_* env vars; jax.distributed.initialize must run BEFORE the
+# first backend touch, and importing this package touches the backend — so
+# join the coordination service here, first thing (dependency-free module:
+# the distributed package itself needs tensors, which need the backend).
+from ._bootstrap import maybe_join_coordination_service as _mpi  # noqa: E402
+
+_mpi()
+
 # int64/float64 semantics parity with the reference (paddle defaults labels
 # and index tensors to int64).  Model code stays float32/bf16; f64 on TPU is
 # a user error surfaced by XLA, same as the reference on most GPU kernels.

@@ -1,0 +1,49 @@
+"""Pre-backend bootstrap: join the jax coordination service from the
+launch-CLI env contract (reference analog: paddle.distributed's TCPStore
+rendezvous driven by PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS /
+PADDLE_TRAINERS_NUM — launch/controllers/collective.py).
+
+Must run before ANYTHING initializes the XLA backend, so this module
+imports only jax's top level and touches no devices.  Called from
+``paddle_tpu/__init__`` first thing; ``init_parallel_env`` then finds the
+service already up.
+"""
+
+from __future__ import annotations
+
+import os
+
+_JOINED = [False]
+
+
+def maybe_join_coordination_service():
+    """Call jax.distributed.initialize when the env contract names a
+    multi-process run.  Idempotent; a no-op for single-process runs."""
+    if _JOINED[0]:
+        return
+    n_proc = os.environ.get("JAX_NUM_PROCESSES") or \
+        os.environ.get("PADDLE_TRAINERS_NUM")
+    if not n_proc or int(n_proc) <= 1:
+        return
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coord is None and os.environ.get("PADDLE_TRAINER_ENDPOINTS"):
+        coord = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")[0]
+    if coord is None:
+        return
+    pid = os.environ.get("JAX_PROCESS_ID") or \
+        os.environ.get("PADDLE_TRAINER_ID") or "0"
+    import jax
+
+    try:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=int(n_proc),
+                                   process_id=int(pid))
+    except RuntimeError as e:
+        # tolerate ONLY the double-init case (user called it explicitly);
+        # real rendezvous failures (unreachable coordinator, timeout) must
+        # surface — swallowing them would silently degrade the job to
+        # independent single-process runs
+        msg = str(e)
+        if "once" not in msg and "already" not in msg:
+            raise
+    _JOINED[0] = True

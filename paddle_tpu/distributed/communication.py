@@ -196,7 +196,35 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
 
 def all_gather_object(object_list, obj, group=None):
+    """Gather an arbitrary picklable object from every rank.
+
+    Multi-process: pickle -> uint8 array, agree on the max length, gather
+    via the jax coordination service (process_allgather), unpickle.
+    Single-controller: every "rank" is this process, so the list is the
+    local object replicated (reference scripts see the same shape)."""
     g = _group(group)
+    if jax.process_count() > 1:
+        if g.nranks != jax.process_count():
+            raise NotImplementedError(
+                "all_gather_object over a subgroup is not supported in "
+                "multi-process runs (the gather rides the global "
+                "coordination service); pass group=None")
+        import pickle
+
+        import numpy as np
+        from jax.experimental import multihost_utils as mh
+
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        lengths = mh.process_allgather(jnp.asarray([payload.size], jnp.int32))
+        max_len = int(np.max(np.asarray(lengths)))
+        padded = np.zeros((max_len,), np.uint8)
+        padded[:payload.size] = payload
+        gathered = np.asarray(mh.process_allgather(jnp.asarray(padded)))
+        sizes = np.asarray(lengths).reshape(-1)
+        object_list.extend(
+            pickle.loads(gathered[i, :sizes[i]].tobytes())
+            for i in range(gathered.shape[0]))
+        return object_list
     object_list.extend([obj] * g.nranks)
     return object_list
 

@@ -30,15 +30,13 @@ def init_parallel_env():
     """
     if _INITIALIZED[0]:
         return ParallelEnv()
-    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
-    n_proc = os.environ.get("JAX_NUM_PROCESSES") or os.environ.get("PADDLE_TRAINERS_NUM")
-    pid = os.environ.get("JAX_PROCESS_ID") or os.environ.get("PADDLE_TRAINER_ID")
-    if coord is None and os.environ.get("PADDLE_TRAINER_ENDPOINTS"):
-        coord = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")[0]
-    if coord and n_proc and int(n_proc) > 1:
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=int(n_proc),
-                                   process_id=int(pid or 0))
+    from .._bootstrap import _JOINED, maybe_join_coordination_service
+
+    if not _JOINED[0]:
+        # normally the package import already joined (env contract read
+        # before the first backend touch); late explicit calls still work
+        # when nothing initialized the backend yet
+        maybe_join_coordination_service()
     _INITIALIZED[0] = True
     from . import collective as _c
 

@@ -55,11 +55,23 @@ def main(argv=None):
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
-    os.environ.update(build_env(args.nnodes, args.node_rank, args.master))
+    env = dict(os.environ)
+    env.update(build_env(args.nnodes, args.node_rank, args.master))
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
-        os.environ["PADDLE_LOG_DIR"] = args.log_dir
+        env["PADDLE_LOG_DIR"] = args.log_dir
 
+    if args.nnodes > 1:
+        # multi-process: the worker must import the framework FRESH so the
+        # bootstrap joins the coordination service before any backend touch
+        # (this launcher process may already hold an initialized backend) —
+        # same spawn model as the reference launcher's worker processes.
+        import subprocess
+
+        proc = subprocess.run([sys.executable, args.script] +
+                              list(args.script_args), env=env)
+        return proc.returncode
+    os.environ.update(env)
     sys.argv = [args.script] + list(args.script_args)
     runpy.run_path(args.script, run_name="__main__")
     return 0

@@ -90,6 +90,7 @@ class HybridCommunicateGroup:
         arr = np.asarray(devs[:n]).reshape(tuple(dims.values()))
         self.mesh = Mesh(arr, tuple(dims.keys()))
         self._dims = dims
+        self._warned_axes = set()  # warn-once state, per HCG instance
         from . import collective
 
         self._groups = {}
@@ -122,22 +123,53 @@ class HybridCommunicateGroup:
     def get_sep_parallel_world_size(self):
         return self._dims.get("sep", 1)
 
-    # ranks (single-controller: coordinate of "this process" is 0; scripts use
-    # these for partitioning decisions which the mesh already encodes)
-    def get_data_parallel_rank(self):
+    # ranks: in a multi-process run the process has a real coordinate along
+    # each mesh axis (derived from which mesh devices it owns).  In
+    # single-controller mode one process drives the WHOLE axis, so a
+    # per-rank coordinate does not exist — ported per-rank scripts that
+    # branch on it would silently all act as rank 0, so warn loudly once.
+    def _axis_rank(self, name):
+        n = self._dims.get(name, 1)
+        if n <= 1:
+            return 0
+        import numpy as np
+
+        axis_idx = self.mesh.axis_names.index(name)
+        pid = jax.process_index()
+        devs = np.asarray(self.mesh.devices, dtype=object)
+        local = np.argwhere(np.vectorize(
+            lambda d: d.process_index == pid)(devs))
+        if local.size == 0:
+            return 0
+        coords = set(local[:, axis_idx].tolist())
+        if len(coords) == 1:
+            return int(next(iter(coords)))
+        if name not in self._warned_axes:
+            self._warned_axes.add(name)
+            import warnings
+
+            warnings.warn(
+                f"get_{name}_parallel_rank(): this process drives ALL "
+                f"{n} ranks of the '{name}' axis (single-controller SPMD); "
+                "returning 0. Per-rank branching from the reference's "
+                "multi-process model does not apply here — express "
+                "placement with shardings instead.")
         return 0
+
+    def get_data_parallel_rank(self):
+        return self._axis_rank("dp")
 
     def get_model_parallel_rank(self):
-        return 0
+        return self._axis_rank("mp")
 
     def get_stage_id(self):
-        return 0
+        return self._axis_rank("pp")
 
     def get_sharding_parallel_rank(self):
-        return 0
+        return self._axis_rank("sharding")
 
     def get_sep_parallel_rank(self):
-        return 0
+        return self._axis_rank("sep")
 
     def get_global_rank(self):
         return jax.process_index()

@@ -541,7 +541,7 @@ class PPYOLOE(nn.Layer):
     def _dense_predictions(self, img):
         feats = self.neck(self.backbone(img))
         outs = self.head(feats)
-        all_cls, all_box = [], []
+        all_cls, all_box, all_ctr = [], [], []
         for (cls, reg), stride in zip(outs, self.strides):
             B, C, H, W = cls.shape
             centers = _grid_centers(H, W, float(stride))
@@ -554,21 +554,20 @@ class PPYOLOE(nn.Layer):
                          _decode_ltrb(c[None], r, s), flat(reg),
                          op_name="decode_box")
             all_box.append(box)
+            all_ctr.append(centers)
         from ...tensor import manipulation as M
 
-        return M.concat(all_cls, axis=1), M.concat(all_box, axis=1)
+        return (M.concat(all_cls, axis=1), M.concat(all_box, axis=1),
+                jnp.concatenate(all_ctr, axis=0))
 
     def forward(self, img, gt_boxes=None, gt_labels=None):
-        cls, box = self._dense_predictions(img)
+        cls, box, centers = self._dense_predictions(img)
         if gt_boxes is not None:
-            return self._loss(cls, box, img.shape[2:], gt_boxes, gt_labels)
+            return self._loss(cls, box, centers, gt_boxes, gt_labels)
         return self._postprocess(cls, box)
 
-    def _loss(self, cls, box, img_hw, gt_boxes, gt_labels):
+    def _loss(self, cls, box, centers, gt_boxes, gt_labels):
         C = self.num_classes
-        centers = jnp.concatenate([
-            _grid_centers(img_hw[0] // s, img_hw[1] // s, float(s))
-            for s in self.strides], axis=0)
 
         def fn(cls, box, gtb, gtl):
             pos, tgt_label, tgt_box = _center_inside_assign(centers, gtb, gtl)

@@ -309,3 +309,19 @@ def test_ppyoloe_trains_and_evals():
     m.convert_to_deploy()
     s1 = m(img)[0]["scores"].numpy()
     np.testing.assert_allclose(s0, s1, rtol=1e-3, atol=1e-4)
+
+
+def test_ppyoloe_loss_on_non_divisible_input():
+    """Centers must come from the REAL conv grid, not img_size//stride
+    (they differ when H,W aren't divisible by 32)."""
+    from paddle_tpu.vision.models.detection import ppyoloe
+
+    paddle.seed(2)
+    m = ppyoloe(num_classes=2, size="s")
+    img = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 100, 100)
+                           .astype("float32"))
+    gtb = np.zeros((1, 3, 4), "float32")
+    gtl = np.full((1, 3), -1, "int64")
+    gtb[0, 0] = [10, 10, 60, 60]; gtl[0, 0] = 1
+    losses = m(img, paddle.to_tensor(gtb), paddle.to_tensor(gtl))
+    assert np.isfinite(float(losses["loss"]))

@@ -494,3 +494,27 @@ def test_parallel_softmax_cross_entropy_mp4():
         0.0).sum())(logits)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_hcg_rank_getters_warn_in_single_controller():
+    """Per-axis rank getters must not SILENTLY act as rank 0: when one
+    process drives the whole axis, the first call warns (ported per-rank
+    scripts notice); the value is still 0 (single-controller SPMD)."""
+    import warnings
+    import paddle_tpu.distributed.fleet as fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    hcg._warned_axes = set()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert hcg.get_model_parallel_rank() == 0
+        assert any("drives ALL 4 ranks" in str(x.message) for x in w), \
+            [str(x.message) for x in w]
+    # degree-1 axes stay silent
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert hcg.get_stage_id() == 0
+        assert not w

@@ -11,6 +11,7 @@ Spec format: (id, fn(tensors)->Tensor, ref(arrays)->array, inputs, grad).
 from __future__ import annotations
 
 import math
+import zlib
 
 import numpy as np
 import pytest
@@ -79,8 +80,7 @@ def _scipy(name):
     import jax.numpy as jnp
 
     def f(x):
-        return np.asarray(getattr(jsp, name)(jnp.asarray(x, jnp.float64)
-                                             if False else jnp.asarray(x)))
+        return np.asarray(getattr(jsp, name)(jnp.asarray(x)))
     return f
 
 
@@ -758,7 +758,7 @@ def test_grad(case):
         if ts[k].grad is None:
             raise AssertionError(f"{case['id']}: no grad for {k}")
         g = np.asarray(ts[k].grad.numpy(), np.float64)
-        r = _rs(hash(case["id"] + k) % (2 ** 31)).uniform(
+        r = _rs(zlib.crc32((case["id"] + k).encode())).uniform(
             -1, 1, size=case["inputs"][k].shape).astype("float32")
         plus = {kk: vv.copy() for kk, vv in case["inputs"].items()}
         minus = {kk: vv.copy() for kk, vv in case["inputs"].items()}
