@@ -65,7 +65,8 @@ import importlib as _importlib
 
 _LAZY = ("nn", "optimizer", "amp", "io", "metric", "jit", "static", "vision",
          "distributed", "autograd", "device", "framework", "hapi", "profiler",
-         "incubate", "utils", "sparse", "signal", "fft", "text", "ops")
+         "incubate", "utils", "sparse", "signal", "fft", "text", "ops",
+         "distribution", "regularizer", "callbacks")
 
 
 def __getattr__(name):

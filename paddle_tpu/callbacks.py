@@ -1,0 +1,7 @@
+"""paddle.callbacks namespace (reference: python/paddle/callbacks.py — a
+re-export of the hapi callback classes)."""
+
+from .hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    VisualDL,
+)

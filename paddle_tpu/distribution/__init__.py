@@ -1,0 +1,543 @@
+"""paddle.distribution (reference: python/paddle/distribution/) — the
+probability-distribution toolkit: sample/rsample/log_prob/entropy plus a
+kl_divergence registry.
+
+TPU-native: every density/entropy/KL is ONE fused jnp formula dispatched
+through the op layer, so it is differentiable w.r.t. BOTH the evaluation
+point and the distribution parameters (Tensor-valued loc/scale flow
+gradients — the VAE/ELBO pattern: ``rsample`` is reparameterized).
+Sampling routes through the framework RNG (``paddle.seed`` deterministic).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as _rng
+from ..tensor.dispatch import apply as _apply
+from ..tensor.tensor import Tensor
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli", "Beta",
+    "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel", "Laplace",
+    "LogNormal", "Multinomial", "kl_divergence", "register_kl",
+]
+
+_LOG_2PI = math.log(2 * math.pi)
+
+
+def _t(x):
+    """Promote to a float32 Tensor, KEEPING tape identity when already a
+    Tensor (parameter gradients depend on this)."""
+    if isinstance(x, Tensor):
+        if jnp.issubdtype(x._value.dtype, jnp.floating):
+            return x
+        return _apply(lambda v: v.astype(jnp.float32), x, op_name="cast")
+    return Tensor(jnp.asarray(x, jnp.float32), stop_gradient=True)
+
+
+def _shape(sample_shape):
+    if sample_shape is None:
+        return ()
+    if isinstance(sample_shape, int):
+        return (sample_shape,)
+    return tuple(int(s) for s in sample_shape)
+
+
+class Distribution:
+    """Base class (reference distribution/distribution.py)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(int(s) for s in batch_shape)
+        self._event_shape = tuple(int(s) for s in event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        """Non-differentiable draw (reference semantics: only ``rsample``
+        carries reparameterization gradients)."""
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _apply(jnp.exp, self.log_prob(value), op_name="exp")
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _key(self):
+        return _rng.next_key()
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(tuple(self.loc.shape),
+                                              tuple(self.scale.shape)))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _apply(lambda s: s ** 2, self.scale, op_name="square")
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        eps = jax.random.normal(self._key(), shp, jnp.float32)
+        return _apply(lambda l, s: l + s * eps, self.loc, self.scale,
+                      op_name="normal_rsample")
+
+    def log_prob(self, value):
+        return _apply(
+            lambda v, l, s: -((v - l) ** 2) / (2 * s ** 2) - jnp.log(s)
+            - 0.5 * _LOG_2PI,
+            _t(value), self.loc, self.scale, op_name="normal_log_prob")
+
+    def entropy(self):
+        return _apply(
+            lambda l, s: jnp.broadcast_to(0.5 + 0.5 * _LOG_2PI + jnp.log(s),
+                                          jnp.broadcast_shapes(l.shape,
+                                                               s.shape)),
+            self.loc, self.scale, op_name="normal_entropy")
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(tuple(self.loc.shape),
+                                              tuple(self.scale.shape)))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        eps = jax.random.normal(self._key(), shp, jnp.float32)
+        return _apply(lambda l, s: jnp.exp(l + s * eps), self.loc, self.scale,
+                      op_name="lognormal_rsample")
+
+    def log_prob(self, value):
+        return _apply(
+            lambda v, l, s: -((jnp.log(v) - l) ** 2) / (2 * s ** 2)
+            - jnp.log(v) - jnp.log(s) - 0.5 * _LOG_2PI,
+            _t(value), self.loc, self.scale, op_name="lognormal_log_prob")
+
+    def entropy(self):
+        return _apply(lambda l, s: l + 0.5 + 0.5 * _LOG_2PI + jnp.log(s),
+                      self.loc, self.scale, op_name="lognormal_entropy")
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(jnp.broadcast_shapes(tuple(self.low.shape),
+                                              tuple(self.high.shape)))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), shp, jnp.float32)
+        return _apply(lambda lo, hi: lo + (hi - lo) * u, self.low, self.high,
+                      op_name="uniform_rsample")
+
+    def log_prob(self, value):
+        return _apply(
+            lambda v, lo, hi: jnp.where((v >= lo) & (v < hi),
+                                        -jnp.log(hi - lo), -jnp.inf),
+            _t(value), self.low, self.high, op_name="uniform_log_prob")
+
+    def entropy(self):
+        return _apply(lambda lo, hi: jnp.log(hi - lo), self.low, self.high,
+                      op_name="uniform_entropy")
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("Categorical needs logits or probs")
+        if logits is not None:
+            self.logits = _apply(lambda l: jax.nn.log_softmax(l), _t(logits),
+                                 op_name="log_softmax")
+        else:
+            self.logits = _apply(
+                lambda p: jnp.log(p / p.sum(-1, keepdims=True)), _t(probs),
+                op_name="categorical_normalize")
+        super().__init__(tuple(self.logits.shape)[:-1])
+
+    @property
+    def probs(self):
+        return _apply(jnp.exp, self.logits, op_name="exp")
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.categorical(self._key(),
+                                             self.logits._value, shape=shp))
+
+    rsample = sample  # discrete; kept for API parity
+
+    def log_prob(self, value):
+        def fn(v, logits):
+            idx = v.astype(jnp.int32)
+            lg = jnp.broadcast_to(logits, idx.shape + logits.shape[-1:])
+            return jnp.take_along_axis(lg, idx[..., None], -1)[..., 0]
+
+        return _apply(fn, value, self.logits, op_name="categorical_log_prob")
+
+    def entropy(self):
+        return _apply(lambda lg: -(jnp.exp(lg) * lg).sum(-1), self.logits,
+                      op_name="categorical_entropy")
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs_ = _t(probs)
+        else:
+            self.probs_ = _apply(jax.nn.sigmoid, _t(logits),
+                                 op_name="sigmoid")
+        super().__init__(tuple(self.probs_.shape))
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.bernoulli(self._key(), self.probs_._value,
+                                           shp).astype(jnp.float32))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v, p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return _apply(fn, _t(value), self.probs_, op_name="bernoulli_log_prob")
+
+    def entropy(self):
+        def fn(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+        return _apply(fn, self.probs_, op_name="bernoulli_entropy")
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(jnp.broadcast_shapes(tuple(self.alpha.shape),
+                                              tuple(self.beta.shape)))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        # reparameterized via two gammas (implicit diff through jax.random)
+        key = self._key()
+        k1, k2 = jax.random.split(key)
+
+        def fn(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, shp))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, shp))
+            return ga / (ga + gb)
+
+        return _apply(fn, self.alpha, self.beta, op_name="beta_rsample")
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+
+        return _apply(
+            lambda v, a, b: (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+            - betaln(a, b),
+            _t(value), self.alpha, self.beta, op_name="beta_log_prob")
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+
+        def fn(a, b):
+            return (betaln(a, b) - (a - 1) * digamma(a)
+                    - (b - 1) * digamma(b) + (a + b - 2) * digamma(a + b))
+
+        return _apply(fn, self.alpha, self.beta, op_name="beta_entropy")
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        shp = tuple(self.concentration.shape)
+        super().__init__(shp[:-1], shp[-1:])
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        key = self._key()
+
+        def fn(a):
+            g = jax.random.gamma(key, jnp.broadcast_to(
+                a, shp + self.event_shape))
+            return g / g.sum(-1, keepdims=True)
+
+        return _apply(fn, self.concentration, op_name="dirichlet_rsample")
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        return _apply(
+            lambda v, a: ((a - 1) * jnp.log(v)).sum(-1)
+            + gammaln(a.sum(-1)) - gammaln(a).sum(-1),
+            _t(value), self.concentration, op_name="dirichlet_log_prob")
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        e = jax.random.exponential(self._key(), shp)
+        return _apply(lambda r: e / r, self.rate, op_name="exponential_rsample")
+
+    def log_prob(self, value):
+        return _apply(lambda v, r: jnp.log(r) - r * v, _t(value), self.rate,
+                      op_name="exponential_log_prob")
+
+    def entropy(self):
+        return _apply(lambda r: 1.0 - jnp.log(r), self.rate,
+                      op_name="exponential_entropy")
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(jnp.broadcast_shapes(tuple(self.concentration.shape),
+                                              tuple(self.rate.shape)))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        key = self._key()
+        return _apply(
+            lambda a, r: jax.random.gamma(key, jnp.broadcast_to(a, shp)) / r,
+            self.concentration, self.rate, op_name="gamma_rsample")
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        return _apply(
+            lambda v, a, b: a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+            - gammaln(a),
+            _t(value), self.concentration, self.rate, op_name="gamma_log_prob")
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+
+        return _apply(
+            lambda a, b: a - jnp.log(b) + gammaln(a) + (1 - a) * digamma(a),
+            self.concentration, self.rate, op_name="gamma_entropy")
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_ = _t(probs)
+        super().__init__(tuple(self.probs_.shape))
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), shp, jnp.float32, 1e-7, 1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_._value)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return _apply(lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+                      _t(value), self.probs_, op_name="geometric_log_prob")
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(tuple(self.loc.shape),
+                                              tuple(self.scale.shape)))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        g = jax.random.gumbel(self._key(), shp, jnp.float32)
+        return _apply(lambda l, s: l + s * g, self.loc, self.scale,
+                      op_name="gumbel_rsample")
+
+    def log_prob(self, value):
+        def fn(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+
+        return _apply(fn, _t(value), self.loc, self.scale,
+                      op_name="gumbel_log_prob")
+
+    def entropy(self):
+        return _apply(lambda s: jnp.log(s) + 1.0 + 0.5772156649,
+                      self.scale, op_name="gumbel_entropy")
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(tuple(self.loc.shape),
+                                              tuple(self.scale.shape)))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        l = jax.random.laplace(self._key(), shp, jnp.float32)
+        return _apply(lambda lo, s: lo + s * l, self.loc, self.scale,
+                      op_name="laplace_rsample")
+
+    def log_prob(self, value):
+        return _apply(
+            lambda v, lo, s: -jnp.abs(v - lo) / s - jnp.log(2 * s),
+            _t(value), self.loc, self.scale, op_name="laplace_log_prob")
+
+    def entropy(self):
+        return _apply(lambda s: 1.0 + jnp.log(2 * s), self.scale,
+                      op_name="laplace_entropy")
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        p = _t(probs)
+        self.probs_ = _apply(lambda v: v / v.sum(-1, keepdims=True), p,
+                             op_name="multinomial_normalize")
+        shp = tuple(self.probs_.shape)
+        super().__init__(shp[:-1], shp[-1:])
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        logits = jnp.log(self.probs_._value)
+        draws = jax.random.categorical(
+            self._key(), logits, shape=(self.total_count,) + shp)
+        K = self.probs_._value.shape[-1]
+        counts = jax.nn.one_hot(draws, K, dtype=jnp.float32).sum(0)
+        return Tensor(counts)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        return _apply(
+            lambda v, p: gammaln(v.sum(-1) + 1) - gammaln(v + 1).sum(-1)
+            + (v * jnp.log(p)).sum(-1),
+            _t(value), self.probs_, op_name="multinomial_log_prob")
+
+
+# ------------------------------------------------------------ KL registry
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def wrap(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return wrap
+
+
+def kl_divergence(p, q):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"kl_divergence not registered for ({type(p).__name__}, "
+        f"{type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    return _apply(
+        lambda lp, sp, lq, sq: jnp.log(sq / sp)
+        + (sp ** 2 + (lp - lq) ** 2) / (2 * sq ** 2) - 0.5,
+        p.loc, p.scale, q.loc, q.scale, op_name="kl_normal_normal")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _apply(
+        lambda pl, ph, ql, qh: jnp.where(
+            (ql <= pl) & (ph <= qh),
+            jnp.log((qh - ql) / (ph - pl)), jnp.inf),
+        p.low, p.high, q.low, q.high, op_name="kl_uniform_uniform")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    return _apply(lambda lp, lq: (jnp.exp(lp) * (lp - lq)).sum(-1),
+                  p.logits, q.logits, op_name="kl_categorical")
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def fn(pp, qq):
+        pp = jnp.clip(pp, 1e-7, 1 - 1e-7)
+        qq = jnp.clip(qq, 1e-7, 1 - 1e-7)
+        return (pp * (jnp.log(pp) - jnp.log(qq))
+                + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+    return _apply(fn, p.probs_, q.probs_, op_name="kl_bernoulli")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return _apply(lambda rp, rq: jnp.log(rp / rq) + rq / rp - 1.0,
+                  p.rate, q.rate, op_name="kl_exponential")
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def fn(lp, sp, lq, sq):
+        d = jnp.abs(lp - lq)
+        return (jnp.log(sq / sp) + d / sq
+                + (sp / sq) * jnp.exp(-d / sp) - 1.0)
+
+    return _apply(fn, p.loc, p.scale, q.loc, q.scale, op_name="kl_laplace")
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    from jax.scipy.special import digamma, gammaln
+
+    def fn(ap, bp, aq, bq):
+        return ((ap - aq) * digamma(ap) - gammaln(ap) + gammaln(aq)
+                + aq * (jnp.log(bp) - jnp.log(bq)) + ap * (bq - bp) / bp)
+
+    return _apply(fn, p.concentration, p.rate, q.concentration, q.rate,
+                  op_name="kl_gamma")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    from jax.scipy.special import betaln, digamma
+
+    def fn(ap, bp, aq, bq):
+        t = digamma(ap + bp)
+        return (betaln(aq, bq) - betaln(ap, bp)
+                + (ap - aq) * (digamma(ap) - t)
+                + (bp - bq) * (digamma(bp) - t))
+
+    return _apply(fn, p.alpha, p.beta, q.alpha, q.beta, op_name="kl_beta")

@@ -8,16 +8,19 @@ from .layers.activation import *  # noqa: F401,F403
 from .layers.common import (  # noqa: F401
     AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout, Dropout2D,
     Dropout3D, Embedding, Flatten, Fold, Identity, Linear, Pad1D, Pad2D, Pad3D,
-    PairwiseDistance, PixelShuffle, PixelUnshuffle, Unfold, Upsample,
-    UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D,
+    PairwiseDistance, PixelShuffle, PixelUnshuffle, Unfold, Unflatten,
+    Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad1D, ZeroPad2D,
+    ZeroPad3D, Dropout1D,
 )
 from .layers.conv import (  # noqa: F401
     Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D, Conv3DTranspose,
 )
 from .layers.loss import (  # noqa: F401
     BCELoss, BCEWithLogitsLoss, CTCLoss, CosineEmbeddingLoss, CrossEntropyLoss,
-    HingeEmbeddingLoss, KLDivLoss, L1Loss, MSELoss, MarginRankingLoss, NLLLoss,
-    SmoothL1Loss, TripletMarginLoss,
+    GaussianNLLLoss, HingeEmbeddingLoss, KLDivLoss, L1Loss, MSELoss,
+    MarginRankingLoss, MultiLabelSoftMarginLoss, MultiMarginLoss, NLLLoss,
+    PoissonNLLLoss, SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
+    TripletMarginWithDistanceLoss,
 )
 from .layers.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm, InstanceNorm1D,
@@ -27,7 +30,8 @@ from .layers.norm import (  # noqa: F401
 from .layers.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
     AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
-    MaxPool1D, MaxPool2D, MaxPool3D,
+    LPPool1D, LPPool2D, MaxPool1D, MaxPool2D, MaxPool3D, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D,
 )
 from .layers.rnn import (  # noqa: F401
     GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, RNNCellBase, SimpleRNN, SimpleRNNCell,

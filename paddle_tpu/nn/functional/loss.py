@@ -291,3 +291,84 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
         return jnp.mean(1 - (inter + epsilon) / (union + epsilon))
 
     return apply(fn, input, label, op_name="dice_loss")
+
+
+# ---------------------------------------------- long-tail losses (round 3)
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-y*x)), y in {-1, 1} (reference F.soft_margin_loss)."""
+    return apply(lambda x, y: _reduce(jax.nn.softplus(-y * x), reduction),
+                 input, label, op_name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def fn(x, y, *w):
+        out = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            out = out * w[0]
+        return _reduce(out.mean(-1), reduction)
+
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply(fn, *args, op_name="multi_label_soft_margin_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(x, y):
+        if log_input:
+            out = jnp.exp(x) - y * x
+        else:
+            out = x - y * jnp.log(x + epsilon)
+        if full:
+            # Stirling approximation for the y! term, y > 1 only
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            out = out + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(out, reduction)
+
+    return apply(fn, input, label, op_name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        out = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            out = out + 0.5 * jnp.log(2 * jnp.asarray(jnp.pi, mu.dtype))
+        return _reduce(out, reduction)
+
+    return apply(fn, input, label, variance, op_name="gaussian_nll_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class hinge (reference F.multi_margin_loss): mean over classes
+    of max(0, margin - x_y + x_j)^p, j != y."""
+
+    def fn(x, y, *w):
+        C = x.shape[1]
+        xy = jnp.take_along_axis(x, y[:, None], axis=1)          # [N,1]
+        m = jnp.maximum(0.0, margin - xy + x) ** p
+        m = m * (1.0 - jax.nn.one_hot(y, C, dtype=x.dtype))
+        if w:
+            m = m * w[0][y][:, None]
+        return _reduce(m.sum(1) / C, reduction)
+
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply(fn, *args, op_name="multi_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dn2 = distance_function(positive, negative)
+        dn = apply(jnp.minimum, dn, dn2, op_name="minimum")
+    return apply(lambda a, b: _reduce(jnp.maximum(a - b + margin, 0.0),
+                                      reduction), dp, dn,
+                 op_name="triplet_margin_with_distance_loss")

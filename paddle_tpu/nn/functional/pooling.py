@@ -33,6 +33,46 @@ def _full_pad(pad, data_format, n):
     return [(0, 0)] + list(pad) + [(0, 0)]
 
 
+def _resolve_pad(pad, spatial, k, s, ceil_mode=False):
+    """Concrete per-dim (lo, hi) pairs from int/pairs/'SAME'/'VALID'
+    padding; ceil_mode extends hi so the last partial window is kept."""
+    n = len(spatial)
+    if isinstance(pad, str):
+        if pad.upper() == "VALID":
+            pairs = [(0, 0)] * n
+        else:  # SAME (XLA convention: split evenly, extra on the high side)
+            pairs = []
+            for i in range(n):
+                out = -(-spatial[i] // s[i])
+                total = max((out - 1) * s[i] + k[i] - spatial[i], 0)
+                pairs.append((total // 2, total - total // 2))
+    else:
+        pairs = [(pp, pp) if isinstance(pp, int) else tuple(pp) for pp in pad]
+    if ceil_mode:
+        adj = []
+        for i in range(n):
+            lo, hi = pairs[i]
+            L = spatial[i]
+            out = -(-(L + lo + hi - k[i]) // s[i]) + 1  # ceil
+            if (out - 1) * s[i] >= L + lo:
+                out -= 1  # torch/paddle rule: a window starting entirely in
+                # the right padding is DROPPED, not emitted as -inf/NaN
+            adj.append((lo, max((out - 1) * s[i] + k[i] - L - lo, 0)))
+        pairs = adj
+    return pairs
+
+
+def _effective_fullpad(pad, v, spatial, k, s, ceil_mode, fullpad):
+    """Per-call reduce_window padding: the precomputed fullpad, unless
+    ceil_mode needs shape-dependent resolution."""
+    if not ceil_mode:
+        return fullpad
+    sp = tuple(v.shape[i] for i in spatial)
+    pairs = _resolve_pad(pad, sp, k, s, True)
+    return tuple((0, 0) if i not in spatial else pairs[spatial.index(i)]
+                 for i in range(v.ndim))
+
+
 def _pool(x, kernel_size, stride, padding, n, data_format, kind,
           ceil_mode=False, exclusive=True, count_include_pad=None):
     k = _norm_tuple(kernel_size, n)
@@ -44,13 +84,14 @@ def _pool(x, kernel_size, stride, padding, n, data_format, kind,
         exclusive = not count_include_pad
 
     def fn(v):
+        fp = _effective_fullpad(pad, v, spatial, k, s, ceil_mode, fullpad)
         if kind == "max":
             init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
-            return jax.lax.reduce_window(v, init, jax.lax.max, dims, strides, fullpad)
-        summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, dims, strides, fullpad)
-        if exclusive and not isinstance(fullpad, str):
+            return jax.lax.reduce_window(v, init, jax.lax.max, dims, strides, fp)
+        summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, dims, strides, fp)
+        if exclusive and not isinstance(fp, str):
             ones = jnp.ones_like(v)
-            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, fullpad)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, fp)
             return summed / counts
         denom = 1
         for kk in k:
@@ -63,31 +104,155 @@ def _pool(x, kernel_size, stride, padding, n, data_format, kind,
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     out = _pool(x, kernel_size, stride, padding, 1, data_format, "max", ceil_mode)
-    return (out, _pool_mask(x, out, kernel_size, stride, padding, 1, data_format)) if return_mask else out
+    return (out, _pool_mask(x, out, kernel_size, stride, padding, 1, data_format, ceil_mode)) if return_mask else out
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     out = _pool(x, kernel_size, stride, padding, 2, data_format, "max", ceil_mode)
-    return (out, _pool_mask(x, out, kernel_size, stride, padding, 2, data_format)) if return_mask else out
+    return (out, _pool_mask(x, out, kernel_size, stride, padding, 2, data_format, ceil_mode)) if return_mask else out
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     out = _pool(x, kernel_size, stride, padding, 3, data_format, "max", ceil_mode)
-    return (out, _pool_mask(x, out, kernel_size, stride, padding, 3, data_format)) if return_mask else out
+    return (out, _pool_mask(x, out, kernel_size, stride, padding, 3, data_format, ceil_mode)) if return_mask else out
 
 
-def _pool_mask(x, out, kernel_size, stride, padding, n, data_format):
-    """Flat argmax indices per window (paddle return_mask contract)."""
-    # implemented via a gather comparison — adequate for API parity
-    v, o = unwrap(x), unwrap(out)
-    from ...tensor.tensor import Tensor
+def _window_patches(v, k, s, pairs, n):
+    """[N, C, *out_spatial, prod(k)] value patches + matching FLAT input
+    indices (into the unpadded spatial volume; padded taps get index -1 and
+    value -inf).  Static Python loop over the at most k1*k2*k3 kernel taps —
+    each tap is one strided slice, which XLA fuses; no dynamic gather."""
+    import itertools
 
+    spatial = v.shape[2:]
+    pairs = tuple(pairs)
+    padded = jnp.pad(v, ((0, 0), (0, 0)) + pairs,
+                     constant_values=-jnp.inf
+                     if jnp.issubdtype(v.dtype, jnp.floating)
+                     else jnp.iinfo(v.dtype).min)
+    out_sp = tuple((spatial[i] + sum(pairs[i]) - k[i]) // s[i] + 1
+                   for i in range(n))
+    # flat index of every UNPADDED position; -1 on padding
+    import math as _math
+
+    pos = jnp.arange(_math.prod(spatial)).reshape(spatial)
+    pos = jnp.pad(pos, pairs, constant_values=-1)
+    vals, idxs = [], []
+    for offs in itertools.product(*[range(kk) for kk in k]):
+        sl = tuple(slice(offs[i], offs[i] + s[i] * (out_sp[i] - 1) + 1, s[i])
+                   for i in range(n))
+        vals.append(padded[(slice(None), slice(None)) + sl])
+        idxs.append(pos[sl])
+    return jnp.stack(vals, -1), jnp.stack(idxs, -1)
+
+
+def _pool_mask(x, out, kernel_size, stride, padding, n, data_format,
+               ceil_mode=False):
+    """Flat argmax index per window, into the input's spatial volume
+    (paddle return_mask contract)."""
+    if data_format not in ("NCL", "NCHW", "NCDHW"):
+        raise NotImplementedError("return_mask expects channel-first layout")
     k = _norm_tuple(kernel_size, n)
     s = _norm_tuple(stride if stride is not None else kernel_size, n)
-    # brute-force host computation (mask path is rare; not a perf path)
-    raise NotImplementedError("max_pool return_mask=True is not yet supported on TPU build")
+    pad = _norm_padding(padding, n)
+
+    def fn(v):
+        pairs = _resolve_pad(pad, v.shape[2:], k, s, ceil_mode)
+        patches, pidx = _window_patches(v, k, s, pairs, n)
+        arg = jnp.argmax(patches, axis=-1)
+        return jnp.take_along_axis(
+            jnp.broadcast_to(pidx, patches.shape), arg[..., None], -1
+        )[..., 0].astype(jnp.int32)
+
+    return apply(fn, x, op_name=f"max_pool{n}d_mask")
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size, n,
+                data_format):
+    """Scatter pooled values back to their argmax positions (zeros
+    elsewhere) — the exact inverse of max_pool with return_mask."""
+    k = _norm_tuple(kernel_size, n)
+    s = _norm_tuple(stride if stride is not None else kernel_size, n)
+    pad = _norm_padding(padding, n)
+
+    def fn(v, idx):
+        if output_size is not None:
+            out_sp = tuple(int(o) for o in output_size[-n:])
+        else:
+            pairs = _resolve_pad(pad, tuple(v.shape[2:]), k, s, False) \
+                if isinstance(pad, str) else \
+                tuple((pp, pp) if isinstance(pp, int) else tuple(pp)
+                      for pp in pad)
+            out_sp = tuple((v.shape[2 + i] - 1) * s[i] - sum(pairs[i]) + k[i]
+                           for i in range(n))
+        N, C = v.shape[:2]
+        flat_len = 1
+        for o in out_sp:
+            flat_len *= o
+        flat = jnp.zeros((N, C, flat_len), v.dtype)
+        vi = v.reshape(N, C, -1)
+        ii = idx.reshape(N, C, -1).astype(jnp.int32)
+        flat = flat.at[
+            jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None], ii
+        ].set(vi)
+        return flat.reshape((N, C) + out_sp)
+
+    return apply(fn, x, indices, op_name=f"max_unpool{n}d")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                       1, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                       2, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                       3, data_format)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 1,
+                    data_format, ceil_mode)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 2,
+                    data_format, ceil_mode)
+
+
+def _lp_pool(x, norm_type, kernel_size, stride, padding, n, data_format,
+             ceil_mode=False):
+    """(sum |x|^p)^(1/p) over windows; p=inf degenerates to max pool."""
+    p = float(norm_type)
+    if p == float("inf"):
+        return _pool(x, kernel_size, stride, padding, n, data_format, "max",
+                     ceil_mode)
+    k = _norm_tuple(kernel_size, n)
+    s = _norm_tuple(stride if stride is not None else kernel_size, n)
+    pad = _norm_padding(padding, n)
+    dims, strides, spatial = _window(data_format, n, k, s)
+    fullpad = _full_pad(pad, data_format, n)
+
+    def fn(v):
+        fp = _effective_fullpad(pad, v, spatial, k, s, ceil_mode, fullpad)
+        powed = jnp.abs(v) ** p
+        summed = jax.lax.reduce_window(powed, 0.0, jax.lax.add, dims, strides,
+                                       fp)
+        return summed ** (1.0 / p)
+
+    return apply(fn, x, op_name=f"lp_pool{n}d")
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,

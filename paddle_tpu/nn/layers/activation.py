@@ -63,3 +63,13 @@ class PReLU(Layer):
 
     def forward(self, x):
         return F.prelu(x, self.weight, self._data_format)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW inputs (reference nn.Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)

@@ -238,3 +238,39 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.args)
+
+
+class ZeroPad1D(_PadNd):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class ZeroPad3D(_PadNd):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class Unflatten(Layer):
+    """Expand one axis into the given shape (reference nn.Unflatten)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from ...tensor import manipulation as M
+
+        return M.unflatten(x, self.axis, self.shape)
+
+
+class Dropout1D(Layer):
+    """Channel-wise dropout on NCL inputs (zero whole length-L channels)."""
+
+    def __init__(self, p=0.5, data_format="NCL", name=None):
+        super().__init__()
+        self.p, self.data_format = p, data_format
+
+    def forward(self, x):
+        # mask varies on (N, C) and broadcasts along L: whole channels drop
+        axis = [0, 1] if self.data_format == "NCL" else [0, 2]
+        return F.dropout(x, self.p, axis=axis, training=self.training)

@@ -101,3 +101,68 @@ class AdaptiveMaxPool2D(_AdaptivePool):
 class AdaptiveMaxPool3D(_AdaptivePool):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__("adaptive_max_pool3d", output_size, return_mask=return_mask)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format)
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        k, s, p, df = self.args
+        return F.max_unpool1d(x, indices, k, stride=s, padding=p,
+                              data_format=df, output_size=self.output_size)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format)
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        k, s, p, df = self.args
+        return F.max_unpool2d(x, indices, k, stride=s, padding=p,
+                              data_format=df, output_size=self.output_size)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format)
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        k, s, p, df = self.args
+        return F.max_unpool3d(x, indices, k, stride=s, padding=p,
+                              data_format=df, output_size=self.output_size)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                     data_format)
+
+    def forward(self, x):
+        nt, k, s, p, cm, df = self.args
+        return F.lp_pool1d(x, nt, k, stride=s, padding=p, ceil_mode=cm,
+                           data_format=df)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                     data_format)
+
+    def forward(self, x):
+        nt, k, s, p, cm, df = self.args
+        return F.lp_pool2d(x, nt, k, stride=s, padding=p, ceil_mode=cm,
+                           data_format=df)

@@ -1,0 +1,14 @@
+"""paddle.regularizer (reference: python/paddle/regularizer.py).
+
+Re-exports the callable decay classes from nn.param_attr — ONE
+implementation serves both spellings (``ParamAttr(regularizer=...)`` and
+``optimizer(weight_decay=...)``).  Each carries ``coeff`` and is callable
+on a raw param value, returning the decay gradient term; the pure-rule
+optimizers fold it into the fused update (decoupled for AdamW).
+"""
+
+from __future__ import annotations
+
+from .nn.param_attr import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

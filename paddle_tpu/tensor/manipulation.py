@@ -498,3 +498,50 @@ def row_stack(x, name=None):
 
 def column_stack(x, name=None):
     return apply(lambda *vs: jnp.column_stack(vs), *list(x), op_name="column_stack")
+
+
+# ------------------------------------------------- long-tail ops (round 3)
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1,
+                                        axis2=axis2), x, op_name="diagonal")
+
+
+def unflatten(x, axis, shape, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        shp = tuple(int(s) for s in shape)
+        return v.reshape(v.shape[:ax] + shp + v.shape[ax + 1:])
+
+    return apply(fn, x, op_name="unflatten")
+
+
+def matrix_transpose(x, name=None):
+    return apply(lambda v: jnp.swapaxes(v, -2, -1), x,
+                 op_name="matrix_transpose")
+
+
+def index_fill(x, index, axis, value, name=None):
+    def fn(v, idx):
+        moved = jnp.moveaxis(v, axis, 0)
+        out = moved.at[idx].set(jnp.asarray(value, v.dtype))
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply(fn, x, index, op_name="index_fill")
+
+
+def index_fill_(x, index, axis, value):
+    return x._inplace_from(index_fill(x._snapshot(), index, axis, value))
+
+
+def index_put_(x, indices, value, accumulate=False):
+    return x._inplace_from(
+        index_put(x._snapshot(), indices, value, accumulate=accumulate))
+
+
+def masked_fill_(x, mask, value):
+    return x._inplace_from(masked_fill(x._snapshot(), mask, value))
+
+
+def flatten_(x, start_axis=0, stop_axis=-1):
+    return x._inplace_from(
+        flatten(x._snapshot(), start_axis=start_axis, stop_axis=stop_axis))

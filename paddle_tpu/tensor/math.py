@@ -319,3 +319,218 @@ def exp_(x):
 
 def reciprocal_(x):
     return x._inplace_unary(lambda v: 1.0 / v, "reciprocal_")
+
+
+# ------------------------------------------------- long-tail ops (round 3)
+def logit(x, eps=None, name=None):
+    def fn(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v / (1.0 - v))
+
+    return apply(fn, x, op_name="logit")
+
+
+def frexp(x, name=None):
+    return apply(lambda v: jnp.frexp(v), x, op_name="frexp", n_outs=2)
+
+
+def polar(abs, angle, name=None):
+    return apply(lambda a, t: (a * jnp.cos(t) + 1j * a * jnp.sin(t))
+                 .astype(jnp.complex64), abs, angle, op_name="polar")
+
+
+def sgn(x, name=None):
+    """Complex-aware sign: x/|x| for complex, jnp.sign for real."""
+    def fn(v):
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(v)
+
+    return apply(fn, x, op_name="sgn")
+
+
+def vdot(x, y, name=None):
+    return apply(lambda a, b: jnp.vdot(a, b), x, y, op_name="vdot")
+
+
+def positive(x, name=None):
+    return apply(lambda v: +v, x, op_name="positive")
+
+
+def negative(x, name=None):
+    return apply(jnp.negative, x, op_name="negative")
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    return apply(jnp.left_shift, x, y, op_name="bitwise_left_shift")
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    op = jnp.right_shift if is_arithmetic else \
+        (lambda a, b: jax.lax.shift_right_logical(a, b.astype(a.dtype)))
+    return apply(op, x, y, op_name="bitwise_right_shift")
+
+
+def igamma(x, a, name=None):
+    from jax.scipy.special import gammaincc
+
+    # paddle.igamma is the UPPER regularized incomplete gamma Q(x, a)
+    return apply(lambda v, av: gammaincc(v, av), x, a, op_name="igamma")
+
+
+def igammac(x, a, name=None):
+    from jax.scipy.special import gammainc
+
+    return apply(lambda v, av: gammainc(v, av), x, a, op_name="igammac")
+
+
+def addbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * jnp.einsum("bij,bjk->ik", a, b),
+                 input, x, y, op_name="addbmm")
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                 input, x, y, op_name="baddbmm")
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(int(i) for i in a) if isinstance(a, (list, tuple))
+                   else int(a) for a in ax)
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y,
+                 op_name="tensordot")
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-norm distances [..., M, N] between rows of x [..., M, D]
+    and y [..., N, D] — one fused broadcast on TPU (the mm fast path is an
+    XLA fusion decision, not ours)."""
+    def fn(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum((d * d).sum(-1), 0.0))
+        if p == float("inf"):
+            return jnp.abs(d).max(-1)
+        return (jnp.abs(d) ** p).sum(-1) ** (1.0 / p)
+
+    return apply(fn, x, y, op_name="cdist")
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    def fn(v):
+        lo, hi = float(min), float(max)
+        if lo == 0 and hi == 0:
+            lo, hi = v.min(), v.max()
+        return jnp.linspace(lo, hi, bins + 1)
+
+    return apply(fn, input, op_name="histogram_bin_edges")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), x,
+                 op_name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    if fweights is not None or aweights is not None:
+        raise NotImplementedError(
+            "cov: fweights/aweights are not supported yet")
+    return apply(lambda v: jnp.cov(v, rowvar=rowvar,
+                                   ddof=1 if ddof else 0), x, op_name="cov")
+
+
+def isneginf(x, name=None):
+    return apply(jnp.isneginf, x, op_name="isneginf")
+
+
+def isposinf(x, name=None):
+    return apply(jnp.isposinf, x, op_name="isposinf")
+
+
+def isreal(x, name=None):
+    return apply(jnp.isreal, x, op_name="isreal")
+
+
+def ceil_(x):
+    return x._inplace_unary(jnp.ceil, "ceil_")
+
+
+def floor_(x):
+    return x._inplace_unary(jnp.floor, "floor_")
+
+
+def round_(x):
+    return x._inplace_unary(jnp.round, "round_")
+
+
+def abs_(x):
+    return x._inplace_unary(jnp.abs, "abs_")
+
+
+def sin_(x):
+    return x._inplace_unary(jnp.sin, "sin_")
+
+
+def cos_(x):
+    return x._inplace_unary(jnp.cos, "cos_")
+
+
+def tanh_(x):
+    return x._inplace_unary(jnp.tanh, "tanh_")
+
+
+def sigmoid_(x):
+    return x._inplace_unary(jax.nn.sigmoid, "sigmoid_")
+
+
+def relu_(x):
+    return x._inplace_unary(lambda v: jnp.maximum(v, 0), "relu_")
+
+
+def clip_(x, min=None, max=None):
+    return x._inplace_unary(lambda v: jnp.clip(v, min, max), "clip_")
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    if bias_after_scale:
+        return x._inplace_unary(lambda v: v * scale + bias, "scale_")
+    return x._inplace_unary(lambda v: (v + bias) * scale, "scale_")
+
+
+def tril_(x, diagonal=0):
+    return x._inplace_unary(lambda v: jnp.tril(v, k=diagonal), "tril_")
+
+
+def triu_(x, diagonal=0):
+    return x._inplace_unary(lambda v: jnp.triu(v, k=diagonal), "triu_")
+
+
+def fill_(x, value):
+    return x.fill_(value)
+
+
+def zero_(x):
+    return x.zero_()
+
+
+def add_(x, y):
+    return x._inplace_binop(jnp.add, y, "add_")
+
+
+def subtract_(x, y):
+    return x._inplace_binop(jnp.subtract, y, "subtract_")
+
+
+def multiply_(x, y):
+    return x._inplace_binop(jnp.multiply, y, "multiply_")
+
+
+def divide_(x, y):
+    return x._inplace_binop(jnp.divide, y, "divide_")

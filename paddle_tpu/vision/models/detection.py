@@ -262,6 +262,48 @@ def _pairwise_iou(a, b):
 
 
 # ======================================================================= RCNN
+_DELTA_W = (10.0, 10.0, 5.0, 5.0)  # reference bbox_coder weights
+
+
+def _encode_deltas(proposals, gt):
+    """xyxy proposal + gt -> (dx, dy, dw, dh) regression targets
+    (reference: ppdet DeltaBBoxCoder.encode)."""
+    pw = jnp.maximum(proposals[..., 2] - proposals[..., 0], 1e-4)
+    ph = jnp.maximum(proposals[..., 3] - proposals[..., 1], 1e-4)
+    px = proposals[..., 0] + 0.5 * pw
+    py = proposals[..., 1] + 0.5 * ph
+    gw = jnp.maximum(gt[..., 2] - gt[..., 0], 1e-4)
+    gh = jnp.maximum(gt[..., 3] - gt[..., 1], 1e-4)
+    gx = gt[..., 0] + 0.5 * gw
+    gy = gt[..., 1] + 0.5 * gh
+    wx, wy, ww, wh = _DELTA_W
+    return jnp.stack([wx * (gx - px) / pw, wy * (gy - py) / ph,
+                      ww * jnp.log(gw / pw), wh * jnp.log(gh / ph)], axis=-1)
+
+
+def _decode_deltas(proposals, deltas, clip=math.log(1000.0 / 16)):
+    """Inverse of :func:`_encode_deltas` (reference decode, dw/dh clipped)."""
+    pw = jnp.maximum(proposals[..., 2] - proposals[..., 0], 1e-4)
+    ph = jnp.maximum(proposals[..., 3] - proposals[..., 1], 1e-4)
+    px = proposals[..., 0] + 0.5 * pw
+    py = proposals[..., 1] + 0.5 * ph
+    wx, wy, ww, wh = _DELTA_W
+    dx, dy = deltas[..., 0] / wx, deltas[..., 1] / wy
+    dw = jnp.clip(deltas[..., 2] / ww, -clip, clip)
+    dh = jnp.clip(deltas[..., 3] / wh, -clip, clip)
+    cx = px + dx * pw
+    cy = py + dy * ph
+    w = pw * jnp.exp(dw)
+    h = ph * jnp.exp(dh)
+    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h, cx + 0.5 * w, cy + 0.5 * h],
+                     axis=-1)
+
+
+def _smooth_l1(x, beta=1.0):
+    ax = jnp.abs(x)
+    return jnp.where(ax < beta, 0.5 * ax * ax / beta, ax - 0.5 * beta)
+
+
 class RPNHead(nn.Layer):
     """Region proposal network over FPN levels; proposals = top-k scored
     anchor-free centers decoded ltrb (static count, padded)."""
@@ -321,8 +363,20 @@ class FasterRCNN(nn.Layer):
         self.roi_head = nn.Sequential(
             nn.Linear(ch * roi_resolution * roi_resolution, 1024), nn.ReLU(),
             nn.Linear(1024, 1024), nn.ReLU())
-        self.cls_score = nn.Linear(1024, num_classes + 1)  # +1 background
-        self.bbox_delta = nn.Linear(1024, 4)
+        # head init per reference bbox_head: tiny Normal so initial deltas/
+        # logits are near zero (raw roi features are large; default Linear
+        # init makes the box branch predict +-10 deltas and destabilizes
+        # early training)
+        self.cls_score = nn.Linear(
+            1024, num_classes + 1,  # +1 background
+            weight_attr=nn.ParamAttr(initializer=nn.initializer.Normal(0.0, 0.01)),
+            bias_attr=nn.ParamAttr(initializer=nn.initializer.Constant(0.0)))
+        # class-specific regression branch (reference bbox_head: 4*C deltas
+        # in the standard (dx,dy,dw,dh) parameterization)
+        self.bbox_delta = nn.Linear(
+            1024, 4 * num_classes,
+            weight_attr=nn.ParamAttr(initializer=nn.initializer.Normal(0.0, 0.001)),
+            bias_attr=nn.ParamAttr(initializer=nn.initializer.Constant(0.0)))
         self.num_classes = num_classes
         self.roi_resolution = roi_resolution
         self.nms_thresh = nms_thresh
@@ -330,43 +384,72 @@ class FasterRCNN(nn.Layer):
         self.score_thresh = score_thresh
 
     def _roi_features(self, feats, proposals):
-        """RoIAlign on the stride-8 level (single-level assign keeps shapes
-        static); proposals [B, K, 4]."""
+        """Multi-level RoIAlign (reference FPN RoI assign: level by
+        sqrt(area), k0=4 at 224): align every proposal on EVERY level —
+        static shapes — and select per-proposal with a mask; 3 aligns + one
+        where beats dynamic gathers on TPU."""
         B, K = proposals.shape[0], proposals.shape[1]
-        from ...tensor import manipulation as M
-
         rois = proposals.reshape([B * K, 4])
         boxes_num = Tensor(jnp.full((B,), K, jnp.int32))
-        pooled = vops.roi_align(feats[0], rois, boxes_num,
-                                output_size=self.roi_resolution,
-                                spatial_scale=1.0 / 8.0)
+        strides = (8, 16, 32)
+        pooled_levels = [
+            vops.roi_align(f, rois, boxes_num,
+                           output_size=self.roi_resolution,
+                           spatial_scale=1.0 / s)
+            for f, s in zip(feats[:3], strides)]
+
+        def select(p0, p1, p2, props):
+            w = jnp.maximum(props[..., 2] - props[..., 0], 1e-4)
+            h = jnp.maximum(props[..., 3] - props[..., 1], 1e-4)
+            k = jnp.floor(4 + jnp.log2(jnp.sqrt(w * h) / 224.0 + 1e-9))
+            # levels here are strides (8,16,32) = P3..P5; canonical k0=4 at
+            # 224px maps to P4, so index = clip(k, 3, 5) - 3
+            lvl = jnp.clip(k, 3, 5).reshape(-1).astype(jnp.int32) - 3  # 0..2
+            stack = jnp.stack([p0, p1, p2])            # [3, B*K, ...]
+            sel = jnp.take_along_axis(
+                stack, lvl[None, :, None, None, None], axis=0)[0]
+            return sel
+
+        pooled = _apply(select, *pooled_levels, proposals,
+                        op_name="roi_level_select")
         return pooled.reshape([B, K, -1])
 
     def forward(self, img, gt_boxes=None, gt_labels=None):
         feats = self.neck(self.backbone(img))
         proposals, rpn_scores, rpn_obj_all, rpn_box_all = self.rpn(feats)
+        if gt_boxes is not None:
+            # reference ProposalTarget: gt boxes JOIN the proposal set at
+            # train time, so the RoI head always sees foreground even before
+            # the RPN warms up (static shape: K + max_boxes)
+            from ...tensor import manipulation as M
+
+            proposals = M.concat([proposals, gt_boxes], axis=1)
         roi_feat = self._roi_features(feats, proposals)
         h = self.roi_head(roi_feat)
-        cls_logits = self.cls_score(h)            # [B, K, C+1]
-        deltas = self.bbox_delta(h)               # [B, K, 4]
-        boxes = _apply(lambda p, d: p + d * 16.0, proposals, deltas,
-                       op_name="apply_deltas")
+        cls_logits = self.cls_score(h)            # [B, K(+M), C+1]
+        deltas = self.bbox_delta(h)               # [B, K(+M), 4*C]
         if gt_boxes is not None:
-            return self._loss(rpn_obj_all, rpn_box_all, cls_logits, boxes,
+            return self._loss(rpn_obj_all, rpn_box_all, cls_logits, deltas,
                               proposals, gt_boxes, gt_labels)
-        return self._postprocess(cls_logits, boxes)
+        return self._postprocess(cls_logits, deltas, proposals)
 
-    def _loss(self, rpn_obj, rpn_box, cls_logits, boxes, proposals,
+    def _loss(self, rpn_obj, rpn_box, cls_logits, deltas, proposals,
               gt_boxes, gt_labels):
         C = self.num_classes
 
-        def fn(rpn_obj, rpn_box, cls_logits, boxes, proposals, gtb, gtl):
+        def fn(rpn_obj, rpn_box, cls_logits, deltas, proposals, gtb, gtl):
             valid_gt = (gtl >= 0)
-            # RPN: IoU-matched objectness over the dense set
+            # RPN: IoU-matched objectness + box refinement on positives
             iou_dense = _iou_matrix(rpn_box, gtb, valid_gt)      # [B,N,M]
             best_dense = iou_dense.max(axis=-1)
-            rpn_t = (best_dense > 0.5).astype(jnp.float32)
+            match_dense = iou_dense.argmax(axis=-1)
+            rpn_pos = best_dense > 0.5
+            rpn_t = rpn_pos.astype(jnp.float32)
             l_rpn = _bce_logits(rpn_obj, rpn_t).mean()
+            gt_dense = jnp.take_along_axis(gtb, match_dense[..., None], axis=1)
+            iou_rpn = _pairwise_iou(rpn_box, gt_dense)
+            l_rpn_box = ((1 - iou_rpn) * rpn_t).sum() / \
+                jnp.maximum(rpn_t.sum(), 1.0)
 
             # RoI head: match proposals to gt
             iou_p = _iou_matrix(proposals, gtb, valid_gt)        # [B,K,M]
@@ -375,33 +458,56 @@ class FasterRCNN(nn.Layer):
             fg = best > 0.5
             tgt_label = jnp.where(fg, jnp.take_along_axis(gtl, match, axis=1), C)
             l_cls = _softmax_ce(cls_logits, jnp.clip(tgt_label, 0, C)).mean()
+
+            # SmoothL1 on ENCODED deltas of the target class (reference
+            # bbox_head loss), fg proposals only
             tgt_box = jnp.take_along_axis(gtb, match[..., None], axis=1)
-            iou_ref = _pairwise_iou(boxes, tgt_box)
-            l_box = ((1 - iou_ref) * fg).sum() / jnp.maximum(fg.sum(), 1.0)
-            return l_rpn, l_cls, l_box
+            tgt_delta = _encode_deltas(proposals, tgt_box)       # [B,K,4]
+            d = deltas.reshape(deltas.shape[:-1] + (C, 4))
+            cls_idx = jnp.clip(tgt_label, 0, C - 1)
+            d_sel = jnp.take_along_axis(
+                d, cls_idx[..., None, None].astype(jnp.int32), axis=-2)[..., 0, :]
+            fgf = fg.astype(jnp.float32)
+            l_box = (_smooth_l1(d_sel - tgt_delta).sum(-1) * fgf).sum() / \
+                jnp.maximum(fgf.sum(), 1.0)
+            return l_rpn, l_rpn_box, l_cls, l_box
 
-        l_rpn, l_cls, l_box = _apply(fn, rpn_obj, rpn_box, cls_logits, boxes,
-                                     proposals, gt_boxes, gt_labels,
-                                     op_name="rcnn_loss", n_outs=None)
-        total = l_rpn + l_cls + 2.0 * l_box
-        return {"loss": total, "loss_rpn": l_rpn, "loss_cls": l_cls,
-                "loss_box": l_box}
+        l_rpn, l_rpn_box, l_cls, l_box = _apply(
+            fn, rpn_obj, rpn_box, cls_logits, deltas, proposals, gt_boxes,
+            gt_labels, op_name="rcnn_loss", n_outs=None)
+        total = l_rpn + l_rpn_box + l_cls + l_box
+        return {"loss": total, "loss_rpn": l_rpn, "loss_rpn_box": l_rpn_box,
+                "loss_cls": l_cls, "loss_box": l_box}
 
-    def _postprocess(self, cls_logits, boxes):
+    def _postprocess(self, cls_logits, deltas, proposals):
         import numpy as np
 
+        C = self.num_classes
         B = cls_logits.shape[0]
+        # decode the PREDICTED class's deltas per proposal
+        def decode(cl, d, p):
+            probs = jax.nn.softmax(cl, axis=-1)
+            fg = probs[..., :C]
+            label = fg.argmax(axis=-1)                           # [B,K]
+            dd = d.reshape(d.shape[:-1] + (C, 4))
+            d_sel = jnp.take_along_axis(
+                dd, label[..., None, None].astype(jnp.int32),
+                axis=-2)[..., 0, :]
+            return fg.max(axis=-1), label, _decode_deltas(p, d_sel)
+
+        best_t, label_t, boxes_t = _apply(decode, cls_logits, deltas,
+                                          proposals, op_name="rcnn_decode",
+                                          n_outs=None)
         out = []
         for b in range(B):
-            probs = F.softmax(cls_logits[b], axis=-1)
-            fg = probs[:, :self.num_classes]
-            best = fg.max(axis=-1)
-            label = fg.argmax(axis=-1)
-            idx, valid = vops.nms_padded(boxes[b], best, self.nms_thresh,
+            best = best_t[b]
+            label = label_t[b]
+            boxes = boxes_t[b]
+            idx, valid = vops.nms_padded(boxes, best, self.nms_thresh,
                                          top_k=self.top_k, category_idxs=label)
             iv = np.maximum(np.asarray(idx.numpy()), 0)
             keep = np.asarray(valid.numpy()) & (best.numpy()[iv] > self.score_thresh)
-            out.append({"boxes": Tensor(boxes[b].numpy()[iv]),
+            out.append({"boxes": Tensor(boxes.numpy()[iv]),
                         "scores": Tensor(best.numpy()[iv]),
                         "labels": Tensor(label.numpy()[iv]),
                         "valid": Tensor(keep)})

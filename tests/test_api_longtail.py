@@ -1,0 +1,281 @@
+"""Long-tail API surface (VERDICT r2 #8): new loss layers, unpool/lp_pool,
+pad/unflatten layers, the distribution toolkit, regularizer/callbacks
+namespaces, and inplace op variants."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestPooling:
+    def test_max_pool_mask_matches_numpy_argmax(self):
+        x = np.random.RandomState(0).randn(2, 3, 8, 8).astype("float32")
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                                 return_mask=True)
+        ref = x.reshape(2, 3, 4, 2, 4, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+        # indices are flat positions into the 8x8 input plane
+        mv = mask.numpy()
+        flat = x.reshape(2, 3, 64)
+        picked = np.take_along_axis(flat, mv.reshape(2, 3, -1), axis=2)
+        np.testing.assert_allclose(picked.reshape(out.shape), ref, rtol=1e-6)
+
+    def test_unpool_inverts_pool(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 3, 8, 8).astype("float32"))
+        pool = nn.MaxPool2D(2, stride=2, return_mask=True)
+        unpool = nn.MaxUnPool2D(2, stride=2)
+        out, mask = pool(x)
+        rec = unpool(out, mask)
+        assert rec.shape == [2, 3, 8, 8]
+        rv = rec.numpy().reshape(2, 3, -1)
+        mi = mask.numpy().reshape(2, 3, -1)
+        np.testing.assert_allclose(
+            np.take_along_axis(rv, mi, axis=2).reshape(out.shape),
+            out.numpy(), rtol=1e-6)
+        assert (rec.numpy() != 0).sum() <= out.numpy().size
+
+    def test_mask_with_string_padding_and_ceil(self):
+        x = np.random.RandomState(3).randn(1, 2, 7, 7).astype("float32")
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                                 padding="SAME", return_mask=True)
+        assert out.shape == mask.shape
+        # ceil_mode keeps the last partial window: 7 -> ceil((7-2)/2)+1 = 4
+        out_c, mask_c = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                                     ceil_mode=True, return_mask=True)
+        assert out_c.shape == [1, 2, 4, 4] and mask_c.shape == [1, 2, 4, 4]
+        # floor mode drops it
+        assert F.max_pool2d(paddle.to_tensor(x), 2, stride=2).shape == \
+            [1, 2, 3, 3]
+
+    def test_ceil_mode_drops_padding_only_windows(self):
+        # L=5, k=2, s=2, pad=1, ceil: torch/paddle emit 3 windows — the 4th
+        # would start entirely inside the right padding and must be DROPPED,
+        # not emitted as -inf (max) or NaN (avg)
+        x = paddle.to_tensor(np.arange(5, dtype="float32").reshape(1, 1, 5))
+        got = F.max_pool1d(x, 2, stride=2, padding=1, ceil_mode=True)
+        np.testing.assert_allclose(got.numpy(), [[[0.0, 2.0, 4.0]]])
+        avg = F.avg_pool1d(x, 2, stride=2, padding=1, ceil_mode=True)
+        assert np.isfinite(avg.numpy()).all()
+
+    def test_lp_pool_ceil_mode_shape(self):
+        x = paddle.to_tensor(np.ones((1, 1, 5), "float32"))
+        assert F.lp_pool1d(x, 2, 2, stride=2, ceil_mode=True).shape == [1, 1, 3]
+        assert F.lp_pool1d(x, 2, 2, stride=2).shape == [1, 1, 2]
+
+    def test_lp_pool(self):
+        x = np.random.RandomState(2).randn(2, 3, 8, 8).astype("float32")
+        got = nn.LPPool2D(2, 2, stride=2)(paddle.to_tensor(x)).numpy()
+        ref = np.sqrt((x.reshape(2, 3, 4, 2, 4, 2) ** 2).sum(axis=(3, 5)))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+        assert nn.LPPool1D(3, 2)(paddle.to_tensor(x[:, :, 0])).shape == [2, 3, 4]
+
+
+class TestLossLayers:
+    def setup_method(self, _):
+        rs = np.random.RandomState(0)
+        self.x = paddle.to_tensor(rs.randn(6, 4).astype("float32"))
+        self.rs = rs
+
+    def test_soft_margin(self):
+        y = paddle.to_tensor(
+            (self.rs.randint(0, 2, (6, 4)) * 2 - 1).astype("float32"))
+        got = nn.SoftMarginLoss()(self.x, y)
+        ref = np.log1p(np.exp(-y.numpy() * self.x.numpy())).mean()
+        np.testing.assert_allclose(float(got), ref, rtol=1e-5)
+
+    def test_soft_margin_large_logits_stable(self):
+        # softplus form: a badly misclassified logit must not overflow to inf
+        x = paddle.to_tensor(np.float32([[-100.0, 50.0]]))
+        y = paddle.to_tensor(np.float32([[1.0, -1.0]]))
+        got = float(nn.SoftMarginLoss()(x, y))
+        np.testing.assert_allclose(got, 75.0, rtol=1e-5)
+
+    def test_multi_label_soft_margin(self):
+        y = paddle.to_tensor(self.rs.randint(0, 2, (6, 4)).astype("float32"))
+        got = nn.MultiLabelSoftMarginLoss()(self.x, y)
+        xv, yv = self.x.numpy(), y.numpy()
+        p = 1 / (1 + np.exp(-xv))
+        ref = -(yv * np.log(p) + (1 - yv) * np.log(1 - p)).mean(-1).mean()
+        np.testing.assert_allclose(float(got), ref, rtol=1e-4)
+
+    def test_poisson_nll(self):
+        y = paddle.to_tensor(self.rs.poisson(2.0, (6, 4)).astype("float32"))
+        got = nn.PoissonNLLLoss()(self.x, y)
+        ref = (np.exp(self.x.numpy()) - y.numpy() * self.x.numpy()).mean()
+        np.testing.assert_allclose(float(got), ref, rtol=1e-5)
+
+    def test_gaussian_nll(self):
+        y = paddle.to_tensor(self.rs.randn(6, 4).astype("float32"))
+        var = paddle.to_tensor(np.full((6, 4), 0.5, "float32"))
+        got = nn.GaussianNLLLoss()(self.x, y, var)
+        ref = 0.5 * (np.log(0.5) + (y.numpy() - self.x.numpy()) ** 2 / 0.5).mean()
+        np.testing.assert_allclose(float(got), ref, rtol=1e-5)
+
+    def test_multi_margin(self):
+        y = paddle.to_tensor(self.rs.randint(0, 4, (6,)).astype("int64"))
+        got = nn.MultiMarginLoss()(self.x, y)
+        xv, yv = self.x.numpy(), y.numpy()
+        ref = 0.0
+        for i in range(6):
+            m = np.maximum(0, 1.0 - xv[i, yv[i]] + xv[i])
+            m[yv[i]] = 0
+            ref += m.sum() / 4
+        np.testing.assert_allclose(float(got), ref / 6, rtol=1e-5)
+
+    def test_triplet_with_distance(self):
+        pos = paddle.to_tensor(self.rs.randn(6, 4).astype("float32"))
+        neg = paddle.to_tensor(self.rs.randn(6, 4).astype("float32"))
+        l1 = nn.TripletMarginWithDistanceLoss()(self.x, pos, neg)
+        l2 = nn.TripletMarginLoss()(self.x, pos, neg)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+        custom = nn.TripletMarginWithDistanceLoss(
+            distance_function=lambda a, b: ((a - b) ** 2).sum(-1))
+        assert np.isfinite(float(custom(self.x, pos, neg)))
+
+
+class TestCommonLayers:
+    def test_zeropads_and_unflatten(self):
+        x = paddle.to_tensor(np.ones((1, 2, 4), "float32"))
+        assert nn.ZeroPad1D([1, 2])(x).shape == [1, 2, 7]
+        x3 = paddle.to_tensor(np.ones((1, 2, 3, 4, 5), "float32"))
+        assert nn.ZeroPad3D([1, 1, 1, 1, 1, 1])(x3).shape == [1, 2, 5, 6, 7]
+        u = nn.Unflatten(1, [2, 2])(paddle.to_tensor(np.ones((3, 4), "float32")))
+        assert u.shape == [3, 2, 2]
+
+    def test_softmax2d(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 3, 4, 4).astype("float32"))
+        out = nn.Softmax2D()(x).numpy()
+        np.testing.assert_allclose(out.sum(1), np.ones((2, 4, 4)), rtol=1e-5)
+
+    def test_dropout1d(self):
+        m = nn.Dropout1D(p=0.5)
+        m.eval()
+        x = paddle.to_tensor(np.ones((2, 4, 8), "float32"))
+        np.testing.assert_array_equal(m(x).numpy(), x.numpy())
+        m.train()
+        paddle.seed(0)
+        y = m(x).numpy()
+        # channel-wise: each (n, c) channel is all-zero or all-scaled
+        per_chan = (y != 0).reshape(2, 4, 8)
+        assert ((per_chan.all(-1)) | (~per_chan.any(-1))).all()
+
+
+class TestDistribution:
+    def test_normal_moments_logprob_kl(self):
+        import paddle_tpu.distribution as D
+
+        paddle.seed(7)
+        d = D.Normal(1.0, 0.5)
+        s = d.sample([20000])
+        assert abs(float(s.mean()) - 1.0) < 0.05
+        assert abs(float(s.std()) - 0.5) < 0.05
+        lp = d.log_prob(paddle.to_tensor(np.float32([1.0])))
+        np.testing.assert_allclose(
+            lp.numpy(), [-math.log(0.5 * math.sqrt(2 * math.pi))], rtol=1e-5)
+        kl = D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(1.0, 2.0))
+        np.testing.assert_allclose(float(kl),
+                                   math.log(2.0) + 2 / 8 - 0.5, rtol=1e-5)
+
+    def test_categorical_and_bernoulli(self):
+        import paddle_tpu.distribution as D
+
+        paddle.seed(3)
+        c = D.Categorical(probs=paddle.to_tensor(np.float32([0.1, 0.6, 0.3])))
+        s = c.sample([30000]).numpy().astype(int)
+        np.testing.assert_allclose(np.bincount(s, minlength=3) / 30000,
+                                   [0.1, 0.6, 0.3], atol=0.02)
+        lp = c.log_prob(paddle.to_tensor(np.int64([1])))
+        np.testing.assert_allclose(lp.numpy(), [math.log(0.6)], rtol=1e-5)
+        b = D.Bernoulli(0.25)
+        np.testing.assert_allclose(float(b.sample([40000]).mean()), 0.25,
+                                   atol=0.02)
+
+    def test_kl_self_is_zero(self):
+        import paddle_tpu.distribution as D
+
+        for d in (D.Bernoulli(0.3), D.Laplace(0.0, 1.0), D.Gamma(2.0, 2.0),
+                  D.Beta(2.0, 2.0), D.Exponential(1.5),
+                  D.Uniform(0.0, 2.0),
+                  D.Categorical(probs=paddle.to_tensor(
+                      np.float32([0.4, 0.6])))):
+            z = np.asarray(D.kl_divergence(d, d)._value)
+            np.testing.assert_allclose(z, np.zeros_like(z), atol=1e-5)
+
+    def test_log_prob_differentiable(self):
+        import paddle_tpu.distribution as D
+
+        x = paddle.to_tensor(np.float32([0.5]), stop_gradient=False)
+        D.Normal(0.0, 1.0).log_prob(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [-0.5], rtol=1e-5)
+
+    def test_parameter_gradients_flow(self):
+        """VAE/policy-gradient contract: rsample, log_prob and KL are
+        differentiable w.r.t. the DISTRIBUTION PARAMETERS."""
+        import paddle_tpu.distribution as D
+
+        paddle.seed(5)
+        mu = paddle.to_tensor(np.float32([0.5]), stop_gradient=False)
+        kl = D.kl_divergence(D.Normal(mu, 1.0), D.Normal(0.0, 1.0)).sum()
+        kl.backward()
+        # d/dmu [mu^2/2] = mu
+        np.testing.assert_allclose(mu.grad.numpy(), [0.5], rtol=1e-5)
+
+        sig = paddle.to_tensor(np.float32([1.0]), stop_gradient=False)
+        z = D.Normal(0.0, sig).rsample([512])
+        (z ** 2).mean().backward()
+        assert sig.grad is not None and np.isfinite(sig.grad.numpy()).all()
+
+        logits = paddle.to_tensor(np.float32([[0.2, -0.2]]),
+                                  stop_gradient=False)
+        c = D.Categorical(logits=logits)
+        (-c.log_prob(paddle.to_tensor(np.int64([1])))).sum().backward()
+        g = logits.grad.numpy()
+        assert abs(g.sum()) < 1e-5 and g[0, 1] < 0 < g[0, 0]
+
+    def test_sample_is_detached_rsample_is_not(self):
+        import paddle_tpu.distribution as D
+
+        mu = paddle.to_tensor(np.float32([0.1]), stop_gradient=False)
+        d = D.Normal(mu, 1.0)
+        assert d.sample([4]).stop_gradient
+        assert not d.rsample([4]).stop_gradient
+
+    def test_unregistered_kl_raises(self):
+        import paddle_tpu.distribution as D
+
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(0.0, 1.0), D.Gamma(1.0, 1.0))
+
+
+class TestNamespaces:
+    def test_regularizer_and_callbacks(self):
+        import paddle_tpu.regularizer as R
+
+        assert R.L2Decay(3e-4).coeff == pytest.approx(3e-4)
+        assert paddle.regularizer.L1Decay(0.1).coeff == pytest.approx(0.1)
+        assert hasattr(paddle.callbacks, "EarlyStopping")
+        assert hasattr(paddle.distribution, "Normal")
+
+    def test_inplace_variants(self):
+        x = paddle.to_tensor(np.float32([[1.0, -2.0], [3.0, -4.0]]),
+                             stop_gradient=False)
+        y = x * 1.0
+        paddle.clip_(y, -1.0, 1.0)
+        np.testing.assert_allclose(y.numpy(), [[1, -1], [1, -1]], rtol=1e-6)
+        z = x * 2.0
+        paddle.add_(z, paddle.to_tensor(np.float32(1.0)))
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 2.0))
+        w = paddle.to_tensor(np.ones((2, 3), "float32"))
+        paddle.scale_(w, scale=3.0, bias=1.0)
+        np.testing.assert_allclose(w.numpy(), np.full((2, 3), 4.0))
+        v = paddle.to_tensor(np.zeros((4,), "float32"))
+        paddle.index_fill_(v, paddle.to_tensor(np.int64([1, 2])), 0, 9.0)
+        np.testing.assert_allclose(v.numpy(), [0, 9, 9, 0])

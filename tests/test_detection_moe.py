@@ -325,3 +325,38 @@ def test_ppyoloe_loss_on_non_divisible_input():
     gtb[0, 0] = [10, 10, 60, 60]; gtl[0, 0] = 1
     losses = m(img, paddle.to_tensor(gtb), paddle.to_tensor(gtl))
     assert np.isfinite(float(losses["loss"]))
+
+
+def test_rcnn_delta_coder_roundtrip():
+    """Standard (dx,dy,dw,dh) bbox coder: encode(decode) is the identity
+    and matches the reference weights (10,10,5,5)."""
+    from paddle_tpu.vision.models.detection import (_decode_deltas,
+                                                    _encode_deltas)
+
+    rs = np.random.RandomState(0)
+    raw = rs.uniform(0, 50, (6, 4)).astype("float32")
+    p = np.concatenate([np.minimum(raw[:, :2], raw[:, 2:]),
+                        np.maximum(raw[:, :2], raw[:, 2:]) + 4], -1)
+    g = p + np.float32([3., -2., 5., 1.])
+    d = _encode_deltas(jnp.asarray(p), jnp.asarray(g))
+    rec = _decode_deltas(jnp.asarray(p), d)
+    np.testing.assert_allclose(np.asarray(rec), g, rtol=1e-4, atol=1e-3)
+    # known value: gt shifted +10 in x on a 20-wide box -> dx = 10*10/20 = 5
+    p1 = jnp.asarray([[0.0, 0.0, 20.0, 10.0]])
+    g1 = jnp.asarray([[10.0, 0.0, 30.0, 10.0]])
+    np.testing.assert_allclose(np.asarray(_encode_deltas(p1, g1))[0],
+                               [5.0, 0.0, 0.0, 0.0], atol=1e-5)
+
+
+def test_rcnn_class_specific_regression_shapes():
+    from paddle_tpu.vision.models import faster_rcnn
+
+    paddle.seed(2)
+    m = faster_rcnn(num_classes=3, depth=18, num_proposals=16)
+    assert m.bbox_delta.weight.shape[-1] == 12  # 4 deltas per class
+    img = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 3, 96, 96).astype("float32"))
+    m.eval()
+    dets = m(img)
+    assert dets[0]["boxes"].shape == [16, 4]
+    assert int(dets[0]["labels"].numpy().max()) < 3

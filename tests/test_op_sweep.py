@@ -68,6 +68,85 @@ def A(name, ref, grad=True, fn=None, **kw):
          grad=grad, **kw)
 
 
+# ----------------------------------------------- long-tail ops (round 3)
+def _longtail_specs():
+    spec("sgn-real", lambda x: paddle.sgn(x), np.sign,
+         {"x": rnd(3, 4, lo=0.2, hi=2.0, seed=301)}, grad=False)
+    spec("vdot", lambda x, y: paddle.vdot(x, y), np.vdot,
+         {"x": rnd(6, seed=302), "y": rnd(6, seed=303)})
+    spec("positive", lambda x: paddle.positive(x), lambda x: +x,
+         {"x": rnd(3, 4, seed=304)})
+    spec("negative", lambda x: paddle.negative(x), np.negative,
+         {"x": rnd(3, 4, seed=305)})
+    spec("bitwise_left_shift", lambda x, y: paddle.bitwise_left_shift(x, y),
+         np.left_shift,
+         {"x": _rs(306).randint(0, 8, (3, 4)).astype("int32"),
+          "y": _rs(307).randint(0, 4, (3, 4)).astype("int32")}, grad=False)
+    spec("bitwise_right_shift", lambda x, y: paddle.bitwise_right_shift(x, y),
+         np.right_shift,
+         {"x": _rs(308).randint(0, 64, (3, 4)).astype("int32"),
+          "y": _rs(309).randint(0, 4, (3, 4)).astype("int32")}, grad=False)
+    spec("addbmm", lambda input, x, y: paddle.addbmm(input, x, y),
+         lambda input, x, y: input + np.einsum("bij,bjk->ik", x, y),
+         {"input": rnd(3, 2, seed=310), "x": rnd(2, 3, 4, seed=311),
+          "y": rnd(2, 4, 2, seed=312)})
+    spec("baddbmm", lambda input, x, y: paddle.baddbmm(input, x, y),
+         lambda input, x, y: input + x @ y,
+         {"input": rnd(2, 3, 2, seed=313), "x": rnd(2, 3, 4, seed=314),
+          "y": rnd(2, 4, 2, seed=315)})
+    spec("tensordot", lambda x, y: paddle.tensordot(x, y, axes=1),
+         lambda x, y: np.tensordot(x, y, axes=1),
+         {"x": rnd(3, 4, seed=316), "y": rnd(4, 2, seed=317)})
+    spec("cdist", lambda x, y: paddle.cdist(x, y),
+         lambda x, y: np.sqrt(((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)),
+         {"x": rnd(3, 4, seed=318), "y": rnd(2, 4, seed=319)}, grad_rtol=5e-2)
+    spec("diagonal", lambda x: paddle.diagonal(x, axis1=1, axis2=2),
+         lambda x: np.diagonal(x, axis1=1, axis2=2),
+         {"x": rnd(2, 3, 4, seed=320)})
+    spec("unflatten", lambda x: paddle.unflatten(x, 1, [2, 2]),
+         lambda x: x.reshape(3, 2, 2), {"x": rnd(3, 4, seed=321)})
+    spec("matrix_transpose", lambda x: paddle.matrix_transpose(x),
+         lambda x: np.swapaxes(x, -2, -1), {"x": rnd(2, 3, 4, seed=322)})
+    spec("index_fill-axis0", lambda x, index: paddle.index_fill(x, index, 0, 5.0),
+         lambda x, index: _index_fill(x, index, 5.0),
+         {"x": rnd(4, 3, seed=323), "index": np.array([1, 3], dtype="int64")})
+    spec("corrcoef", lambda x: paddle.corrcoef(x),
+         lambda x: np.corrcoef(x).astype("float32"),
+         {"x": rnd(3, 6, seed=324)}, rtol=1e-4, atol=1e-4, grad=False)
+    spec("cov-top", lambda x: paddle.cov(x),
+         lambda x: np.cov(x).astype("float32"), {"x": rnd(3, 6, seed=325)},
+         rtol=1e-4, atol=1e-4, grad=False)
+    spec("isposinf", lambda x: paddle.isposinf(x), np.isposinf,
+         {"x": np.array([1.0, np.inf, -np.inf], "float32")}, grad=False)
+    spec("isneginf", lambda x: paddle.isneginf(x), np.isneginf,
+         {"x": np.array([1.0, np.inf, -np.inf], "float32")}, grad=False)
+    spec("isreal", lambda x: paddle.isreal(x), np.isreal,
+         {"x": rnd(3, 4, seed=326)}, grad=False)
+    # independent oracle via the shape-1 closed form: Q(1, a) = e^-a —
+    # catches a swapped (x, a) -> gammainc* argument mapping, which a
+    # jax.scipy "reference" cannot
+    spec("igamma", lambda x, a: paddle.igamma(x, a),
+         lambda x, a: np.exp(-a),
+         {"x": np.ones((3, 4), "float32"),
+          "a": rnd(3, 4, lo=0.5, hi=3.0, seed=328)}, rtol=1e-4, atol=1e-4,
+         grad=False)
+    spec("igammac", lambda x, a: paddle.igammac(x, a),
+         lambda x, a: 1.0 - np.exp(-a),
+         {"x": np.ones((3, 4), "float32"),
+          "a": rnd(3, 4, lo=0.5, hi=3.0, seed=330)}, rtol=1e-4, atol=1e-4,
+         grad=False)
+    spec("histogram_bin_edges",
+         lambda input: paddle.histogram_bin_edges(input, bins=4, min=-1, max=1),
+         lambda input: np.histogram_bin_edges(input, bins=4, range=(-1, 1))
+         .astype("float32"), {"input": rnd(3, 4, seed=331)}, grad=False)
+    spec("frexp-mantissa", lambda x: paddle.frexp(x)[0],
+         lambda x: np.frexp(x)[0], {"x": rnd(3, 4, lo=0.3, hi=3.0, seed=332)},
+         grad=False)
+
+
+_longtail_specs()
+
+
 # ---------------------------------------------------- reference helpers
 
 def _softmax(x):

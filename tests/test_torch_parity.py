@@ -1,0 +1,246 @@
+"""Cross-framework parity: nn functionals checked against torch (CPU) as an
+independent oracle (SURVEY.md §4 — the reference validates kernels against
+authoritative implementations; numpy refs live in test_op_sweep, torch
+covers the layers whose math is too intricate to re-derive: convs, norms,
+interpolation, NLL/CTC-class losses, fold/grid_sample)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+
+
+def _np(*shape, seed=0, lo=-1.0, hi=1.0):
+    return np.random.RandomState(seed).uniform(lo, hi, shape).astype("float32")
+
+
+def _chk(pd_out, th_out, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(pd_out.numpy(), th_out.detach().numpy(),
+                               rtol=rtol, atol=atol)
+
+
+class TestConvParity:
+    def test_conv2d(self):
+        x, w, b = _np(2, 3, 10, 10, seed=1), _np(8, 3, 3, 3, seed=2), _np(8, seed=3)
+        got = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                       paddle.to_tensor(b), stride=2, padding=1)
+        ref = TF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                        stride=2, padding=1)
+        _chk(got, ref)
+
+    def test_conv2d_groups_dilation(self):
+        x, w = _np(1, 4, 9, 9, seed=4), _np(8, 2, 3, 3, seed=5)
+        got = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), None,
+                       dilation=2, groups=2)
+        ref = TF.conv2d(torch.tensor(x), torch.tensor(w), dilation=2, groups=2)
+        _chk(got, ref)
+
+    def test_conv2d_transpose(self):
+        x, w = _np(1, 4, 5, 5, seed=6), _np(4, 6, 3, 3, seed=7)
+        got = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                 stride=2, padding=1)
+        ref = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  stride=2, padding=1)
+        _chk(got, ref, rtol=2e-4)
+
+    def test_conv1d_and_3d(self):
+        x1, w1 = _np(2, 3, 12, seed=8), _np(5, 3, 3, seed=9)
+        _chk(F.conv1d(paddle.to_tensor(x1), paddle.to_tensor(w1), padding=1),
+             TF.conv1d(torch.tensor(x1), torch.tensor(w1), padding=1))
+        x3, w3 = _np(1, 2, 5, 5, 5, seed=10), _np(3, 2, 2, 2, 2, seed=11)
+        _chk(F.conv3d(paddle.to_tensor(x3), paddle.to_tensor(w3)),
+             TF.conv3d(torch.tensor(x3), torch.tensor(w3)), rtol=2e-4)
+
+
+class TestNormParity:
+    def test_layer_norm(self):
+        x, g, b = _np(4, 6, seed=12), _np(6, seed=13), _np(6, seed=14)
+        got = F.layer_norm(paddle.to_tensor(x), 6, weight=paddle.to_tensor(g),
+                           bias=paddle.to_tensor(b))
+        ref = TF.layer_norm(torch.tensor(x), (6,), torch.tensor(g),
+                            torch.tensor(b))
+        _chk(got, ref)
+
+    def test_batch_norm_eval(self):
+        x = _np(4, 3, 5, 5, seed=15)
+        mean, var = _np(3, seed=16, lo=0, hi=1), _np(3, seed=17, lo=0.5, hi=2)
+        g, b = _np(3, seed=18), _np(3, seed=19)
+        got = F.batch_norm(paddle.to_tensor(x), paddle.to_tensor(mean),
+                           paddle.to_tensor(var), paddle.to_tensor(g),
+                           paddle.to_tensor(b), training=False)
+        ref = TF.batch_norm(torch.tensor(x), torch.tensor(mean),
+                            torch.tensor(var), torch.tensor(g),
+                            torch.tensor(b), training=False)
+        _chk(got, ref)
+
+    def test_group_norm(self):
+        x = _np(2, 6, 4, 4, seed=20)
+        g, b = _np(6, seed=21), _np(6, seed=22)
+        got = F.group_norm(paddle.to_tensor(x), 3, weight=paddle.to_tensor(g),
+                           bias=paddle.to_tensor(b))
+        ref = TF.group_norm(torch.tensor(x), 3, torch.tensor(g),
+                            torch.tensor(b))
+        _chk(got, ref)
+
+    def test_instance_norm(self):
+        x = _np(2, 3, 6, 6, seed=23)
+        got = F.instance_norm(paddle.to_tensor(x))
+        ref = TF.instance_norm(torch.tensor(x))
+        _chk(got, ref, rtol=2e-4)
+
+
+class TestLossParity:
+    def test_cross_entropy_weighted(self):
+        x = _np(8, 5, seed=24, lo=-2, hi=2)
+        y = np.random.RandomState(25).randint(0, 5, (8,)).astype("int64")
+        w = _np(5, seed=26, lo=0.5, hi=2.0)
+        got = F.cross_entropy(paddle.to_tensor(x), paddle.to_tensor(y),
+                              weight=paddle.to_tensor(w))
+        ref = TF.cross_entropy(torch.tensor(x), torch.tensor(y),
+                               weight=torch.tensor(w))
+        _chk(got, ref)
+
+    def test_cross_entropy_ignore_index(self):
+        x = _np(8, 5, seed=27, lo=-2, hi=2)
+        y = np.random.RandomState(28).randint(0, 5, (8,)).astype("int64")
+        y[:3] = -100
+        got = F.cross_entropy(paddle.to_tensor(x), paddle.to_tensor(y),
+                              ignore_index=-100)
+        ref = TF.cross_entropy(torch.tensor(x), torch.tensor(y),
+                               ignore_index=-100)
+        _chk(got, ref)
+
+    def test_nll_kl_bce(self):
+        x = _np(6, 4, seed=29, lo=-2, hi=2)
+        logp = np.log(np.exp(x) / np.exp(x).sum(-1, keepdims=True))
+        y = np.random.RandomState(30).randint(0, 4, (6,)).astype("int64")
+        _chk(F.nll_loss(paddle.to_tensor(logp), paddle.to_tensor(y)),
+             TF.nll_loss(torch.tensor(logp), torch.tensor(y)))
+        q = _np(6, 4, seed=31, lo=0.1, hi=1.0)
+        q = q / q.sum(-1, keepdims=True)
+        _chk(F.kl_div(paddle.to_tensor(logp), paddle.to_tensor(q),
+                      reduction="batchmean"),
+             TF.kl_div(torch.tensor(logp), torch.tensor(q),
+                       reduction="batchmean"))
+        z = _np(6, 4, seed=32, lo=-2, hi=2)
+        t = np.random.RandomState(33).randint(0, 2, (6, 4)).astype("float32")
+        _chk(F.binary_cross_entropy_with_logits(paddle.to_tensor(z),
+                                                paddle.to_tensor(t)),
+             TF.binary_cross_entropy_with_logits(torch.tensor(z),
+                                                 torch.tensor(t)))
+
+    def test_smooth_l1_and_margin(self):
+        a, b = _np(5, 3, seed=34, lo=-2, hi=2), _np(5, 3, seed=35, lo=-2, hi=2)
+        # paddle smooth_l1_loss is the HUBER form (scales with delta):
+        # 0.5 x^2 inside, delta*|x| - 0.5 delta^2 outside == torch huber_loss
+        _chk(F.smooth_l1_loss(paddle.to_tensor(a), paddle.to_tensor(b),
+                              delta=0.5),
+             TF.huber_loss(torch.tensor(a), torch.tensor(b), delta=0.5))
+        x1, x2 = _np(6, seed=36), _np(6, seed=37)
+        y = np.sign(_np(6, seed=38)).astype("float32")
+        _chk(F.margin_ranking_loss(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                                   paddle.to_tensor(y)),
+             TF.margin_ranking_loss(torch.tensor(x1), torch.tensor(x2),
+                                    torch.tensor(y)))
+
+    def test_new_losses_vs_torch(self):
+        x = _np(6, 4, seed=39, lo=-2, hi=2)
+        y01 = np.random.RandomState(40).randint(0, 2, (6, 4)).astype("float32")
+        ypm = (y01 * 2 - 1).astype("float32")
+        _chk(F.soft_margin_loss(paddle.to_tensor(x), paddle.to_tensor(ypm)),
+             TF.soft_margin_loss(torch.tensor(x), torch.tensor(ypm)))
+        _chk(F.multi_label_soft_margin_loss(paddle.to_tensor(x),
+                                            paddle.to_tensor(y01)),
+             TF.multilabel_soft_margin_loss(torch.tensor(x),
+                                            torch.tensor(y01)))
+        lam = np.random.RandomState(41).uniform(0.5, 3, (6, 4)).astype("float32")
+        _chk(F.poisson_nll_loss(paddle.to_tensor(x), paddle.to_tensor(lam)),
+             TF.poisson_nll_loss(torch.tensor(x), torch.tensor(lam)))
+        mu = _np(6, 4, seed=42)
+        var = _np(6, 4, seed=43, lo=0.2, hi=2.0)
+        _chk(F.gaussian_nll_loss(paddle.to_tensor(x), paddle.to_tensor(mu),
+                                 paddle.to_tensor(var)),
+             TF.gaussian_nll_loss(torch.tensor(x), torch.tensor(mu),
+                                  torch.tensor(var)))
+        yc = np.random.RandomState(44).randint(0, 4, (6,)).astype("int64")
+        _chk(F.multi_margin_loss(paddle.to_tensor(x), paddle.to_tensor(yc)),
+             TF.multi_margin_loss(torch.tensor(x), torch.tensor(yc)))
+
+
+class TestShapeOpsParity:
+    def test_interpolate_bilinear_nearest(self):
+        x = _np(1, 2, 5, 7, seed=45)
+        got = F.interpolate(paddle.to_tensor(x), size=[10, 14],
+                            mode="bilinear", align_corners=False)
+        ref = TF.interpolate(torch.tensor(x), size=(10, 14), mode="bilinear",
+                             align_corners=False)
+        _chk(got, ref, rtol=1e-3, atol=1e-4)
+        got = F.interpolate(paddle.to_tensor(x), scale_factor=2,
+                            mode="nearest")
+        ref = TF.interpolate(torch.tensor(x), scale_factor=2, mode="nearest")
+        _chk(got, ref)
+
+    def test_pad_reflect_replicate(self):
+        x = _np(1, 2, 4, 5, seed=46)
+        for mode in ("reflect", "replicate"):
+            got = F.pad(paddle.to_tensor(x), [1, 2, 2, 1], mode=mode)
+            ref = TF.pad(torch.tensor(x), (1, 2, 2, 1), mode=mode)
+            _chk(got, ref)
+
+    def test_pixel_shuffle_unshuffle(self):
+        x = _np(1, 8, 3, 3, seed=47)
+        _chk(F.pixel_shuffle(paddle.to_tensor(x), 2),
+             TF.pixel_shuffle(torch.tensor(x), 2))
+        y = _np(1, 2, 6, 6, seed=48)
+        _chk(F.pixel_unshuffle(paddle.to_tensor(y), 2),
+             TF.pixel_unshuffle(torch.tensor(y), 2))
+
+    def test_unfold_fold(self):
+        x = _np(1, 3, 6, 6, seed=49)
+        got = F.unfold(paddle.to_tensor(x), kernel_sizes=2, strides=2)
+        ref = TF.unfold(torch.tensor(x), kernel_size=2, stride=2)
+        _chk(got, ref)
+        cols = _np(1, 12, 9, seed=50)
+        got = F.fold(paddle.to_tensor(cols), output_sizes=[6, 6],
+                     kernel_sizes=2, strides=2)
+        ref = TF.fold(torch.tensor(cols), output_size=(6, 6), kernel_size=2,
+                      stride=2)
+        _chk(got, ref)
+
+    def test_grid_sample(self):
+        x = _np(1, 2, 5, 5, seed=51)
+        g = _np(1, 4, 4, 2, seed=52, lo=-0.9, hi=0.9)
+        got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g),
+                            align_corners=True)
+        ref = TF.grid_sample(torch.tensor(x), torch.tensor(g),
+                             align_corners=True)
+        _chk(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_adaptive_pools(self):
+        x = _np(2, 3, 9, 9, seed=53)
+        _chk(F.adaptive_avg_pool2d(paddle.to_tensor(x), 3),
+             TF.adaptive_avg_pool2d(torch.tensor(x), 3))
+        _chk(F.adaptive_max_pool2d(paddle.to_tensor(x), 3),
+             TF.adaptive_max_pool2d(torch.tensor(x), 3))
+
+    def test_max_unpool_vs_torch(self):
+        x = _np(1, 2, 8, 8, seed=54)
+        p_out, p_idx = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                                    return_mask=True)
+        t_out, t_idx = TF.max_pool2d(torch.tensor(x), 2, stride=2,
+                                     return_indices=True)
+        _chk(p_out, t_out)
+        np.testing.assert_array_equal(p_idx.numpy(),
+                                      t_idx.numpy().astype("int32"))
+        _chk(F.max_unpool2d(p_out, p_idx, 2, stride=2),
+             TF.max_unpool2d(t_out, t_idx, 2, stride=2))
+
+    def test_embedding_and_one_hot(self):
+        w = _np(10, 4, seed=55)
+        ids = np.array([[1, 3], [7, 9]], dtype="int64")
+        _chk(F.embedding(paddle.to_tensor(ids), paddle.to_tensor(w)),
+             TF.embedding(torch.tensor(ids), torch.tensor(w)))
