@@ -66,7 +66,7 @@ import importlib as _importlib
 _LAZY = ("nn", "optimizer", "amp", "io", "metric", "jit", "static", "vision",
          "distributed", "autograd", "device", "framework", "hapi", "profiler",
          "incubate", "utils", "sparse", "signal", "fft", "text", "ops",
-         "distribution", "regularizer", "callbacks")
+         "distribution", "regularizer", "callbacks", "inference")
 
 
 def __getattr__(name):

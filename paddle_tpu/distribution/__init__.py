@@ -199,11 +199,16 @@ class Categorical(Distribution):
             lg = jnp.broadcast_to(logits, idx.shape + logits.shape[-1:])
             return jnp.take_along_axis(lg, idx[..., None], -1)[..., 0]
 
+        if not isinstance(value, Tensor):
+            value = Tensor(jnp.asarray(value))  # keep integer dtype
         return _apply(fn, value, self.logits, op_name="categorical_log_prob")
 
     def entropy(self):
-        return _apply(lambda lg: -(jnp.exp(lg) * lg).sum(-1), self.logits,
-                      op_name="categorical_entropy")
+        def fn(lg):
+            p = jnp.exp(lg)
+            return -jnp.where(p > 0, p * lg, 0.0).sum(-1)  # 0*log(0) = 0
+
+        return _apply(fn, self.logits, op_name="categorical_entropy")
 
 
 class Bernoulli(Distribution):
@@ -441,9 +446,11 @@ class Multinomial(Distribution):
     def log_prob(self, value):
         from jax.scipy.special import gammaln
 
+        from jax.scipy.special import xlogy
+
         return _apply(
             lambda v, p: gammaln(v.sum(-1) + 1) - gammaln(v + 1).sum(-1)
-            + (v * jnp.log(p)).sum(-1),
+            + xlogy(v, p).sum(-1),
             _t(value), self.probs_, op_name="multinomial_log_prob")
 
 

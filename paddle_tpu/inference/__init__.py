@@ -1,0 +1,231 @@
+"""paddle.inference — the deployment API (reference analog:
+paddle/fluid/inference/api: Config + create_predictor + AnalysisPredictor).
+
+TPU-native: the "inference program" is the StableHLO artifact written by
+``paddle.jit.save`` (versioned, compiler-stable); the predictor wraps a
+:class:`~paddle_tpu.jit.TranslatedLayer` and jit-executes it on the chip.
+The reference's graph-pass knobs (IR optim, memory optim, TensorRT) have no
+analog — XLA owns those decisions — so the Config records them as inert
+flags for script compatibility and ``summary()`` says what actually runs.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor",
+           "get_version"]
+
+
+def get_version():
+    from .. import __version__
+
+    return f"paddle_tpu-inference {__version__} (StableHLO/XLA)"
+
+
+class Config:
+    """reference: paddle.inference.Config(prog_file, params_file) or
+    Config(model_dir).  Here both spellings resolve to a jit.save prefix:
+    ``Config("dir/model")`` loads dir/model.{stablehlo,pdparams,spec.json}.
+    """
+
+    def __init__(self, prog_file=None, params_file=None, model_dir=None):
+        self._prefix = None
+        target = prog_file if prog_file is not None else model_dir
+        if target is not None:
+            t = str(target)
+            for suffix in (".stablehlo", ".pdmodel", ".spec.json",
+                           ".pdparams", ".json"):
+                if t.endswith(suffix):
+                    t = t[: -len(suffix)]
+                    break
+            self._prefix = t
+        self._flags = {}
+        self._device = "tpu"
+        self._device_id = 0
+
+    # ------------------------------------------------------------- device
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # the accelerator here is the TPU; accept the call, record intent
+        self._device, self._device_id = "tpu", device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_custom_device(self, device_type, device_id=0):
+        self._device, self._device_id = device_type, device_id
+
+    def use_gpu(self):
+        return self._device != "cpu"
+
+    def gpu_device_id(self):
+        return self._device_id
+
+    # --------------------------------------------- inert graph-pass knobs
+    def _inert(self, name, *a, **k):
+        self._flags[name] = (a, k)
+
+    def switch_ir_optim(self, x=True):
+        self._inert("ir_optim", x)
+
+    def enable_memory_optim(self, x=True):
+        self._inert("memory_optim", x)
+
+    def switch_use_feed_fetch_ops(self, x=False):
+        self._inert("feed_fetch_ops", x)
+
+    def switch_specify_input_names(self, x=True):
+        self._inert("specify_input_names", x)
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._inert("cpu_threads", n)
+
+    def enable_mkldnn(self):
+        self._inert("mkldnn")
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._inert("tensorrt", *a, **k)
+
+    def set_optim_cache_dir(self, d):
+        self._inert("optim_cache_dir", d)
+
+    def enable_profile(self):
+        self._inert("profile")
+
+    def disable_glog_info(self):
+        self._inert("glog_off")
+
+    # ------------------------------------------------------------- info
+    def model_dir(self):
+        return os.path.dirname(self._prefix or "")
+
+    def prog_file(self):
+        return (self._prefix or "") + ".stablehlo"
+
+    def params_file(self):
+        return (self._prefix or "") + ".pdparams"
+
+    def summary(self):
+        lines = [
+            "paddle_tpu inference config",
+            f"  artifact prefix : {self._prefix}",
+            f"  device          : {self._device}:{self._device_id}",
+            "  executor        : XLA (StableHLO artifact; graph passes owned "
+            "by the compiler)",
+        ]
+        for k, v in self._flags.items():
+            lines.append(f"  [inert] {k}      : {v}")
+        return "\n".join(lines)
+
+
+class PredictorTensor:
+    """Input/output handle (reference: paddle.inference.Tensor): host-side
+    staging buffer; ``run()`` moves inputs to the chip in one batch."""
+
+    def __init__(self, name, spec_shape=None, dtype=None):
+        self._name = name
+        self._spec_shape = spec_shape
+        self._dtype = dtype
+        self._value = None
+
+    def name(self):
+        return self._name
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = np.reshape(self._value, shape)
+        else:
+            self._spec_shape = list(shape)
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def share_external_data(self, arr):
+        self.copy_from_cpu(np.asarray(arr))
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        v = self._value if self._value is not None else None
+        return list(v.shape) if v is not None else list(self._spec_shape or [])
+
+    def type(self):
+        return str(self._dtype)
+
+
+class Predictor:
+    """reference AnalysisPredictor: named input handles -> run() -> named
+    output handles.  Execution is the TranslatedLayer's jitted StableHLO
+    call; repeated run()s at the same shapes hit the compiled cache."""
+
+    def __init__(self, config: Config):
+        if config._prefix is None:
+            raise ValueError("Config has no model path; pass the jit.save "
+                             "prefix, e.g. Config('inference/model')")
+        from .. import jit as _jit
+
+        self._layer = _jit.load(config._prefix)
+        spec = self._layer._meta.get("input_spec", [])
+        self._inputs = {}
+        for i, s in enumerate(spec):
+            nm = s.get("name") or f"input_{i}"
+            self._inputs[nm] = PredictorTensor(nm, s.get("shape"),
+                                               s.get("dtype"))
+        if not self._inputs:
+            self._inputs["input_0"] = PredictorTensor("input_0")
+        self._outputs = []
+        self._config = config
+
+    # ---------------------------------------------------------------- api
+    def get_input_names(self):
+        return list(self._inputs.keys())
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(max(len(self._outputs), 1))]
+
+    def get_output_handle(self, name):
+        i = int(name.split("_")[-1])
+        return self._outputs[i]
+
+    def run(self, inputs=None):
+        """Execute; also callable functionally: run([np_arrays]) -> list."""
+        from ..tensor.tensor import Tensor
+
+        if inputs is not None:
+            for h, a in zip(self._inputs.values(), inputs):
+                h.copy_from_cpu(np.asarray(a))
+        args = []
+        for h in self._inputs.values():
+            if h._value is None:
+                raise RuntimeError(f"input {h.name()!r} not set; call "
+                                   "copy_from_cpu first")
+            args.append(Tensor(np.asarray(h._value)))
+        out = self._layer(*args)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        self._outputs = []
+        for i, o in enumerate(outs):
+            t = PredictorTensor(f"output_{i}")
+            t.copy_from_cpu(np.asarray(o.numpy()))
+            self._outputs.append(t)
+        if inputs is not None:
+            return [t.copy_to_cpu() for t in self._outputs]
+        return True
+
+    def clone(self):
+        return Predictor(self._config)
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -94,3 +94,91 @@ def to_sparse_coo(x, sparse_dim=None):
     t = SparseCooTensor(v)
     t.bcoo = bcoo
     return t
+
+
+# --------------------------------------------------- round-3 surface growth
+def _unary(fn, name):
+    def op(x, name=None):
+        out = Tensor(fn(_dense(x)))
+        return out
+
+    op.__name__ = name
+    return op
+
+
+sin = _unary(jnp.sin, "sin")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+atanh = _unary(jnp.arctanh, "atanh")
+sqrt = _unary(jnp.sqrt, "sqrt")
+square = _unary(jnp.square, "square")
+log1p = _unary(jnp.log1p, "log1p")
+abs = _unary(jnp.abs, "abs")  # noqa: A001
+expm1 = _unary(jnp.expm1, "expm1")
+neg = _unary(jnp.negative, "neg")
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return Tensor(_dense(x) ** factor)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    v = _dense(x)
+    if value_dtype is not None:
+        from ..framework import dtypes as _dt
+
+        v = v.astype(_dt.to_jax(value_dtype))
+    return Tensor(v)
+
+
+def transpose(x, perm, name=None):
+    return Tensor(jnp.transpose(_dense(x), perm))
+
+
+def reshape(x, shape, name=None):
+    return Tensor(jnp.reshape(_dense(x), shape))
+
+
+def coalesce(x, name=None):
+    """Sum duplicate indices (BCOO sum_duplicates)."""
+    if isinstance(x, SparseCooTensor) and getattr(x, "bcoo", None) is not None:
+        b = x.bcoo.sum_duplicates()
+        t = SparseCooTensor(b.todense())
+        t.bcoo = b
+        return t
+    return to_sparse_coo(x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return Tensor(beta * _dense(input) + alpha * (_dense(x) @ _dense(y)))
+
+
+def mv(mat, vec, name=None):
+    return Tensor(_dense(mat) @ _dense(vec))
+
+
+def nnz(x):
+    return int((_dense(x) != 0).sum())
+
+
+def indices(x):
+    if isinstance(x, SparseCooTensor) and getattr(x, "bcoo", None) is not None:
+        return Tensor(x.bcoo.indices.T)
+    import numpy as np
+
+    nz = np.nonzero(np.asarray(_dense(x)))
+    return Tensor(jnp.asarray(np.stack(nz)))
+
+
+def values(x):
+    if isinstance(x, SparseCooTensor) and getattr(x, "bcoo", None) is not None:
+        return Tensor(x.bcoo.data)
+    v = _dense(x)
+    return Tensor(v[v != 0])
+
+
+from . import nn  # noqa: E402,F401

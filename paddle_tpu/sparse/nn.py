@@ -1,0 +1,98 @@
+"""paddle.sparse.nn (reference: python/paddle/sparse/nn/) — layers over the
+dense-materialized sparse tensors (XLA:TPU executes dense compute faster
+than emulated scatter sparsity; see package docstring) + the sparse
+attention functional."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn import functional as F
+
+
+def _dense(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return Tensor(jnp.maximum(_dense(x), 0))
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return Tensor(jnp.clip(_dense(x), 0, 6))
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        v = _dense(x)
+        return Tensor(jnp.where(v > 0, v, self.negative_slope * v))
+
+
+class Softmax(Layer):
+    """Softmax over the last dim, restricted to the nonzero pattern
+    (reference sparse softmax semantics: zeros stay zero)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        v = _dense(x)
+        mask = v != 0
+        z = jnp.where(mask, v, -jnp.inf)
+        e = jax.nn.softmax(z, axis=self.axis)
+        return Tensor(jnp.where(mask, e, 0.0))
+
+
+class BatchNorm(Layer):
+    """Channel-last batch norm over nonzero sites (reference sparse BN for
+    point-cloud [N, ..., C] layouts)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        super().__init__()
+        from ..nn.layers.norm import BatchNorm1D
+
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, data_format="NLC")
+
+    def forward(self, x):
+        v = _dense(x)
+        flat = Tensor(v.reshape(1, -1, v.shape[-1]))
+        out = self._bn(flat)
+        return Tensor(out._value.reshape(v.shape))
+
+
+class functional:  # namespace-style holder (paddle.sparse.nn.functional)
+    @staticmethod
+    def relu(x):
+        return Tensor(jnp.maximum(_dense(x), 0))
+
+    @staticmethod
+    def softmax(x, axis=-1):
+        return Softmax(axis)(x)
+
+    @staticmethod
+    def attention(query, key, value, sparse_mask, key_padding_mask=None,
+                  attn_mask=None, name=None):
+        """Sparse-pattern attention (reference
+        sparse.nn.functional.attention): scores outside ``sparse_mask``'s
+        nonzero pattern are dropped before softmax."""
+        q, k, v = _dense(query), _dense(key), _dense(value)
+        m = _dense(sparse_mask)
+        d = q.shape[-1]
+        scores = q @ jnp.swapaxes(k, -1, -2) / jnp.sqrt(jnp.asarray(d, q.dtype))
+        scores = jnp.where(m != 0, scores, -jnp.inf)
+        if attn_mask is not None:
+            scores = scores + _dense(attn_mask)
+        p = jax.nn.softmax(scores, axis=-1)
+        p = jnp.where(jnp.isfinite(p), p, 0.0)
+        return Tensor(p @ v)

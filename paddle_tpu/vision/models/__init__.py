@@ -22,6 +22,10 @@ from .densenet import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201, densenet264,
 )
 
+from .vision_transformer import (  # noqa: F401
+    VisionTransformer, vit_b_16, vit_b_32, vit_l_16, vit_s_16,
+)
+
 from .detection import (  # noqa: F401
     YOLOv3, FasterRCNN, ResNetBackbone, FPN, yolov3, ppyoloe, faster_rcnn,
 )

@@ -1,0 +1,160 @@
+"""Vision Transformer (reference analog: PaddleClas ppcls/arch/backbone/
+model_zoo/vision_transformer.py — ViT-B/16 family).
+
+TPU-first: the whole network is patch-embed einsum + transformer blocks —
+pure MXU matmuls at static [B, N+1, D] shapes; attention routes through
+``F.scaled_dot_product_attention`` (Pallas flash kernel on the chip for
+long sequences).  Pre-norm blocks, learned position embeddings, cls token.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ... import nn
+from ...nn import functional as F
+from ...tensor.dispatch import apply as _apply
+from ...tensor.tensor import Tensor
+
+__all__ = ["VisionTransformer", "vit_b_16", "vit_b_32", "vit_l_16",
+           "vit_s_16"]
+
+
+class PatchEmbed(nn.Layer):
+    """img [B,3,H,W] -> tokens [B, HW/P^2, D] via a stride-P conv (one MXU
+    matmul after im2col; XLA lowers it that way)."""
+
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = nn.Conv2D(in_chans, embed_dim, patch_size,
+                              stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)                                   # [B, D, H/P, W/P]
+        # shapes read INSIDE the traced fn so symbolic batch dims export
+        return _apply(
+            lambda v: jnp.transpose(
+                v.reshape(v.shape[0], v.shape[1], -1), (0, 2, 1)),
+            x, op_name="patch_flatten")                    # [B, N, D]
+
+
+class Mlp(nn.Layer):
+    def __init__(self, dim, hidden, drop=0.0):
+        super().__init__()
+        self.fc1 = nn.Linear(dim, hidden)
+        self.fc2 = nn.Linear(hidden, dim)
+        self.drop = nn.Dropout(drop)
+
+    def forward(self, x):
+        return self.drop(self.fc2(self.drop(F.gelu(self.fc1(x)))))
+
+
+class Block(nn.Layer):
+    """Pre-norm transformer block with fused sdpa attention."""
+
+    def __init__(self, dim, num_heads, mlp_ratio=4.0, drop=0.0,
+                 attn_drop=0.0, epsilon=1e-6):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim, epsilon=epsilon)
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.qkv = nn.Linear(dim, dim * 3)
+        self.proj = nn.Linear(dim, dim)
+        self.norm2 = nn.LayerNorm(dim, epsilon=epsilon)
+        self.mlp = Mlp(dim, int(dim * mlp_ratio), drop)
+        self.attn_drop = attn_drop
+        self.drop = nn.Dropout(drop)
+
+    def forward(self, x):
+        h = self.norm1(x)
+        qkv = self.qkv(h)
+
+        def split_heads(v):
+            q, k, val = jnp.split(v, 3, axis=-1)
+
+            def heads(t):
+                return t.reshape(t.shape[0], t.shape[1], self.num_heads,
+                                 self.head_dim)
+
+            return heads(q), heads(k), heads(val)
+
+        q, k, v = _apply(split_heads, qkv, op_name="qkv_split", n_outs=3)
+        att = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=self.attn_drop, training=self.training)
+        att = _apply(
+            lambda a: a.reshape(a.shape[0], a.shape[1], -1), att,
+            op_name="merge_heads")
+        x = x + self.drop(self.proj(att))
+        x = x + self.mlp(self.norm2(x))
+        return x
+
+
+class VisionTransformer(nn.Layer):
+    """reference ViT: patch embed + cls token + learned pos embed + L
+    pre-norm blocks + LN + linear head."""
+
+    def __init__(self, img_size=224, patch_size=16, in_chans=3,
+                 class_num=1000, embed_dim=768, depth=12, num_heads=12,
+                 mlp_ratio=4.0, drop_rate=0.0, attn_drop_rate=0.0,
+                 epsilon=1e-6, num_classes=None):
+        super().__init__()
+        if num_classes is not None:  # torchvision-style alias
+            class_num = num_classes
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans,
+                                      embed_dim)
+        n = self.patch_embed.num_patches
+        self.cls_token = self.create_parameter(
+            [1, 1, embed_dim],
+            default_initializer=nn.initializer.TruncatedNormal(std=0.02))
+        self.pos_embed = self.create_parameter(
+            [1, n + 1, embed_dim],
+            default_initializer=nn.initializer.TruncatedNormal(std=0.02))
+        self.pos_drop = nn.Dropout(drop_rate)
+        self.blocks = nn.LayerList([
+            Block(embed_dim, num_heads, mlp_ratio, drop_rate, attn_drop_rate,
+                  epsilon) for _ in range(depth)])
+        self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.head = (nn.Linear(embed_dim, class_num) if class_num > 0
+                     else nn.Identity())
+
+    def forward_features(self, x):
+        x = self.patch_embed(x)                            # [B, N, D]
+        B = x.shape[0]
+        cls = _apply(
+            lambda c, v: jnp.concatenate(
+                [jnp.broadcast_to(c, (v.shape[0], 1, c.shape[-1])), v], 1),
+            self.cls_token, x, op_name="prepend_cls")
+        x = cls + self.pos_embed
+        x = self.pos_drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        return x[:, 0]                                     # cls token
+
+    def forward(self, x):
+        return self.head(self.forward_features(x))
+
+
+def vit_s_16(**kw):
+    kw.setdefault("embed_dim", 384)
+    kw.setdefault("depth", 12)
+    kw.setdefault("num_heads", 6)
+    return VisionTransformer(patch_size=16, **kw)
+
+
+def vit_b_16(**kw):
+    return VisionTransformer(patch_size=16, **kw)
+
+
+def vit_b_32(**kw):
+    return VisionTransformer(patch_size=32, **kw)
+
+
+def vit_l_16(**kw):
+    kw.setdefault("embed_dim", 1024)
+    kw.setdefault("depth", 24)
+    kw.setdefault("num_heads", 16)
+    return VisionTransformer(patch_size=16, **kw)

@@ -279,3 +279,64 @@ class TestNamespaces:
         v = paddle.to_tensor(np.zeros((4,), "float32"))
         paddle.index_fill_(v, paddle.to_tensor(np.int64([1, 2])), 0, 9.0)
         np.testing.assert_allclose(v.numpy(), [0, 9, 9, 0])
+
+
+class TestSparse:
+    def test_coo_roundtrip_and_accessors(self):
+        import paddle_tpu.sparse as sp
+
+        i = paddle.to_tensor(np.array([[0, 1, 2], [1, 2, 0]], "int64"))
+        v = paddle.to_tensor(np.float32([1.0, 2.0, 3.0]))
+        s = sp.sparse_coo_tensor(i, v, shape=[3, 3])
+        dense = np.zeros((3, 3), "float32")
+        dense[[0, 1, 2], [1, 2, 0]] = [1, 2, 3]
+        np.testing.assert_allclose(sp.to_dense(s).numpy(), dense)
+        assert sp.nnz(s) == 3
+        np.testing.assert_allclose(np.sort(sp.values(s).numpy()), [1, 2, 3])
+        s2 = sp.to_sparse_coo(paddle.to_tensor(dense))
+        np.testing.assert_allclose(sp.to_dense(s2).numpy(), dense)
+
+    def test_csr_and_math(self):
+        import paddle_tpu.sparse as sp
+
+        crows = np.array([0, 1, 2, 3], "int64")
+        cols = np.array([1, 2, 0], "int64")
+        vals = paddle.to_tensor(np.float32([1.0, 2.0, 3.0]))
+        s = sp.sparse_csr_tensor(crows, cols, vals, [3, 3])
+        d = sp.matmul(s, s)
+        ref = sp.to_dense(s).numpy() @ sp.to_dense(s).numpy()
+        np.testing.assert_allclose(d.numpy(), ref, rtol=1e-6)
+        np.testing.assert_allclose(
+            sp.addmm(paddle.to_tensor(np.eye(3, dtype="float32")), s, s,
+                     beta=2.0).numpy(), 2 * np.eye(3) + ref, rtol=1e-6)
+        np.testing.assert_allclose(sp.tanh(s).numpy(),
+                                   np.tanh(sp.to_dense(s).numpy()), rtol=1e-6)
+
+    def test_sparse_nn_softmax_pattern(self):
+        import paddle_tpu.sparse as sp
+
+        x = paddle.to_tensor(np.float32([[1.0, 0.0, 2.0], [0.0, 0.0, 0.0]]))
+        out = sp.nn.functional.softmax(x).numpy()
+        # zeros stay zero; nonzeros softmax among themselves
+        assert out[0, 1] == 0 and abs(out[0, 0] + out[0, 2] - 1.0) < 1e-6
+        np.testing.assert_allclose(out[1], 0.0)
+
+    def test_sparse_attention(self):
+        import paddle_tpu.sparse as sp
+
+        rs = np.random.RandomState(0)
+        q = paddle.to_tensor(rs.randn(1, 4, 8).astype("float32"))
+        full = paddle.to_tensor(np.ones((1, 4, 4), "float32"))
+        att = sp.nn.functional.attention(q, q, q, full)
+        ref = paddle.nn.functional.scaled_dot_product_attention  # dense ref
+        # full mask == dense attention
+        import paddle_tpu.nn.functional as F
+        dq = q.numpy()
+        sc = dq @ dq.transpose(0, 2, 1) / np.sqrt(8)
+        e = np.exp(sc - sc.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(att.numpy(), p @ dq, rtol=1e-4, atol=1e-5)
+        # banded mask: masked positions get zero weight
+        band = np.tril(np.triu(np.ones((4, 4)), -1), 1).astype("float32")[None]
+        att_b = sp.nn.functional.attention(q, q, q, paddle.to_tensor(band))
+        assert np.isfinite(att_b.numpy()).all()

@@ -266,3 +266,43 @@ def test_translated_layer_fine_tunes():
     assert losses[-1] < losses[0] * 0.6, losses
     y1 = tl.eval()(x).numpy()
     assert np.abs(y1 - y0).max() > 1e-3  # weights actually moved
+
+
+def test_inference_predictor_api():
+    """paddle.inference Config/create_predictor over a jit.save artifact
+    (reference AnalysisPredictor flow: named handles, copy_from/to_cpu)."""
+    import tempfile
+
+    import paddle_tpu.inference as infer
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 8).astype("float32"))
+    ref = m(x).numpy()
+    with tempfile.TemporaryDirectory() as d:
+        prefix = d + "/model"
+        paddle.jit.save(m, prefix, input_spec=[
+            paddle.static.InputSpec([None, 8], "float32", name="feat")])
+
+        config = infer.Config(prefix)
+        config.enable_memory_optim()   # inert knob must not break
+        config.switch_ir_optim(True)
+        predictor = infer.create_predictor(config)
+
+        assert predictor.get_input_names() == ["feat"]
+        h = predictor.get_input_handle("feat")
+        h.copy_from_cpu(x.numpy())
+        predictor.run()
+        out = predictor.get_output_handle(predictor.get_output_names()[0])
+        np.testing.assert_allclose(out.copy_to_cpu(), ref, rtol=1e-5, atol=1e-6)
+
+        # functional spelling + different batch size (symbolic dim)
+        x2 = np.random.RandomState(1).randn(5, 8).astype("float32")
+        outs = predictor.run([x2])
+        assert outs[0].shape == (5, 4)
+        assert "StableHLO" in config.summary() or "XLA" in config.summary()
+        # unset input errors clearly
+        p2 = predictor.clone()
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError):
+            p2.run()

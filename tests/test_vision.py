@@ -130,3 +130,30 @@ def test_datasetfolder_npy(tmp_path):
     img, label = ds[0]
     assert img.shape == (8, 8, 3)
     assert label in (0, 1)
+
+
+def test_vision_transformer_trains():
+    """ViT (PaddleClas family): patch-embed + pre-norm blocks over the
+    fused sdpa path; trains through the fused step."""
+    from paddle_tpu.vision.models import vit_s_16
+
+    paddle.seed(0)
+    m = vit_s_16(img_size=32, class_num=10, depth=2)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 32, 32).astype("float32"))
+    out = m(x)
+    assert out.shape == [2, 10]
+    # 32/16 = 2x2 patches + cls = 5 tokens
+    assert m.pos_embed.shape == [1, 5, 384]
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+    o = popt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss())
+    y = paddle.to_tensor(
+        np.random.RandomState(1).randint(0, 10, (2,)).astype("int64"))
+    losses = [float(step(x, y)) for _ in range(3)]
+    assert losses[-1] < losses[0]
+    m.eval()
+    e1 = m(x).numpy()
+    e2 = m(x).numpy()
+    np.testing.assert_array_equal(e1, e2)  # dropout off in eval
