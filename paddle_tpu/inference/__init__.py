@@ -191,6 +191,9 @@ class Predictor:
 
     def get_output_handle(self, name):
         i = int(name.split("_")[-1])
+        if i >= len(self._outputs):  # pre-run fetch (reference API permits)
+            while len(self._outputs) <= i:
+                self._outputs.append(PredictorTensor(f"output_{len(self._outputs)}"))
         return self._outputs[i]
 
     def run(self, inputs=None):
@@ -198,6 +201,11 @@ class Predictor:
         from ..tensor.tensor import Tensor
 
         if inputs is not None:
+            if len(inputs) != len(self._inputs):
+                raise ValueError(
+                    f"run() got {len(inputs)} inputs for "
+                    f"{len(self._inputs)} input handles "
+                    f"({list(self._inputs)})")
             for h, a in zip(self._inputs.values(), inputs):
                 h.copy_from_cpu(np.asarray(a))
         args = []
@@ -208,11 +216,13 @@ class Predictor:
             args.append(Tensor(np.asarray(h._value)))
         out = self._layer(*args)
         outs = out if isinstance(out, (tuple, list)) else [out]
-        self._outputs = []
+        # update handles IN PLACE: a handle fetched before run() must see
+        # the results (reference API contract)
         for i, o in enumerate(outs):
-            t = PredictorTensor(f"output_{i}")
-            t.copy_from_cpu(np.asarray(o.numpy()))
-            self._outputs.append(t)
+            if i >= len(self._outputs):
+                self._outputs.append(PredictorTensor(f"output_{i}"))
+            self._outputs[i].copy_from_cpu(np.asarray(o.numpy()))
+        del self._outputs[len(outs):]
         if inputs is not None:
             return [t.copy_to_cpu() for t in self._outputs]
         return True

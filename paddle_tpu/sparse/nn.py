@@ -10,16 +10,12 @@ import jax.numpy as jnp
 
 from ..tensor.tensor import Tensor
 from ..nn.layer import Layer
-from ..nn import functional as F
-
-
-def _dense(x):
-    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+from . import _dense, relu as _sparse_relu  # one implementation, shared
 
 
 class ReLU(Layer):
     def forward(self, x):
-        return Tensor(jnp.maximum(_dense(x), 0))
+        return _sparse_relu(x)
 
 
 class ReLU6(Layer):
@@ -54,27 +50,40 @@ class Softmax(Layer):
 
 
 class BatchNorm(Layer):
-    """Channel-last batch norm over nonzero sites (reference sparse BN for
-    point-cloud [N, ..., C] layouts)."""
+    """Channel-last batch norm whose statistics run over ACTIVE sites only
+    (a site is active when any channel is nonzero) — reference sparse BN
+    semantics for point-cloud [N, ..., C] layouts; inactive sites stay 0."""
 
     def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
         super().__init__()
-        from ..nn.layers.norm import BatchNorm1D
-
-        self._bn = BatchNorm1D(num_features, momentum=momentum,
-                               epsilon=epsilon, data_format="NLC")
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = self.create_parameter([num_features])
+        self.bias = self.create_parameter([num_features], is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros([num_features])))
+        self.register_buffer("_variance", Tensor(jnp.ones([num_features])))
 
     def forward(self, x):
         v = _dense(x)
-        flat = Tensor(v.reshape(1, -1, v.shape[-1]))
-        out = self._bn(flat)
-        return Tensor(out._value.reshape(v.shape))
+        flat = v.reshape(-1, v.shape[-1])
+        active = jnp.any(flat != 0, axis=-1, keepdims=True)     # [M, 1]
+        n = jnp.maximum(active.sum(), 1.0)
+        if self.training:
+            mean = (flat * active).sum(0) / n
+            var = (((flat - mean) ** 2) * active).sum(0) / n
+            m = self.momentum
+            self._mean._value = m * self._mean._value + (1 - m) * mean
+            self._variance._value = m * self._variance._value + (1 - m) * var
+        else:
+            mean, var = self._mean._value, self._variance._value
+        out = (flat - mean) / jnp.sqrt(var + self.epsilon)
+        out = out * self.weight._value + self.bias._value
+        out = jnp.where(active, out, 0.0)
+        return Tensor(out.reshape(v.shape))
 
 
 class functional:  # namespace-style holder (paddle.sparse.nn.functional)
-    @staticmethod
-    def relu(x):
-        return Tensor(jnp.maximum(_dense(x), 0))
+    relu = staticmethod(_sparse_relu)
 
     @staticmethod
     def softmax(x, axis=-1):
@@ -91,6 +100,9 @@ class functional:  # namespace-style holder (paddle.sparse.nn.functional)
         d = q.shape[-1]
         scores = q @ jnp.swapaxes(k, -1, -2) / jnp.sqrt(jnp.asarray(d, q.dtype))
         scores = jnp.where(m != 0, scores, -jnp.inf)
+        if key_padding_mask is not None:
+            kp = _dense(key_padding_mask).astype(bool)          # [B, S_k]
+            scores = jnp.where(kp[:, None, :], scores, -jnp.inf)
         if attn_mask is not None:
             scores = scores + _dense(attn_mask)
         p = jax.nn.softmax(scores, axis=-1)

@@ -6,3 +6,6 @@ from .gpt import (  # noqa: F401
     GPTModel, GPTForCausalLM, GPTForCausalLMPipe, GPTDecoderLayer,
     stack_block_params, block_fn_for, pipeline_forward,
 )
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel,
+)

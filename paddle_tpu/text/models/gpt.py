@@ -40,20 +40,22 @@ def _mp_degree():
     return 1
 
 
-def _col_linear(d_in, d_out):
+def _col_linear(d_in, d_out, bias=True):
     if _mp_degree() > 1:
         from ...distributed.fleet.meta_parallel import ColumnParallelLinear
 
-        return ColumnParallelLinear(d_in, d_out, gather_output=False)
-    return Linear(d_in, d_out)
+        return ColumnParallelLinear(d_in, d_out, gather_output=False,
+                                    has_bias=bias)
+    return Linear(d_in, d_out, bias_attr=None if bias else False)
 
 
-def _row_linear(d_in, d_out):
+def _row_linear(d_in, d_out, bias=True):
     if _mp_degree() > 1:
         from ...distributed.fleet.meta_parallel import RowParallelLinear
 
-        return RowParallelLinear(d_in, d_out, input_is_parallel=True)
-    return Linear(d_in, d_out)
+        return RowParallelLinear(d_in, d_out, input_is_parallel=True,
+                                 has_bias=bias)
+    return Linear(d_in, d_out, bias_attr=None if bias else False)
 
 
 def _vocab_embedding(vocab, hidden):

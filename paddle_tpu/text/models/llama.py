@@ -1,0 +1,251 @@
+"""Llama family (reference analog: PaddleNLP paddlenlp/transformers/llama —
+the modern decoder architecture: RMSNorm pre-norm, rotary position
+embeddings, grouped-query attention, SwiGLU MLP, no biases).
+
+TPU-first notes:
+- RoPE uses the HF half-split rotate convention so weights interchange
+  with the torch/transformers reference bit-for-bit (cross-validated in
+  tests/test_text.py).
+- GQA K/V heads are repeated to the query head count BEFORE sdpa, so the
+  Pallas flash kernel serves the attention (the repeat is a broadcast XLA
+  folds into the kernel's K/V loads).
+- Projections route through the same column/row-parallel helpers as GPT:
+  under a live 'mp' mesh axis the weights shard and the partitioner
+  inserts the Megatron collectives.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn.layer import Layer
+from ...nn.layers.common import Dropout, Linear
+from ...nn.layers.norm import RMSNorm
+from ...tensor.dispatch import apply as _apply
+from ...tensor.tensor import Tensor
+from .gpt import _col_linear, _row_linear, _vocab_embedding
+
+__all__ = ["LlamaModel", "LlamaForCausalLM", "LlamaConfig"]
+
+
+class LlamaConfig(dict):
+    """Config bag (attribute + dict access, PaddleNLP-style)."""
+
+    def __init__(self, **kw):
+        defaults = dict(vocab_size=32000, hidden_size=4096,
+                        intermediate_size=11008, num_hidden_layers=32,
+                        num_attention_heads=32, num_key_value_heads=None,
+                        max_position_embeddings=4096, rms_norm_eps=1e-6,
+                        rope_theta=10000.0, tie_word_embeddings=False)
+        defaults.update(kw)
+        if defaults["num_key_value_heads"] is None:
+            defaults["num_key_value_heads"] = defaults["num_attention_heads"]
+        super().__init__(**defaults)
+        self.__dict__ = self
+
+
+def _rope_cos_sin(positions, head_dim, theta):
+    """[S] or [B, S] int positions -> cos/sin [..., S, head_dim] in the HF
+    half-split layout (freqs duplicated across the two halves)."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
+    ang = positions.astype(jnp.float32)[..., None] * inv        # [..., S, d/2]
+    ang = jnp.concatenate([ang, ang], axis=-1)                  # [..., S, d]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def _apply_rope(q, k, cos, sin):
+    """q/k [B, S, h, d]; cos/sin [S, d] or [B, S, d] broadcast over heads."""
+    if cos.ndim == 2:
+        cos, sin = cos[None], sin[None]
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    return q * c + _rotate_half(q) * s, k * c + _rotate_half(k) * s
+
+
+class LlamaMLP(Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x)) — two column-parallel inputs,
+    one row-parallel output (Megatron layout)."""
+
+    def __init__(self, hidden_size, intermediate_size):
+        super().__init__()
+        # llama uses no biases (bias=False reaches the TP classes too)
+        self.gate_proj = _col_linear(hidden_size, intermediate_size, bias=False)
+        self.up_proj = _col_linear(hidden_size, intermediate_size, bias=False)
+        self.down_proj = _row_linear(intermediate_size, hidden_size, bias=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = h // self.num_heads
+        self.rope_theta = config.rope_theta
+        self.q_proj = _col_linear(h, self.num_heads * self.head_dim,
+                                  bias=False)
+        self.k_proj = _col_linear(h, self.num_kv_heads * self.head_dim,
+                                  bias=False)
+        self.v_proj = _col_linear(h, self.num_kv_heads * self.head_dim,
+                                  bias=False)
+        self.o_proj = _row_linear(self.num_heads * self.head_dim, h,
+                                  bias=False)
+
+    def forward(self, x, position_ids, attention_mask=None):
+        B, S = x.shape[0], x.shape[1]
+        hd = self.head_dim
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+        # local head counts from actual widths (TP shards carry h/mp heads)
+        hq = q.shape[-1] // hd
+        hkv = k.shape[-1] // hd
+        rep = hq // hkv
+
+        def attend(qv, kv, vv, pos):
+            qh = qv.reshape(B, S, hq, hd)
+            kh = kv.reshape(B, S, hkv, hd)
+            vh = vv.reshape(B, S, hkv, hd)
+            cos, sin = _rope_cos_sin(pos, hd, self.rope_theta)
+            qh, kh = _apply_rope(qh, kh, cos, sin)
+            if rep > 1:  # GQA: broadcast kv heads up to the q head count
+                kh = jnp.repeat(kh, rep, axis=2)
+                vh = jnp.repeat(vh, rep, axis=2)
+            return qh, kh, vh
+
+        qh, kh, vh = _apply(attend, q, k, v, position_ids,
+                            op_name="llama_rope", n_outs=3)
+        if attention_mask is not None:
+            # [B, S] padding mask -> additive causal+pad bias [B, 1, S, S]
+            def build_bias(am):
+                pad = jnp.where(am.astype(jnp.bool_), 0.0, -1e30)[:, None,
+                                                                  None, :]
+                i = jnp.arange(S)[:, None]
+                j = jnp.arange(S)[None, :]
+                causal = jnp.where(j <= i, 0.0, -1e30)[None, None]
+                return (pad + causal).astype(jnp.float32)
+
+            bias = _apply(build_bias, attention_mask, op_name="llama_mask")
+            att = F.scaled_dot_product_attention(qh, kh, vh, attn_mask=bias,
+                                                 training=self.training)
+        else:
+            att = F.scaled_dot_product_attention(qh, kh, vh, is_causal=True,
+                                                 training=self.training)
+        att = att.reshape([B, S, hq * hd])
+        return self.o_proj(att)
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config.hidden_size, config.intermediate_size)
+
+    def forward(self, x, position_ids, attention_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), position_ids,
+                               attention_mask)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config=None, **kw):
+        super().__init__()
+        self.config = config if isinstance(config, LlamaConfig) \
+            else LlamaConfig(**(config or {}), **kw)
+        cfg = self.config
+        self.embed_tokens = _vocab_embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = [LlamaDecoderLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+        for i, l in enumerate(self.layers):
+            self.add_sublayer(f"layers.{i}", l)
+        self.norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None):
+        x = self.embed_tokens(input_ids)
+        if position_ids is None:
+            S = x.shape[1]
+            position_ids = Tensor(jnp.arange(S, dtype=jnp.int32))
+        for layer in self.layers:
+            x = layer(x, position_ids, attention_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config=None, **kw):
+        super().__init__()
+        self.llama = LlamaModel(config, **kw)
+        cfg = self.llama.config
+        self.tie = cfg.tie_word_embeddings
+        if not self.tie:
+            self.lm_head = _col_linear(cfg.hidden_size, cfg.vocab_size,
+                                       bias=False)
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None,
+                labels=None):
+        hidden = self.llama(input_ids, position_ids, attention_mask)
+        if self.tie:
+            w = self.llama.embed_tokens.weight  # [vocab, hidden]
+            logits = _apply(lambda h, wv: h @ wv.T, hidden, w,
+                            op_name="matmul")
+        else:
+            logits = self.lm_head(hidden)
+        if labels is not None:
+            return F.cross_entropy(
+                logits[:, :-1].reshape([-1, logits.shape[-1]]),
+                labels[:, 1:].reshape([-1]), reduction="mean")
+        return logits
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=0, top_p=1.0, seed=None):
+        """Greedy/sampled decode (eager full-prefix loop; the jitted
+        KV-cache path lives on GPTForCausalLM and applies the same way)."""
+        import numpy as np
+
+        ids = np.asarray(input_ids.numpy()).astype("int64")
+        rs = np.random.RandomState(seed if seed is not None else 0)
+        was = [(m, m.training) for m in self.sublayers(include_self=True)]
+        self.eval()
+        try:
+            for _ in range(max_new_tokens):
+                logits = self(Tensor(jnp.asarray(ids))).numpy()[:, -1]
+                if temperature == 0.0:
+                    nxt = logits.argmax(-1)
+                else:
+                    logits = logits / max(temperature, 1e-6)
+                    if top_k:
+                        kth = np.sort(logits, -1)[:, -top_k][:, None]
+                        logits = np.where(logits < kth, -np.inf, logits)
+                    p = np.exp(logits - logits.max(-1, keepdims=True))
+                    p = p / p.sum(-1, keepdims=True)
+                    if top_p < 1.0:  # nucleus: keep the smallest top set
+                        srt = np.argsort(-p, axis=-1)
+                        ps = np.take_along_axis(p, srt, -1)
+                        keep = np.cumsum(ps, -1) - ps < top_p
+                        ps = np.where(keep, ps, 0.0)
+                        ps = ps / ps.sum(-1, keepdims=True)
+                        pick = np.stack([rs.choice(ps.shape[-1], p=ps[b])
+                                         for b in range(ps.shape[0])])
+                        nxt = np.take_along_axis(srt, pick[:, None], -1)[:, 0]
+                    else:
+                        nxt = np.stack([rs.choice(p.shape[-1], p=p[b])
+                                        for b in range(p.shape[0])])
+                ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        finally:
+            for m, t in was:
+                m.training = t
+        return Tensor(jnp.asarray(ids))
