@@ -1,0 +1,96 @@
+"""Llama fine-tuning: the modern decoder recipe end-to-end — fused train
+step with gradient accumulation, fp16/bf16 autocast with the traced
+GradScaler, padding-masked batches, EMA evaluation weights, and greedy /
+top-p generation at the end.
+
+Synthetic corpus by default (next-token objective over random sequences);
+tiny config so it runs anywhere, scale the flags up on real hardware.
+
+    python examples/finetune_llama.py [--steps 30] [--accum 2]
+                                      [--hidden 256] [--layers 4]
+                                      [--amp-dtype bfloat16|float16]
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate.optimizer import ExponentialMovingAverage
+from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--accum", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=2048)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--amp-dtype", default="bfloat16",
+                    choices=["bfloat16", "float16"])
+    args = ap.parse_args()
+
+    paddle.seed(0)
+    cfg = LlamaConfig(
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        intermediate_size=int(args.hidden * 8 / 3) // 16 * 16,
+        num_hidden_layers=args.layers, num_attention_heads=args.heads,
+        num_key_value_heads=args.kv_heads,
+        max_position_embeddings=4 * args.seq_len, tie_word_embeddings=True)
+    model = LlamaForCausalLM(cfg)
+    n_params = sum(p._value.size for p in model.parameters())
+    print(f"llama: {n_params / 1e6:.1f}M params "
+          f"(GQA {args.heads}q/{args.kv_heads}kv, SwiGLU, tied head)")
+
+    sched = opt.lr.CosineAnnealingDecay(learning_rate=args.lr,
+                                        T_max=args.steps)
+    optimizer = opt.AdamW(learning_rate=sched, parameters=model.parameters(),
+                          weight_decay=0.01,
+                          grad_clip=opt.ClipGradByGlobalNorm(1.0))
+    scaler = (paddle.amp.GradScaler(init_loss_scaling=2.0 ** 15)
+              if args.amp_dtype == "float16" else None)
+    step = paddle.jit.TrainStep(model, optimizer, amp_level="O2",
+                                amp_dtype=args.amp_dtype,
+                                accumulate_steps=args.accum, scaler=scaler)
+    ema = ExponentialMovingAverage(model, decay=0.99)
+
+    rs = np.random.RandomState(0)
+    t0 = time.time()
+    for i in range(args.steps):
+        ids = paddle.to_tensor(
+            rs.randint(1, args.vocab,
+                       (args.batch_size, args.seq_len)).astype("int64"))
+        loss = step({"input_ids": ids, "labels": ids})
+        ema.update()
+        sched.step()
+        if i % 5 == 0 or i == args.steps - 1:
+            extra = (f" scale={step.loss_scale:.0f}" if scaler else "")
+            print(f"step {i:4d}  loss {float(loss):.4f}  "
+                  f"lr {sched.get_lr():.2e}{extra}")
+    dt = time.time() - t0
+    tok = args.steps * args.batch_size * args.seq_len / dt
+    print(f"{dt:.1f}s total, {tok:,.0f} tokens/s")
+
+    # evaluate with EMA weights, then generate
+    with ema.apply():
+        model.eval()
+        prompt = paddle.to_tensor(
+            rs.randint(1, args.vocab, (1, 8)).astype("int64"))
+        greedy = model.generate(prompt, max_new_tokens=16, temperature=0.0)
+        sampled = model.generate(prompt, max_new_tokens=16, temperature=0.8,
+                                 top_p=0.9, seed=1)
+    print("greedy :", greedy.numpy()[0, -16:].tolist())
+    print("sampled:", sampled.numpy()[0, -16:].tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -187,7 +187,8 @@ class Predictor:
         return self._inputs[name]
 
     def get_output_names(self):
-        return [f"output_{i}" for i in range(max(len(self._outputs), 1))]
+        n = getattr(self, "_n_outs", 0) or len(self._outputs) or 1
+        return [f"output_{i}" for i in range(n)]
 
     def get_output_handle(self, name):
         i = int(name.split("_")[-1])
@@ -222,9 +223,9 @@ class Predictor:
             if i >= len(self._outputs):
                 self._outputs.append(PredictorTensor(f"output_{i}"))
             self._outputs[i].copy_from_cpu(np.asarray(o.numpy()))
-        del self._outputs[len(outs):]
+        self._n_outs = len(outs)  # pre-created extra handles stay alive
         if inputs is not None:
-            return [t.copy_to_cpu() for t in self._outputs]
+            return [t.copy_to_cpu() for t in self._outputs[:self._n_outs]]
         return True
 
     def clone(self):

@@ -26,7 +26,12 @@ _CACHE = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu")
 
 def _build():
     os.makedirs(_CACHE, exist_ok=True)
-    so = os.path.join(_CACHE, "paddle_tpu_native.so")
+    # PADDLE_TPU_NATIVE_TSAN=1 builds a ThreadSanitizer variant (SURVEY §5.2
+    # race detection; run the process with LD_PRELOAD=libtsan.so)
+    tsan = os.environ.get("PADDLE_TPU_NATIVE_TSAN") == "1"
+    so = os.path.join(_CACHE,
+                      "paddle_tpu_native_tsan.so" if tsan
+                      else "paddle_tpu_native.so")
     if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(_SRC):
         return so
     # pid-suffixed temp: concurrent first-use compiles (multi-process launch)
@@ -34,6 +39,9 @@ def _build():
     tmp = f"{so}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
            _SRC, "-o", tmp]
+    if tsan:
+        cmd.insert(1, "-fsanitize=thread")
+        cmd.insert(1, "-g")
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, so)
     return so

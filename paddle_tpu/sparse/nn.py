@@ -64,22 +64,39 @@ class BatchNorm(Layer):
         self.register_buffer("_variance", Tensor(jnp.ones([num_features])))
 
     def forward(self, x):
-        v = _dense(x)
-        flat = v.reshape(-1, v.shape[-1])
-        active = jnp.any(flat != 0, axis=-1, keepdims=True)     # [M, 1]
-        n = jnp.maximum(active.sum(), 1.0)
-        if self.training:
+        from ..tensor.dispatch import apply as _dispatch
+
+        training = self.training
+        eps = self.epsilon
+
+        def fn(v, w, b, run_mean, run_var):
+            flat = v.reshape(-1, v.shape[-1])
+            active = jnp.any(flat != 0, axis=-1, keepdims=True)  # [M, 1]
+            n = jnp.maximum(active.sum(), 1.0)
+            if training:
+                mean = (flat * active).sum(0) / n
+                var = (((flat - mean) ** 2) * active).sum(0) / n
+            else:
+                mean, var = run_mean, run_var
+            out = (flat - mean) / jnp.sqrt(var + eps)
+            out = out * w + b
+            out = jnp.where(active, out, 0.0)
+            return out.reshape(v.shape)
+
+        out = _dispatch(fn, x if isinstance(x, Tensor) else Tensor(_dense(x)),
+                        self.weight, self.bias, self._mean, self._variance,
+                        op_name="sparse_batch_norm")
+        if training:  # running stats tracked outside the grad path
+            v = _dense(x)
+            flat = v.reshape(-1, v.shape[-1])
+            active = jnp.any(flat != 0, axis=-1, keepdims=True)
+            n = jnp.maximum(active.sum(), 1.0)
             mean = (flat * active).sum(0) / n
             var = (((flat - mean) ** 2) * active).sum(0) / n
             m = self.momentum
             self._mean._value = m * self._mean._value + (1 - m) * mean
             self._variance._value = m * self._variance._value + (1 - m) * var
-        else:
-            mean, var = self._mean._value, self._variance._value
-        out = (flat - mean) / jnp.sqrt(var + self.epsilon)
-        out = out * self.weight._value + self.bias._value
-        out = jnp.where(active, out, 0.0)
-        return Tensor(out.reshape(v.shape))
+        return out
 
 
 class functional:  # namespace-style holder (paddle.sparse.nn.functional)

@@ -21,7 +21,6 @@ import jax.numpy as jnp
 
 from ...nn import functional as F
 from ...nn.layer import Layer
-from ...nn.layers.common import Dropout, Linear
 from ...nn.layers.norm import RMSNorm
 from ...tensor.dispatch import apply as _apply
 from ...tensor.tensor import Tensor
@@ -101,7 +100,7 @@ class LlamaAttention(Layer):
         self.o_proj = _row_linear(self.num_heads * self.head_dim, h,
                                   bias=False)
 
-    def forward(self, x, position_ids, attention_mask=None):
+    def forward(self, x, rope, attn_bias=None):
         B, S = x.shape[0], x.shape[1]
         hd = self.head_dim
         q = self.q_proj(x)
@@ -112,31 +111,21 @@ class LlamaAttention(Layer):
         hkv = k.shape[-1] // hd
         rep = hq // hkv
 
-        def attend(qv, kv, vv, pos):
+        def attend(qv, kv, vv, cos, sin):
             qh = qv.reshape(B, S, hq, hd)
             kh = kv.reshape(B, S, hkv, hd)
             vh = vv.reshape(B, S, hkv, hd)
-            cos, sin = _rope_cos_sin(pos, hd, self.rope_theta)
             qh, kh = _apply_rope(qh, kh, cos, sin)
             if rep > 1:  # GQA: broadcast kv heads up to the q head count
                 kh = jnp.repeat(kh, rep, axis=2)
                 vh = jnp.repeat(vh, rep, axis=2)
             return qh, kh, vh
 
-        qh, kh, vh = _apply(attend, q, k, v, position_ids,
+        qh, kh, vh = _apply(attend, q, k, v, rope[0], rope[1],
                             op_name="llama_rope", n_outs=3)
-        if attention_mask is not None:
-            # [B, S] padding mask -> additive causal+pad bias [B, 1, S, S]
-            def build_bias(am):
-                pad = jnp.where(am.astype(jnp.bool_), 0.0, -1e30)[:, None,
-                                                                  None, :]
-                i = jnp.arange(S)[:, None]
-                j = jnp.arange(S)[None, :]
-                causal = jnp.where(j <= i, 0.0, -1e30)[None, None]
-                return (pad + causal).astype(jnp.float32)
-
-            bias = _apply(build_bias, attention_mask, op_name="llama_mask")
-            att = F.scaled_dot_product_attention(qh, kh, vh, attn_mask=bias,
+        if attn_bias is not None:
+            att = F.scaled_dot_product_attention(qh, kh, vh,
+                                                 attn_mask=attn_bias,
                                                  training=self.training)
         else:
             att = F.scaled_dot_product_attention(qh, kh, vh, is_causal=True,
@@ -155,9 +144,8 @@ class LlamaDecoderLayer(Layer):
                                                 epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config.hidden_size, config.intermediate_size)
 
-    def forward(self, x, position_ids, attention_mask=None):
-        x = x + self.self_attn(self.input_layernorm(x), position_ids,
-                               attention_mask)
+    def forward(self, x, rope, attn_bias=None):
+        x = x + self.self_attn(self.input_layernorm(x), rope, attn_bias)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
@@ -174,14 +162,48 @@ class LlamaModel(Layer):
         for i, l in enumerate(self.layers):
             self.add_sublayer(f"layers.{i}", l)
         self.norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+        # reference init (HF _init_weights): every weight matrix N(0, 0.02)
+        # — the Embedding default N(0,1) would start CE ~8x above ln(V)
+        import jax.random as _jr
+
+        from ...framework import random as _rng
+
+        key = _rng.next_key()
+        for _, p in self.named_parameters():
+            if p._value.ndim >= 2:
+                key, sub = _jr.split(key)
+                new = (0.02 * _jr.normal(sub, p._value.shape, jnp.float32)
+                       ).astype(p._value.dtype)
+                sh = p._value.sharding
+                if hasattr(sh, "spec"):  # keep the TP layout
+                    new = jax.device_put(new, sh)
+                p._value = new
 
     def forward(self, input_ids, position_ids=None, attention_mask=None):
         x = self.embed_tokens(input_ids)
+        S = x.shape[1]
         if position_ids is None:
-            S = x.shape[1]
             position_ids = Tensor(jnp.arange(S, dtype=jnp.int32))
+        hd = self.config.hidden_size // self.config.num_attention_heads
+        theta = self.config.rope_theta
+        # rope tables + padding bias built ONCE and shared by all layers
+        cos, sin = _apply(
+            lambda pos: _rope_cos_sin(pos, hd, theta), position_ids,
+            op_name="rope_tables", n_outs=2)
+        bias = None
+        if attention_mask is not None:
+            def build_bias(am):
+                # [B, S] padding mask -> additive causal+pad [B, 1, S, S]
+                pad = jnp.where(am.astype(jnp.bool_), 0.0, -1e30)[:, None,
+                                                                  None, :]
+                i = jnp.arange(S)[:, None]
+                j = jnp.arange(S)[None, :]
+                causal = jnp.where(j <= i, 0.0, -1e30)[None, None]
+                return (pad + causal).astype(jnp.float32)
+
+            bias = _apply(build_bias, attention_mask, op_name="llama_mask")
         for layer in self.layers:
-            x = layer(x, position_ids, attention_mask)
+            x = layer(x, (cos, sin), bias)
         return self.norm(x)
 
 
@@ -194,6 +216,18 @@ class LlamaForCausalLM(Layer):
         if not self.tie:
             self.lm_head = _col_linear(cfg.hidden_size, cfg.vocab_size,
                                        bias=False)
+            # same N(0, 0.02) reference init as the body weights
+            import jax.random as _jr
+
+            from ...framework import random as _rng
+
+            w = self.lm_head.weight
+            new = (0.02 * _jr.normal(_rng.next_key(), w._value.shape,
+                                     jnp.float32)).astype(w._value.dtype)
+            sh = w._value.sharding
+            if hasattr(sh, "spec"):
+                new = jax.device_put(new, sh)
+            w._value = new
 
     def forward(self, input_ids, position_ids=None, attention_mask=None,
                 labels=None):
@@ -228,6 +262,7 @@ class LlamaForCausalLM(Layer):
                 else:
                     logits = logits / max(temperature, 1e-6)
                     if top_k:
+                        top_k = min(int(top_k), logits.shape[-1])
                         kth = np.sort(logits, -1)[:, -top_k][:, None]
                         logits = np.where(logits < kth, -np.inf, logits)
                     p = np.exp(logits - logits.max(-1, keepdims=True))

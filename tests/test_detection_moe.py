@@ -1,6 +1,7 @@
 """Detection zoo (YOLO/FasterRCNN, static shapes), MoE, SEP utils, padded
 NMS, native C++ pipeline kernels."""
 
+import os
 import numpy as np
 import pytest
 
@@ -360,3 +361,86 @@ def test_rcnn_class_specific_regression_shapes():
     dets = m(img)
     assert dets[0]["boxes"].shape == [16, 4]
     assert int(dets[0]["labels"].numpy().max()) < 3
+
+
+def test_native_pipeline_thread_safety_and_determinism():
+    """SURVEY §5.2 race/determinism check for the native C++ kernels:
+    hammer normalize/crop/collate from many Python threads concurrently and
+    at several internal thread counts — results must be bit-identical to
+    the single-threaded reference on every call."""
+    import concurrent.futures as cf
+
+    from paddle_tpu.io import native
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (8, 24, 24, 3), dtype=np.uint8)
+    mean = np.array([123.7, 116.3, 103.5], np.float32)
+    std = np.array([58.4, 57.1, 57.4], np.float32)
+    flips = (rng.rand(8) > 0.5).astype(np.uint8)
+    ref_norm = native.normalize_chw(imgs, mean, std, flips, num_threads=1)
+    ys = rng.randint(0, 8, 8).astype(np.int32)
+    xs = rng.randint(0, 8, 8).astype(np.int32)
+    ref_crop = native.crop_batch(imgs, ys, xs, 16, 16, num_threads=1)
+    samples = [rng.randn(5, 7).astype(np.float32) for _ in range(16)]
+    ref_coll = native.collate_f32(samples, num_threads=1)
+
+    def hammer(i):
+        nt = (i % 4)  # 0 = library default, 1..3 explicit
+        a = native.normalize_chw(imgs, mean, std, flips, num_threads=nt)
+        b = native.crop_batch(imgs, ys, xs, 16, 16, num_threads=nt)
+        c = native.collate_f32(samples, num_threads=nt)
+        np.testing.assert_array_equal(a, ref_norm)
+        np.testing.assert_array_equal(b, ref_crop)
+        np.testing.assert_array_equal(c, ref_coll)
+        return True
+
+    with cf.ThreadPoolExecutor(max_workers=8) as ex:
+        assert all(ex.map(hammer, range(64)))
+
+
+def test_native_pipeline_under_tsan():
+    """Run the native kernels in a subprocess built with -fsanitize=thread
+    and LD_PRELOAD'd libtsan — any data race aborts the worker (SURVEY §5.2:
+    the reference gates its threaded runtime on TSAN CI)."""
+    import glob
+    import subprocess
+    import sys
+
+    libtsan = sorted(glob.glob("/usr/lib/gcc/x86_64-linux-gnu/*/libtsan.so"))
+    if not libtsan:
+        pytest.skip("libtsan not available")
+    worker = r"""
+import importlib.util
+import os
+import numpy as np
+# load native.py standalone: the full package would initialize jax, which
+# is not TSAN-instrumented; the native module is dependency-free
+spec = importlib.util.spec_from_file_location(
+    "pt_native", os.environ["PT_NATIVE_PATH"])
+native = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(native)
+assert native.available(), "native lib failed to build under TSAN"
+rng = np.random.RandomState(0)
+imgs = rng.randint(0, 256, (8, 24, 24, 3), dtype=np.uint8)
+mean = np.array([123.7, 116.3, 103.5], np.float32)
+std = np.array([58.4, 57.1, 57.4], np.float32)
+for nt in (0, 2, 4):
+    native.normalize_chw(imgs, mean, std, None, num_threads=nt)
+    native.crop_batch(imgs, np.zeros(8, np.int32), np.zeros(8, np.int32),
+                      16, 16, num_threads=nt)
+    native.collate_f32([rng.randn(5, 7).astype(np.float32)
+                        for _ in range(16)], num_threads=nt)
+print("TSAN_CLEAN")
+"""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # plain-CPU worker, no jax needed
+    env["PADDLE_TPU_NATIVE_TSAN"] = "1"
+    env["LD_PRELOAD"] = libtsan[0]
+    env["TSAN_OPTIONS"] = "exitcode=66"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
+    env["PT_NATIVE_PATH"] = os.path.join(repo, "paddle_tpu", "io", "native.py")
+    r = subprocess.run([sys.executable, "-c", worker], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0 and "TSAN_CLEAN" in r.stdout, \
+        f"rc={r.returncode}\n{r.stdout}\n{r.stderr[-3000:]}"
