@@ -44,11 +44,31 @@ class Model:
                 if not isinstance(m, Metric):
                     raise TypeError(f"metric {m!r} is not a paddle.metric.Metric")
             self._metrics = list(metrics)
+        # fresh AMP state each prepare(): re-preparing must fully replace
+        # any earlier fp16/scaler configuration
+        self._amp_dtype = "bfloat16"
+        self._scaler = None
         if amp_configs:
             if isinstance(amp_configs, str):
                 self._amp_level = amp_configs
             else:
                 self._amp_level = amp_configs.get("level", "O1")
+                self._amp_dtype = amp_configs.get("dtype", "bfloat16")
+                # fp16 needs loss scaling: build the traced scaler from the
+                # reference-named knobs (init_loss_scaling etc.)
+                if self._amp_dtype == "float16" or any(
+                        k in amp_configs for k in ("init_loss_scaling",
+                                                   "incr_every_n_steps",
+                                                   "use_dynamic_loss_scaling")):
+                    from ..amp import GradScaler
+
+                    self._scaler = GradScaler(
+                        init_loss_scaling=amp_configs.get(
+                            "init_loss_scaling", 2.0 ** 15),
+                        incr_every_n_steps=amp_configs.get(
+                            "incr_every_n_steps", 1000),
+                        use_dynamic_loss_scaling=amp_configs.get(
+                            "use_dynamic_loss_scaling", True))
         self._train_step = None
         return self
 
@@ -65,7 +85,10 @@ class Model:
                 raise RuntimeError("call prepare(optimizer=..., loss=...) before fit()")
             self._train_step = TrainStep(
                 self.network, self._optimizer, loss_fn=self._loss,
-                amp_level=self._amp_level, return_outputs=bool(self._metrics),
+                amp_level=self._amp_level,
+                amp_dtype=self._amp_dtype,
+                scaler=self._scaler,
+                return_outputs=bool(self._metrics),
                 accumulate_steps=accumulate or 1)
         return self._train_step
 

@@ -72,8 +72,10 @@ class BatchNorm(Layer):
         def fn(v, w, b, run_mean, run_var):
             flat = v.reshape(-1, v.shape[-1])
             active = jnp.any(flat != 0, axis=-1, keepdims=True)  # [M, 1]
-            n = jnp.maximum(active.sum(), 1.0)
             if training:
+                # batch stats INSIDE the dispatched fn: gradients flow
+                # through mean/var like real BN
+                n = jnp.maximum(active.sum(), 1.0)
                 mean = (flat * active).sum(0) / n
                 var = (((flat - mean) ** 2) * active).sum(0) / n
             else:
@@ -81,22 +83,20 @@ class BatchNorm(Layer):
             out = (flat - mean) / jnp.sqrt(var + eps)
             out = out * w + b
             out = jnp.where(active, out, 0.0)
+            if training:
+                return out.reshape(v.shape), mean, var
             return out.reshape(v.shape)
 
-        out = _dispatch(fn, x if isinstance(x, Tensor) else Tensor(_dense(x)),
-                        self.weight, self.bias, self._mean, self._variance,
-                        op_name="sparse_batch_norm")
-        if training:  # running stats tracked outside the grad path
-            v = _dense(x)
-            flat = v.reshape(-1, v.shape[-1])
-            active = jnp.any(flat != 0, axis=-1, keepdims=True)
-            n = jnp.maximum(active.sum(), 1.0)
-            mean = (flat * active).sum(0) / n
-            var = (((flat - mean) ** 2) * active).sum(0) / n
+        args = (x if isinstance(x, Tensor) else Tensor(_dense(x)),
+                self.weight, self.bias, self._mean, self._variance)
+        if training:
+            out, mean, var = _dispatch(*((fn,) + args),
+                                       op_name="sparse_batch_norm", n_outs=3)
             m = self.momentum
-            self._mean._value = m * self._mean._value + (1 - m) * mean
-            self._variance._value = m * self._variance._value + (1 - m) * var
-        return out
+            self._mean._value = m * self._mean._value                 + (1 - m) * mean._value
+            self._variance._value = m * self._variance._value                 + (1 - m) * var._value
+            return out
+        return _dispatch(*((fn,) + args), op_name="sparse_batch_norm")
 
 
 class functional:  # namespace-style holder (paddle.sparse.nn.functional)

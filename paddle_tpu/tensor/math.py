@@ -534,3 +534,40 @@ def multiply_(x, y):
 
 def divide_(x, y):
     return x._inplace_binop(jnp.divide, y, "divide_")
+
+
+def asarray(data, dtype=None, place=None):
+    """numpy-style alias for paddle.to_tensor."""
+    from .creation import to_tensor
+
+    return to_tensor(data, dtype=dtype, place=place)
+
+
+class _FInfo:
+    def __init__(self, dtype):
+        i = jnp.finfo(jnp.dtype(dtype))  # ml_dtypes-aware (bfloat16 etc.)
+        self.dtype = str(i.dtype)
+        self.bits = i.bits
+        self.eps = float(i.eps)
+        self.min = float(i.min)
+        self.max = float(i.max)
+        self.tiny = float(i.tiny)
+        self.smallest_normal = float(i.tiny)
+        self.resolution = float(i.resolution)
+
+
+class _IInfo:
+    def __init__(self, dtype):
+        i = jnp.iinfo(jnp.dtype(dtype))
+        self.dtype = str(i.dtype)
+        self.bits = i.bits
+        self.min = int(i.min)
+        self.max = int(i.max)
+
+
+def finfo(dtype):
+    return _FInfo(dtype)
+
+
+def iinfo(dtype):
+    return _IInfo(dtype)

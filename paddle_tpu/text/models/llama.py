@@ -68,6 +68,25 @@ def _apply_rope(q, k, cos, sin):
     return q * c + _rotate_half(q) * s, k * c + _rotate_half(k) * s
 
 
+def _reference_init(layer):
+    """HF _init_weights: every >=2D weight N(0, 0.02), preserving any TP
+    sharding already laid on the parameter."""
+    import jax.random as _jr
+
+    from ...framework import random as _rng
+
+    key = _rng.next_key()
+    for _, p in layer.named_parameters():
+        if p._value.ndim >= 2:
+            key, sub = _jr.split(key)
+            new = (0.02 * _jr.normal(sub, p._value.shape, jnp.float32)
+                   ).astype(p._value.dtype)
+            sh = p._value.sharding
+            if hasattr(sh, "spec"):
+                new = jax.device_put(new, sh)
+            p._value = new
+
+
 class LlamaMLP(Layer):
     """SwiGLU: down(silu(gate(x)) * up(x)) — two column-parallel inputs,
     one row-parallel output (Megatron layout)."""
@@ -162,22 +181,9 @@ class LlamaModel(Layer):
         for i, l in enumerate(self.layers):
             self.add_sublayer(f"layers.{i}", l)
         self.norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
-        # reference init (HF _init_weights): every weight matrix N(0, 0.02)
-        # — the Embedding default N(0,1) would start CE ~8x above ln(V)
-        import jax.random as _jr
-
-        from ...framework import random as _rng
-
-        key = _rng.next_key()
-        for _, p in self.named_parameters():
-            if p._value.ndim >= 2:
-                key, sub = _jr.split(key)
-                new = (0.02 * _jr.normal(sub, p._value.shape, jnp.float32)
-                       ).astype(p._value.dtype)
-                sh = p._value.sharding
-                if hasattr(sh, "spec"):  # keep the TP layout
-                    new = jax.device_put(new, sh)
-                p._value = new
+        # reference init — the Embedding default N(0,1) would start CE ~8x
+        # above ln(V)
+        _reference_init(self)
 
     def forward(self, input_ids, position_ids=None, attention_mask=None):
         x = self.embed_tokens(input_ids)
@@ -216,18 +222,7 @@ class LlamaForCausalLM(Layer):
         if not self.tie:
             self.lm_head = _col_linear(cfg.hidden_size, cfg.vocab_size,
                                        bias=False)
-            # same N(0, 0.02) reference init as the body weights
-            import jax.random as _jr
-
-            from ...framework import random as _rng
-
-            w = self.lm_head.weight
-            new = (0.02 * _jr.normal(_rng.next_key(), w._value.shape,
-                                     jnp.float32)).astype(w._value.dtype)
-            sh = w._value.sharding
-            if hasattr(sh, "spec"):
-                new = jax.device_put(new, sh)
-            w._value = new
+            _reference_init(self.lm_head)
 
     def forward(self, input_ids, position_ids=None, attention_mask=None,
                 labels=None):

@@ -79,3 +79,23 @@ def test_train_batch_api():
     assert np.isfinite(ev[0])
     pr = model.predict_batch([x])
     assert pr[0].shape == (8, 2)
+
+
+def test_model_fit_fp16_scaler_via_amp_configs():
+    """Model.prepare(amp_configs={'level','dtype','init_loss_scaling'})
+    builds the traced GradScaler inside the fused step."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=opt.AdamW(learning_rate=1e-3, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        amp_configs={"level": "O2", "dtype": "float16",
+                     "init_loss_scaling": 1024.0})
+    x = np.random.RandomState(0).randn(16, 8).astype("float32")
+    y = np.random.RandomState(1).randint(0, 4, (16,)).astype("int64")
+    l1 = model.train_batch([x], [y])
+    l2 = model.train_batch([x], [y])
+    step = model._train_step
+    assert step._scaler is not None and step.loss_scale == 1024.0
+    assert float(np.asarray(l2[0])) < float(np.asarray(l1[0]))
