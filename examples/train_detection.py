@@ -1,7 +1,7 @@
 """Baseline config #3: detection training (PP-YOLOE-style anchor-free head
 or FasterRCNN) on synthetic boxes.
 
-    python examples/train_detection.py [--arch yolo|rcnn] [--steps 20]
+    python examples/train_detection.py [--arch yolo|ppyoloe|rcnn] [--steps 20]
 """
 
 import argparse
@@ -10,7 +10,7 @@ import numpy as np
 
 import paddle_tpu as paddle
 import paddle_tpu.optimizer as opt
-from paddle_tpu.vision.models import yolov3, faster_rcnn
+from paddle_tpu.vision.models import faster_rcnn, ppyoloe, yolov3
 
 
 def synth_batch(rng, b=2, size=160, max_boxes=8, classes=8):
@@ -29,14 +29,19 @@ def synth_batch(rng, b=2, size=160, max_boxes=8, classes=8):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--arch", default="yolo", choices=["yolo", "rcnn"])
+    ap.add_argument("--arch", default="yolo", choices=["yolo", "ppyoloe", "rcnn"])
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--classes", type=int, default=8)
     args = ap.parse_args()
 
     paddle.seed(0)
-    model = yolov3(num_classes=args.classes, depth=18) if args.arch == "yolo" \
-        else faster_rcnn(num_classes=args.classes, depth=18, num_proposals=64)
+    if args.arch == "yolo":
+        model = yolov3(num_classes=args.classes, depth=18)
+    elif args.arch == "ppyoloe":
+        model = ppyoloe(num_classes=args.classes, size="s")
+    else:
+        model = faster_rcnn(num_classes=args.classes, depth=18,
+                            num_proposals=64)
     optim = opt.Adam(learning_rate=2e-4, parameters=model.parameters())
     rng = np.random.RandomState(0)
     for i in range(args.steps):

@@ -66,7 +66,8 @@ import importlib as _importlib
 _LAZY = ("nn", "optimizer", "amp", "io", "metric", "jit", "static", "vision",
          "distributed", "autograd", "device", "framework", "hapi", "profiler",
          "incubate", "utils", "sparse", "signal", "fft", "text", "ops",
-         "distribution", "regularizer", "callbacks", "inference")
+         "distribution", "regularizer", "callbacks", "inference",
+         "audio")
 
 
 def __getattr__(name):

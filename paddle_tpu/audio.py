@@ -1,0 +1,198 @@
+"""paddle.audio — feature extraction (reference: python/paddle/audio/:
+functional windows/mel utilities + features.Spectrogram/MelSpectrogram/
+LogMelSpectrogram/MFCC layers).
+
+TPU-native: everything reduces to the framed-matmul STFT in
+``paddle_tpu.signal`` plus one mel filter-bank matmul — MXU-shaped ops a
+jitted feature pipeline fuses with the model; no librosa-style host DSP.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from . import signal as _signal
+from .nn.layer import Layer
+from .tensor.dispatch import apply as _apply
+from .tensor.tensor import Tensor
+
+__all__ = ["functional", "features"]
+
+
+class functional:
+    """paddle.audio.functional namespace."""
+
+    @staticmethod
+    def get_window(window, win_length, fftbins=True, dtype="float64"):
+        n = win_length
+        k = jnp.arange(n, dtype=jnp.float64)
+        denom = n if fftbins else max(n - 1, 1)  # n=1: [1.0], like scipy
+        if window in ("hann", "hanning"):
+            w = 0.5 - 0.5 * jnp.cos(2 * math.pi * k / denom)
+        elif window == "hamming":
+            w = 0.54 - 0.46 * jnp.cos(2 * math.pi * k / denom)
+        elif window == "blackman":
+            w = (0.42 - 0.5 * jnp.cos(2 * math.pi * k / denom)
+                 + 0.08 * jnp.cos(4 * math.pi * k / denom))
+        elif window in ("rect", "rectangular", "boxcar", "ones"):
+            w = jnp.ones((n,), jnp.float64)
+        else:
+            raise ValueError(f"unsupported window {window!r}")
+        from .framework import dtypes as _dt
+
+        return Tensor(w.astype(_dt.to_jax(dtype)))
+
+    @staticmethod
+    def hz_to_mel(freq, htk=False):
+        f = jnp.asarray(freq, jnp.float64)
+        if htk:
+            out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+            return float(out) if out.ndim == 0 else Tensor(out)
+        # slaney scale
+        mel = (f - 0.0) / (200.0 / 3)
+        min_log_hz = 1000.0
+        min_log_mel = min_log_hz / (200.0 / 3)
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(f / min_log_hz) / logstep, mel)
+        return float(out) if out.ndim == 0 else Tensor(out)
+
+    @staticmethod
+    def mel_to_hz(mel, htk=False):
+        m = jnp.asarray(mel, jnp.float64)
+        if htk:
+            out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        else:
+            freqs = (200.0 / 3) * m
+            min_log_hz = 1000.0
+            min_log_mel = min_log_hz / (200.0 / 3)
+            logstep = math.log(6.4) / 27.0
+            out = jnp.where(m >= min_log_mel,
+                            min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                            freqs)
+        return float(out) if out.ndim == 0 else Tensor(out)
+
+    @staticmethod
+    def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                             htk=False, norm="slaney", dtype="float32"):
+        """[n_mels, n_fft//2 + 1] triangular mel filter bank."""
+        f_max = f_max if f_max is not None else sr / 2.0
+        n_bins = n_fft // 2 + 1
+        fft_freqs = jnp.linspace(0.0, sr / 2.0, n_bins, dtype=jnp.float64)
+        mel_min = functional.hz_to_mel(f_min, htk)
+        mel_max = functional.hz_to_mel(f_max, htk)
+        mel_pts = jnp.linspace(float(mel_min), float(mel_max), n_mels + 2,
+                               dtype=jnp.float64)
+        hz_pts = functional.mel_to_hz(mel_pts, htk)
+        hz_pts = hz_pts._value if isinstance(hz_pts, Tensor) else hz_pts
+        lower = hz_pts[:-2][:, None]
+        center = hz_pts[1:-1][:, None]
+        upper = hz_pts[2:][:, None]
+        up = (fft_freqs[None] - lower) / jnp.maximum(center - lower, 1e-10)
+        down = (upper - fft_freqs[None]) / jnp.maximum(upper - center, 1e-10)
+        fb = jnp.maximum(0.0, jnp.minimum(up, down))
+        if norm == "slaney":
+            enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+            fb = fb * enorm[:, None]
+        from .framework import dtypes as _dt
+
+        return Tensor(fb.astype(_dt.to_jax(dtype)))
+
+    @staticmethod
+    def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+        def fn(s):
+            db = 10.0 * jnp.log10(jnp.maximum(s, amin))
+            db = db - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+            if top_db is not None:
+                db = jnp.maximum(db, db.max() - top_db)
+            return db
+
+        return _apply(fn, spect, op_name="power_to_db")
+
+    @staticmethod
+    def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+        k = jnp.arange(n_mels, dtype=jnp.float64)
+        n = jnp.arange(n_mfcc, dtype=jnp.float64)[:, None]
+        dct = jnp.cos(math.pi / n_mels * (k + 0.5) * n)       # [n_mfcc, n_mels]
+        if norm == "ortho":
+            dct = dct * math.sqrt(2.0 / n_mels)
+            dct = dct.at[0].multiply(1.0 / math.sqrt(2.0))
+        from .framework import dtypes as _dt
+
+        return Tensor(dct.T.astype(_dt.to_jax(dtype)))      # [n_mels, n_mfcc]
+
+
+class _Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.window = functional.get_window(window, self.win_length,
+                                            dtype=dtype)
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, hop_length=self.hop_length,
+                            win_length=self.win_length, window=self.window,
+                            center=self.center, pad_mode=self.pad_mode)
+        return _apply(lambda s: jnp.abs(s) ** self.power, spec,
+                      op_name="spec_power")
+
+
+class _MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = _Spectrogram(n_fft, hop_length, win_length, window,
+                                        power, center, pad_mode, dtype)
+        self.fbank = functional.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype)
+
+    def forward(self, x):
+        s = self.spectrogram(x)                      # [..., n_bins, T]
+        return _apply(lambda sv, fb: jnp.einsum("mf,...ft->...mt", fb, sv),
+                      s, self.fbank, op_name="mel_project")
+
+
+class _LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, ref_value=1.0, amin=1e-10, top_db=None,
+                 **kw):
+        super().__init__()
+        self.mel = _MelSpectrogram(sr=sr, **kw)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        return functional.power_to_db(self.mel(x), self.ref_value, self.amin,
+                                      self.top_db)
+
+
+class _MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, norm="ortho", dtype="float32",
+                 **kw):
+        super().__init__()
+        kw.setdefault("n_mels", 64)
+        self.log_mel = _LogMelSpectrogram(sr=sr, dtype=dtype, **kw)
+        self.dct = functional.create_dct(n_mfcc, kw["n_mels"], norm, dtype)
+
+    def forward(self, x):
+        lm = self.log_mel(x)                         # [..., n_mels, T]
+        return _apply(lambda v, d: jnp.einsum("mk,...mt->...kt", d, v),
+                      lm, self.dct, op_name="mfcc_dct")
+
+
+class features:
+    """paddle.audio.features namespace."""
+
+    Spectrogram = _Spectrogram
+    MelSpectrogram = _MelSpectrogram
+    LogMelSpectrogram = _LogMelSpectrogram
+    MFCC = _MFCC

@@ -46,6 +46,7 @@ class Model:
             self._metrics = list(metrics)
         # fresh AMP state each prepare(): re-preparing must fully replace
         # any earlier fp16/scaler configuration
+        self._amp_level = None
         self._amp_dtype = "bfloat16"
         self._scaler = None
         if amp_configs:
@@ -56,17 +57,23 @@ class Model:
                 self._amp_dtype = amp_configs.get("dtype", "bfloat16")
                 # fp16 needs loss scaling: build the traced scaler from the
                 # reference-named knobs (init_loss_scaling etc.)
+                scaler_keys = ("init_loss_scaling", "incr_every_n_steps",
+                               "incr_ratio", "decr_ratio",
+                               "decr_every_n_nan_or_inf",
+                               "use_dynamic_loss_scaling")
                 if self._amp_dtype == "float16" or any(
-                        k in amp_configs for k in ("init_loss_scaling",
-                                                   "incr_every_n_steps",
-                                                   "use_dynamic_loss_scaling")):
+                        k in amp_configs for k in scaler_keys):
                     from ..amp import GradScaler
 
                     self._scaler = GradScaler(
                         init_loss_scaling=amp_configs.get(
                             "init_loss_scaling", 2.0 ** 15),
+                        incr_ratio=amp_configs.get("incr_ratio", 2.0),
+                        decr_ratio=amp_configs.get("decr_ratio", 0.5),
                         incr_every_n_steps=amp_configs.get(
                             "incr_every_n_steps", 1000),
+                        decr_every_n_nan_or_inf=amp_configs.get(
+                            "decr_every_n_nan_or_inf", 1),
                         use_dynamic_loss_scaling=amp_configs.get(
                             "use_dynamic_loss_scaling", True))
         self._train_step = None

@@ -87,16 +87,17 @@ class BatchNorm(Layer):
                 return out.reshape(v.shape), mean, var
             return out.reshape(v.shape)
 
-        args = (x if isinstance(x, Tensor) else Tensor(_dense(x)),
-                self.weight, self.bias, self._mean, self._variance)
+        xt = x if isinstance(x, Tensor) else Tensor(_dense(x))
+        args = (xt, self.weight, self.bias, self._mean, self._variance)
         if training:
-            out, mean, var = _dispatch(*((fn,) + args),
-                                       op_name="sparse_batch_norm", n_outs=3)
+            # apply() infers the 3-tuple output from fn's return type
+            out, mean, var = _dispatch(fn, *args,
+                                       op_name="sparse_batch_norm")
             m = self.momentum
             self._mean._value = m * self._mean._value                 + (1 - m) * mean._value
             self._variance._value = m * self._variance._value                 + (1 - m) * var._value
             return out
-        return _dispatch(*((fn,) + args), op_name="sparse_batch_norm")
+        return _dispatch(fn, *args, op_name="sparse_batch_norm")
 
 
 class functional:  # namespace-style holder (paddle.sparse.nn.functional)

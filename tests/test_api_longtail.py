@@ -340,3 +340,62 @@ class TestSparse:
         band = np.tril(np.triu(np.ones((4, 4)), -1), 1).astype("float32")[None]
         att_b = sp.nn.functional.attention(q, q, q, paddle.to_tensor(band))
         assert np.isfinite(att_b.numpy()).all()
+
+
+class TestAudio:
+    def test_windows_match_numpy(self):
+        import paddle_tpu.audio as audio
+
+        for name, ref in (("hann", np.hanning), ("hamming", np.hamming),
+                          ("blackman", np.blackman)):
+            w = audio.functional.get_window(name, 16, fftbins=False,
+                                            dtype="float64").numpy()
+            np.testing.assert_allclose(w, ref(16), rtol=1e-10, atol=1e-12)
+
+    def test_fbank_partition_of_unity_region(self):
+        import paddle_tpu.audio as audio
+
+        fb = audio.functional.compute_fbank_matrix(16000, 512, n_mels=40,
+                                                   norm=None).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all() and fb.max() <= 1.0 + 1e-6
+        # triangles overlap: interior bins are covered by some filter
+        covered = fb.sum(0)[10:200]
+        assert (covered > 0).all()
+
+    def test_spectrogram_peak_bin(self):
+        import paddle_tpu.audio as audio
+
+        sr = 16000
+        t = np.arange(sr, dtype=np.float32) / sr
+        wav = paddle.to_tensor((0.5 * np.sin(2 * np.pi * 440 * t))[None, :])
+        spec = audio.features.Spectrogram(n_fft=512)(wav)
+        peak = int(np.asarray(spec.numpy()).mean(-1).argmax())
+        assert abs(peak - round(440 * 512 / sr)) <= 1
+
+    def test_mel_mfcc_pipeline(self):
+        import paddle_tpu.audio as audio
+
+        wav = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8000).astype("float32"))
+        mel = audio.features.MelSpectrogram(sr=16000, n_fft=512, n_mels=64)(wav)
+        logmel = audio.features.LogMelSpectrogram(sr=16000, n_fft=512,
+                                                  n_mels=64, top_db=80.0)(wav)
+        mfcc = audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=512)(wav)
+        assert mel.shape[0:2] == [2, 64] and mfcc.shape[0:2] == [2, 13]
+        lm = logmel.numpy()
+        assert np.isfinite(lm).all() and lm.max() - lm.min() <= 80.0 + 1e-4
+        # mel/hz roundtrip
+        f = audio.functional.mel_to_hz(audio.functional.hz_to_mel(440.0))
+        np.testing.assert_allclose(f, 440.0, rtol=1e-6)
+
+
+class TestInfoAPIs:
+    def test_finfo_iinfo_asarray(self):
+        assert paddle.finfo(paddle.float32).max > 1e38
+        assert paddle.finfo("bfloat16").bits == 16
+        assert paddle.finfo(paddle.float16).eps == pytest.approx(2 ** -10)
+        assert paddle.iinfo(paddle.int32).max == 2 ** 31 - 1
+        assert paddle.iinfo("int8").min == -128
+        t = paddle.asarray(np.arange(6).reshape(2, 3), dtype="float32")
+        assert t.shape == [2, 3] and t.dtype == paddle.float32
