@@ -27,8 +27,12 @@ class functional:
     @staticmethod
     def get_window(window, win_length, fftbins=True, dtype="float64"):
         n = win_length
+        if n == 1:  # scipy convention: a length-1 window is [1.0]
+            from .framework import dtypes as _dt
+
+            return Tensor(jnp.ones((1,), _dt.to_jax(dtype)))
         k = jnp.arange(n, dtype=jnp.float64)
-        denom = n if fftbins else max(n - 1, 1)  # n=1: [1.0], like scipy
+        denom = n if fftbins else n - 1
         if window in ("hann", "hanning"):
             w = 0.5 - 0.5 * jnp.cos(2 * math.pi * k / denom)
         elif window == "hamming":

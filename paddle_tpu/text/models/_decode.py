@@ -1,0 +1,86 @@
+"""Shared jitted KV-cache decode loop (used by GPT and Llama heads).
+
+The per-model piece is ONE closure: ``fwd(params, bufs, ids, ks, vs, pos)
+-> (last-token logits f32, new ks, new vs)`` over stacked [L, B, T, h, d]
+cache buffers.  This module owns everything else — sampling (greedy /
+temperature / top-k / top-p as traced ops), the compiled prefill, the
+single compiled decode step with DONATED cache buffers, and the
+train-mode save/restore discipline — so decode fixes land in one place.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor
+
+
+def make_sampler(temperature, top_k, top_p):
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1)
+        l = logits / jnp.float32(max(temperature, 1e-6))
+        if top_k:
+            kk = min(int(top_k), l.shape[-1])
+            kth = jax.lax.top_k(l, kk)[0][:, -1][:, None]
+            l = jnp.where(l < kth, -jnp.inf, l)
+        if top_p < 1.0:  # nucleus: smallest prefix of sorted probs >= top_p
+            srt = jnp.sort(l, axis=-1)[:, ::-1]
+            p = jax.nn.softmax(srt, axis=-1)
+            keep_n = (jnp.cumsum(p, axis=-1) - p < top_p).sum(-1)
+            kth = jnp.take_along_axis(srt, (keep_n - 1)[:, None], axis=-1)
+            l = jnp.where(l < kth, -jnp.inf, l)
+        return jax.random.categorical(key, l, axis=-1)
+
+    return sample
+
+
+def jitted_decode(model, fwd, ids0, max_new_tokens, cache_shape, cache_dtype,
+                  temperature=1.0, top_k=0, top_p=1.0, seed=None):
+    """Run prefill + per-token decode; returns the full id matrix.
+
+    model: Layer (eval'd recursively for the duration).
+    fwd: closure as in the module docstring.
+    ids0: np.int64 [B, S0] prompt.
+    cache_shape: [L, B, T, h, d] for the zero-initialized K/V buffers.
+    """
+    import numpy as np
+
+    S0 = ids0.shape[1]
+    params = {k: p._value for k, p in model.named_parameters()}
+    bufs = {k: b._value for k, b in model.named_buffers()}
+    modes = [(m, m.training) for m in model.sublayers(include_self=True)]
+    model.eval()
+    sample = make_sampler(temperature, top_k, top_p)
+
+    @jax.jit
+    def prefill(params, bufs, ids, ks, vs, key):
+        logits, ks, vs = fwd(params, bufs, ids, ks, vs, jnp.int32(0))
+        return sample(logits, key), ks, vs
+
+    @functools.partial(jax.jit, donate_argnums=(3, 4))
+    def step(params, bufs, last, ks, vs, pos, key):
+        logits, ks, vs = fwd(params, bufs, last, ks, vs, pos)
+        return sample(logits, key), ks, vs
+
+    try:
+        ks = jnp.zeros(tuple(cache_shape), cache_dtype)
+        vs = jnp.zeros_like(ks)
+        base = jax.random.key(seed if seed is not None else 0)
+        nxt, ks, vs = prefill(params, bufs, jnp.asarray(ids0), ks, vs,
+                              jax.random.fold_in(base, 0))
+        out = [np.asarray(nxt)[:, None]]
+        for t in range(1, max_new_tokens):
+            nxt, ks, vs = step(params, bufs,
+                               jnp.asarray(nxt)[:, None].astype(jnp.int64),
+                               ks, vs, jnp.int32(S0 + t - 1),
+                               jax.random.fold_in(base, t))
+            out.append(np.asarray(nxt)[:, None])
+    finally:
+        for m, tr in modes:
+            m.training = tr
+    new = np.concatenate(out, axis=1)
+    return Tensor(jnp.asarray(np.concatenate([ids0, new], axis=1)))

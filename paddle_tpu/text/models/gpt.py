@@ -19,7 +19,6 @@ TPU-first structure:
 
 from __future__ import annotations
 
-import functools
 
 import jax.numpy as jnp
 
@@ -210,14 +209,15 @@ class GPTForCausalLM(Layer):
 
     # ------------------------------------------------------------ generation
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
-                 seed=None, use_cache=True):
+                 top_p=1.0, seed=None, use_cache=True):
         """Autoregressive generation.
 
-        ``use_cache=True`` (default): jitted two-phase decode — one compiled
-        prefill writes the prompt's K/V into fixed [B, T, h, d] buffers, then
-        ONE compiled single-token step (donated cache, static shapes) runs
-        per new token.  Greedy (temperature=0) output is identical to the
-        eager loop; sampled output uses jax PRNG instead of numpy's.
+        ``use_cache=True`` (default): jitted two-phase decode via the shared
+        decode loop (``_decode.jitted_decode``) — one compiled prefill
+        writes the prompt's K/V into fixed [B, T, h, d] buffers, then ONE
+        compiled single-token step (donated cache, static shapes) runs per
+        new token.  Greedy (temperature=0) output is identical to the eager
+        loop; sampling supports temperature/top-k/top-p via jax PRNG.
         ``use_cache=False``: the eager full-prefix loop (reference parity /
         debug path)."""
         if not use_cache:
@@ -230,6 +230,7 @@ class GPTForCausalLM(Layer):
 
         from ...framework import random as _rng
         from ...framework.state import no_grad_ctx
+        from ._decode import jitted_decode
 
         ids0 = np.asarray(input_ids.numpy()).astype("int64")
         B, S0 = ids0.shape
@@ -244,11 +245,6 @@ class GPTForCausalLM(Layer):
         blk = gpt.layers[0]
         h_heads = blk.qkv.weight.shape[-1] // (3 * blk.head_dim)
         dt = gpt.word_embeddings.weight._value.dtype
-        params = {k: p._value for k, p in self.named_parameters()}
-        bufs = {k: b._value for k, b in self.named_buffers()}
-        # eval mode must reach every sublayer (dropout lives in the blocks)
-        modes = [(m, m.training) for m in self.sublayers(include_self=True)]
-        self.eval()
 
         def fwd(params, bufs, ids, ks, vs, pos):
             with no_grad_ctx(), _rng.rng_scope(jax.random.key(0)), \
@@ -266,43 +262,10 @@ class GPTForCausalLM(Layer):
                 vs = jnp.stack([c[1]._value for c in new_cache])
             return logits, ks, vs
 
-        def sample(logits, key):
-            if temperature == 0.0:
-                return jnp.argmax(logits, axis=-1)
-            l = logits / jnp.float32(max(temperature, 1e-6))
-            if top_k:
-                kth = jax.lax.top_k(l, top_k)[0][:, -1][:, None]
-                l = jnp.where(l < kth, -jnp.inf, l)
-            return jax.random.categorical(key, l, axis=-1)
-
-        @jax.jit
-        def prefill(params, bufs, ids, ks, vs, key):
-            logits, ks, vs = fwd(params, bufs, ids, ks, vs, jnp.int32(0))
-            return sample(logits, key), ks, vs
-
-        @functools.partial(jax.jit, donate_argnums=(3, 4))
-        def step(params, bufs, last, ks, vs, pos, key):
-            logits, ks, vs = fwd(params, bufs, last, ks, vs, pos)
-            return sample(logits, key), ks, vs
-
-        try:
-            ks = jnp.zeros((L, B, T, h_heads, blk.head_dim), dt)
-            vs = jnp.zeros_like(ks)
-            base = jax.random.key(seed if seed is not None else 0)
-            nxt, ks, vs = prefill(params, bufs, jnp.asarray(ids0), ks, vs,
-                                  jax.random.fold_in(base, 0))
-            out = [np.asarray(nxt)[:, None]]
-            for t in range(1, max_new_tokens):
-                nxt, ks, vs = step(params, bufs,
-                                   jnp.asarray(nxt)[:, None].astype(jnp.int64),
-                                   ks, vs, jnp.int32(S0 + t - 1),
-                                   jax.random.fold_in(base, t))
-                out.append(np.asarray(nxt)[:, None])
-        finally:
-            for m, t in modes:
-                m.training = t
-        new = np.concatenate(out, axis=1)
-        return Tensor(jnp.asarray(np.concatenate([ids0, new], axis=1)))
+        return jitted_decode(self, fwd, ids0, max_new_tokens,
+                             (L, B, T, h_heads, blk.head_dim), dt,
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p, seed=seed)
 
     def _generate_eager(self, input_ids, max_new_tokens=32, temperature=1.0,
                         top_k=0, seed=None):

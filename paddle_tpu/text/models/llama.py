@@ -119,7 +119,7 @@ class LlamaAttention(Layer):
         self.o_proj = _row_linear(self.num_heads * self.head_dim, h,
                                   bias=False)
 
-    def forward(self, x, rope, attn_bias=None):
+    def forward(self, x, rope, attn_bias=None, cache=None):
         B, S = x.shape[0], x.shape[1]
         hd = self.head_dim
         q = self.q_proj(x)
@@ -135,13 +135,53 @@ class LlamaAttention(Layer):
             kh = kv.reshape(B, S, hkv, hd)
             vh = vv.reshape(B, S, hkv, hd)
             qh, kh = _apply_rope(qh, kh, cos, sin)
-            if rep > 1:  # GQA: broadcast kv heads up to the q head count
-                kh = jnp.repeat(kh, rep, axis=2)
-                vh = jnp.repeat(vh, rep, axis=2)
             return qh, kh, vh
 
         qh, kh, vh = _apply(attend, q, k, v, rope[0], rope[1],
                             op_name="llama_rope", n_outs=3)
+        if cache is not None:
+            # STATIC cache decode (GPT pattern): fixed [B, T, hkv, hd]
+            # buffers updated in place at ``pos``; keys stored PRE-ROTATED
+            import jax as _jax
+
+            k_buf, v_buf, pos = cache
+
+            def write(buf, new, p):
+                # rope math runs in f32; store in the buffer's dtype
+                return _jax.lax.dynamic_update_slice_in_dim(
+                    buf, new.astype(buf.dtype), p, 1)
+
+            k_buf = _apply(write, k_buf, kh, pos, op_name="cache_write")
+            v_buf = _apply(write, v_buf, vh, pos, op_name="cache_write")
+            T = k_buf.shape[1]
+
+            def expand_and_mask(kb, vb, p, *bias):
+                kk, vv2 = kb, vb
+                if rep > 1:
+                    kk = jnp.repeat(kk, rep, axis=2)
+                    vv2 = jnp.repeat(vv2, rep, axis=2)
+                i = jnp.arange(S, dtype=jnp.int32)[:, None]
+                j = jnp.arange(T, dtype=jnp.int32)[None, :]
+                m = jnp.where(j <= p + i, jnp.float32(0.0),
+                              jnp.float32(-1e30))[None, None]
+                if bias:  # caller-provided padding bias joins the mask
+                    m = m + bias[0][..., :S, :T]
+                return kk, vv2, m
+
+            mask_args = (k_buf, v_buf, pos) + (
+                (attn_bias,) if attn_bias is not None else ())
+            kf, vf, mask = _apply(expand_and_mask, *mask_args,
+                                  op_name="cache_expand", n_outs=3)
+            att = F.scaled_dot_product_attention(qh, kf, vf, attn_mask=mask,
+                                                 dropout_p=0.0,
+                                                 training=False)
+            att = att.reshape([B, S, hq * hd])
+            return self.o_proj(att), (k_buf, v_buf, pos)
+        if rep > 1:  # GQA: broadcast kv heads up to the q head count
+            kh = _apply(lambda t: jnp.repeat(t, rep, axis=2), kh,
+                        op_name="gqa_repeat")
+            vh = _apply(lambda t: jnp.repeat(t, rep, axis=2), vh,
+                        op_name="gqa_repeat")
         if attn_bias is not None:
             att = F.scaled_dot_product_attention(qh, kh, vh,
                                                  attn_mask=attn_bias,
@@ -163,7 +203,13 @@ class LlamaDecoderLayer(Layer):
                                                 epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config.hidden_size, config.intermediate_size)
 
-    def forward(self, x, rope, attn_bias=None):
+    def forward(self, x, rope, attn_bias=None, cache=None):
+        if cache is not None:
+            att, new_cache = self.self_attn(self.input_layernorm(x), rope,
+                                            attn_bias, cache)
+            x = x + att
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_cache
         x = x + self.self_attn(self.input_layernorm(x), rope, attn_bias)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -185,7 +231,8 @@ class LlamaModel(Layer):
         # above ln(V)
         _reference_init(self)
 
-    def forward(self, input_ids, position_ids=None, attention_mask=None):
+    def forward(self, input_ids, position_ids=None, attention_mask=None,
+                cache=None):
         x = self.embed_tokens(input_ids)
         S = x.shape[1]
         if position_ids is None:
@@ -208,6 +255,12 @@ class LlamaModel(Layer):
                 return (pad + causal).astype(jnp.float32)
 
             bias = _apply(build_bias, attention_mask, op_name="llama_mask")
+        if cache is not None:
+            new_caches = []
+            for layer, c in zip(self.layers, cache):
+                x, nc = layer(x, (cos, sin), bias, c)
+                new_caches.append(nc)
+            return self.norm(x), new_caches
         for layer in self.layers:
             x = layer(x, (cos, sin), bias)
         return self.norm(x)
@@ -240,9 +293,68 @@ class LlamaForCausalLM(Layer):
         return logits
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=0, top_p=1.0, seed=None):
-        """Greedy/sampled decode (eager full-prefix loop; the jitted
-        KV-cache path lives on GPTForCausalLM and applies the same way)."""
+                 top_k=0, top_p=1.0, seed=None, use_cache=True):
+        """Autoregressive decode.
+
+        ``use_cache=True`` (default): jitted two-phase decode — compiled
+        prefill writes the prompt K/V into fixed [B, T, hkv, hd] buffers
+        (keys stored pre-rotated), then ONE compiled single-token step
+        (donated cache, static shapes) runs per new token.  Greedy output
+        is identical to the eager loop.  ``use_cache=False``: eager
+        full-prefix loop (debug/reference path)."""
+        if not use_cache:
+            return self._generate_eager(input_ids, max_new_tokens,
+                                        temperature, top_k, top_p, seed)
+        if max_new_tokens <= 0:
+            return input_ids
+        import numpy as np
+
+        ids0 = np.asarray(input_ids.numpy()).astype("int64")
+        B, S0 = ids0.shape
+        T = S0 + max_new_tokens
+        cfg = self.llama.config
+        if T > cfg.max_position_embeddings:
+            raise ValueError(
+                f"generate: prompt {S0} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_position_embeddings "
+                f"{cfg.max_position_embeddings}")
+        L = cfg.num_hidden_layers
+        hkv = cfg.num_key_value_heads
+        hd = cfg.hidden_size // cfg.num_attention_heads
+
+        from ...framework import random as _rng
+        from ...framework.state import no_grad_ctx
+        from ._decode import jitted_decode
+
+        def fwd(params, bufs, ids, ks, vs, pos):
+            with no_grad_ctx(), _rng.rng_scope(jax.random.key(0)), \
+                    self.bind(params, bufs):
+                S = ids.shape[1]
+                pos_ids = Tensor(pos + jnp.arange(S, dtype=jnp.int32))
+                cache = [(Tensor(ks[i]), Tensor(vs[i]), Tensor(pos))
+                         for i in range(L)]
+                hidden, new_cache = self.llama(Tensor(ids),
+                                               position_ids=pos_ids,
+                                               cache=cache)
+                h = hidden._value[:, -1].astype(jnp.float32)
+                if self.tie:
+                    w = self.llama.embed_tokens.weight._value
+                    logits = h @ w.T.astype(jnp.float32)
+                else:
+                    logits = h @ self.lm_head.weight._value.astype(jnp.float32)
+                ks = jnp.stack([c[0]._value for c in new_cache])
+                vs = jnp.stack([c[1]._value for c in new_cache])
+            return logits, ks, vs
+
+        dt = self.llama.embed_tokens.weight._value.dtype
+        return jitted_decode(self, fwd, ids0, max_new_tokens,
+                             (L, B, T, hkv, hd), dt,
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p, seed=seed)
+
+    def _generate_eager(self, input_ids, max_new_tokens=32, temperature=1.0,
+                        top_k=0, top_p=1.0, seed=None):
+        """Greedy/sampled decode, eager full-prefix loop (reference path)."""
         import numpy as np
 
         ids = np.asarray(input_ids.numpy()).astype("int64")
