@@ -26,6 +26,10 @@ class functional:
 
     @staticmethod
     def get_window(window, win_length, fftbins=True, dtype="float64"):
+        known = ("hann", "hanning", "hamming", "blackman", "rect",
+                 "rectangular", "boxcar", "ones")
+        if window not in known:
+            raise ValueError(f"unsupported window {window!r}")
         n = win_length
         if n == 1:  # scipy convention: a length-1 window is [1.0]
             from .framework import dtypes as _dt

@@ -1,0 +1,17 @@
+"""paddle.distributed.sharding namespace (reference:
+python/paddle/distributed/sharding/__init__.py) — re-exports the ZeRO
+entry points from fleet.meta_parallel.sharding (one implementation)."""
+
+from .fleet.meta_parallel.sharding import (  # noqa: F401
+    group_sharded_parallel, shard_optimizer_states, shard_parameters,
+)
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """reference: persists a group-sharded model; orbax checkpointing
+    already handles sharded state, so this is paddle.save on state_dicts."""
+    from ..framework import io as _io
+
+    _io.save(model.state_dict(), output + ".pdmodel.pdparams")
+    if optimizer is not None:
+        _io.save(optimizer.state_dict(), output + ".pdopt")

@@ -1,0 +1,11 @@
+"""paddle.linalg as an importable module (reference: python/paddle/linalg.py
+is likewise a re-export shim; `import paddle.linalg` must work, not just
+attribute access)."""
+
+from .tensor.linalg import *  # noqa: F401,F403
+from .tensor.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh, eigvals,
+    eigvalsh, householder_product, inv, lstsq, lu, matrix_norm, matrix_power,
+    matrix_rank, multi_dot, norm, pca_lowrank, pinv, qr, slogdet, solve, svd,
+    svdvals, triangular_solve, vector_norm,
+)

@@ -222,7 +222,7 @@ class GPTForCausalLM(Layer):
         debug path)."""
         if not use_cache:
             return self._generate_eager(input_ids, max_new_tokens, temperature,
-                                        top_k, seed)
+                                        top_k, top_p, seed)
         if max_new_tokens <= 0:
             return input_ids
         import jax
@@ -268,7 +268,7 @@ class GPTForCausalLM(Layer):
                              top_p=top_p, seed=seed)
 
     def _generate_eager(self, input_ids, max_new_tokens=32, temperature=1.0,
-                        top_k=0, seed=None):
+                        top_k=0, top_p=1.0, seed=None):
         """Greedy/top-k sampling loop (eager; each step reuses the jit cache
         for its shape)."""
         import numpy as np
@@ -286,15 +286,26 @@ class GPTForCausalLM(Layer):
             if temperature != 1.0:
                 step = step / max(temperature, 1e-6)
             if top_k:
-                kth = np.sort(step, axis=-1)[:, -top_k][:, None]
+                kk = min(int(top_k), step.shape[-1])
+                kth = np.sort(step, axis=-1)[:, -kk][:, None]
                 step = np.where(step < kth, -np.inf, step)
             if temperature == 0.0:
                 nxt = step.argmax(-1)
             else:
                 p = np.exp(step - step.max(-1, keepdims=True))
                 p /= p.sum(-1, keepdims=True)
-                nxt = np.array([rng.choice(p.shape[-1], p=p[i])
-                                for i in range(p.shape[0])])
+                if top_p < 1.0:  # nucleus: smallest prefix >= top_p
+                    srt = np.argsort(-p, axis=-1)
+                    ps = np.take_along_axis(p, srt, -1)
+                    keep = np.cumsum(ps, -1) - ps < top_p
+                    ps = np.where(keep, ps, 0.0)
+                    ps = ps / ps.sum(-1, keepdims=True)
+                    pick = np.stack([rng.choice(ps.shape[-1], p=ps[i])
+                                     for i in range(ps.shape[0])])
+                    nxt = np.take_along_axis(srt, pick[:, None], -1)[:, 0]
+                else:
+                    nxt = np.array([rng.choice(p.shape[-1], p=p[i])
+                                    for i in range(p.shape[0])])
             ids = np.concatenate([ids, nxt[:, None]], axis=1)
         return Tensor(jnp.asarray(ids))
 

@@ -142,13 +142,11 @@ class LlamaAttention(Layer):
         if cache is not None:
             # STATIC cache decode (GPT pattern): fixed [B, T, hkv, hd]
             # buffers updated in place at ``pos``; keys stored PRE-ROTATED
-            import jax as _jax
-
             k_buf, v_buf, pos = cache
 
             def write(buf, new, p):
                 # rope math runs in f32; store in the buffer's dtype
-                return _jax.lax.dynamic_update_slice_in_dim(
+                return jax.lax.dynamic_update_slice_in_dim(
                     buf, new.astype(buf.dtype), p, 1)
 
             k_buf = _apply(write, k_buf, kh, pos, op_name="cache_write")
@@ -164,8 +162,13 @@ class LlamaAttention(Layer):
                 j = jnp.arange(T, dtype=jnp.int32)[None, :]
                 m = jnp.where(j <= p + i, jnp.float32(0.0),
                               jnp.float32(-1e30))[None, None]
-                if bias:  # caller-provided padding bias joins the mask
-                    m = m + bias[0][..., :S, :T]
+                if bias:  # key-side padding bias [B,1,1,T] joins the mask
+                    b = bias[0]
+                    if b.shape[-1] != T:
+                        raise ValueError(
+                            f"cache-mode attention_mask must cover all "
+                            f"{T} cache slots, got {b.shape[-1]}")
+                    m = m + b
                 return kk, vv2, m
 
             mask_args = (k_buf, v_buf, pos) + (
@@ -245,16 +248,28 @@ class LlamaModel(Layer):
             op_name="rope_tables", n_outs=2)
         bias = None
         if attention_mask is not None:
-            def build_bias(am):
-                # [B, S] padding mask -> additive causal+pad [B, 1, S, S]
-                pad = jnp.where(am.astype(jnp.bool_), 0.0, -1e30)[:, None,
-                                                                  None, :]
-                i = jnp.arange(S)[:, None]
-                j = jnp.arange(S)[None, :]
-                causal = jnp.where(j <= i, 0.0, -1e30)[None, None]
-                return (pad + causal).astype(jnp.float32)
+            if cache is not None:
+                # cache mode: the mask covers KEY SLOTS [B, T_cache]; the
+                # causal part comes from the cache position mask
+                def build_kbias(am):
+                    return jnp.where(am.astype(jnp.bool_), 0.0,
+                                     -1e30).astype(jnp.float32)[:, None,
+                                                                None, :]
 
-            bias = _apply(build_bias, attention_mask, op_name="llama_mask")
+                bias = _apply(build_kbias, attention_mask,
+                              op_name="llama_key_pad")
+            else:
+                def build_bias(am):
+                    # [B, S] padding mask -> additive causal+pad [B,1,S,S]
+                    pad = jnp.where(am.astype(jnp.bool_), 0.0,
+                                    -1e30)[:, None, None, :]
+                    i = jnp.arange(S)[:, None]
+                    j = jnp.arange(S)[None, :]
+                    causal = jnp.where(j <= i, 0.0, -1e30)[None, None]
+                    return (pad + causal).astype(jnp.float32)
+
+                bias = _apply(build_bias, attention_mask,
+                              op_name="llama_mask")
         if cache is not None:
             new_caches = []
             for layer, c in zip(self.layers, cache):

@@ -1,0 +1,24 @@
+"""paddle.version (reference: generated python/paddle/version/__init__.py)."""
+
+from . import __version__ as _v
+
+full_version = _v
+major, minor, patch = (_v.split(".") + ["0", "0"])[:3]
+rc = 0
+commit = "tpu-native"
+cuda_version = "False"
+cudnn_version = "False"
+tpu = True
+
+
+def show():
+    print(f"paddle_tpu {full_version} (commit {commit}); "
+          "backend: jax/XLA on TPU")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False

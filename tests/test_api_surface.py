@@ -83,3 +83,18 @@ def test_incubate_fused_ffn():
                                                attn_dropout_rate=0.0)
     out = attn(x)
     assert out.shape == [2, 4, 8]
+
+
+def test_namespace_parity_shims():
+    """Reference import spellings that must work as real modules."""
+    import importlib
+
+    import paddle_tpu as paddle
+
+    L = importlib.import_module("paddle_tpu.linalg")
+    assert callable(L.inv) and callable(L.svd)
+    sh = importlib.import_module("paddle_tpu.distributed.sharding")
+    assert callable(sh.group_sharded_parallel)
+    v = importlib.import_module("paddle_tpu.version")
+    assert v.full_version == paddle.__version__
+    assert paddle.version.cuda() is False

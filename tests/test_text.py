@@ -372,3 +372,31 @@ def test_llama_jitted_cache_generate_matches_eager():
         p._value = p._value.astype(jnp.bfloat16)
     gb = m.generate(ids, max_new_tokens=4, temperature=0.0)
     assert gb.shape == [2, 10]
+
+
+def test_llama_cache_mode_key_padding():
+    """Cache-mode attention_mask covers KEY SLOTS [B, T_cache]: padded
+    prefill matches the unpadded forward, and a short mask raises."""
+    m, _ = _small_llama()
+    m.eval()
+    rs = np.random.RandomState(9)
+    short = rs.randint(1, 96, (1, 5)).astype("int64")
+    padded = np.concatenate([short, np.zeros((1, 3), "int64")], 1)
+    T = 8
+
+    def fresh_caches():
+        return [(paddle.to_tensor(np.zeros((1, T, 2, 8), "float32")),
+                 paddle.to_tensor(np.zeros((1, T, 2, 8), "float32")),
+                 paddle.to_tensor(np.int32(0)))
+                for _ in range(len(m.llama.layers))]
+
+    kmask = paddle.to_tensor((padded != 0).astype("int64"))
+    h_cache, _ = m.llama(paddle.to_tensor(padded), attention_mask=kmask,
+                         cache=fresh_caches())
+    h_plain = m.llama(paddle.to_tensor(short)).numpy()
+    np.testing.assert_allclose(h_cache.numpy()[:, :5], h_plain, rtol=2e-4,
+                               atol=2e-4)
+    with pytest.raises(ValueError, match="cache slots"):
+        m.llama(paddle.to_tensor(padded),
+                attention_mask=paddle.to_tensor(np.ones((1, 3), "int64")),
+                cache=fresh_caches())
