@@ -84,3 +84,89 @@ def jitted_decode(model, fwd, ids0, max_new_tokens, cache_shape, cache_dtype,
             m.training = tr
     new = np.concatenate(out, axis=1)
     return Tensor(jnp.asarray(np.concatenate([ids0, new], axis=1)))
+
+
+def beam_search(model, input_ids, max_new_tokens, num_beams=4,
+                length_penalty=0.0, eos_token_id=None):
+    """Reference-style beam search (PaddleNLP generate
+    decode_strategy='beam_search'): maintain num_beams hypotheses per batch
+    item, expand by log-prob, keep the global top beams, penalize each
+    hypothesis by ITS OWN finished length at the end.  Eager full-prefix
+    evaluation — beam bookkeeping is host logic; each scoring pass is one
+    jitted forward, with only the LAST position's logits leaving the device.
+
+    model: a causal LM Layer (called as model(ids) -> [N, S, V] logits).
+    Returns a Tensor [B, S0 + max_new_tokens] (best beam per item).
+    """
+    import numpy as np
+
+    ids0 = np.asarray(input_ids.numpy()).astype("int64")
+    if max_new_tokens <= 0:
+        return input_ids
+    B, S0 = ids0.shape
+    modes = [(m, m.training) for m in model.sublayers(include_self=True)]
+    model.eval()
+
+    def last_logits(arr):
+        out = model(Tensor(jnp.asarray(arr)))
+        # slice on DEVICE: only [N, V] crosses to host, not [N, S, V]
+        return np.asarray(out._value[:, -1]).astype(np.float64)
+
+    def log_softmax(l):
+        m = l.max(-1, keepdims=True)
+        return l - (np.log(np.exp(l - m).sum(-1, keepdims=True)) + m)
+
+    try:
+        # first expansion: top num_beams continuations of each prompt
+        logp = log_softmax(last_logits(ids0))
+        V = logp.shape[-1]
+        top = np.argsort(-logp, axis=-1)[:, :num_beams]        # [B, beams]
+        scores = np.take_along_axis(logp, top, -1)             # [B, beams]
+        seqs = np.concatenate(
+            [np.repeat(ids0[:, None], num_beams, 1), top[..., None]], -1)
+        done = np.zeros((B, num_beams), bool)
+        fin_len = np.full((B, num_beams), max_new_tokens, np.int64)
+        if eos_token_id is not None:
+            done |= top == eos_token_id
+            fin_len = np.where(done, 1, fin_len)
+
+        for t in range(1, max_new_tokens):
+            if done.all():
+                break
+            logp = log_softmax(last_logits(seqs.reshape(B * num_beams, -1)))
+            logp = logp.reshape(B, num_beams, V)
+            if eos_token_id is not None:
+                # finished beams only extend with EOS at no cost
+                frozen = np.full((V,), -np.inf)
+                frozen[eos_token_id] = 0.0
+                logp = np.where(done[..., None], frozen, logp)
+            cand = scores[..., None] + logp                    # [B, beams, V]
+            pick = np.argsort(-cand.reshape(B, num_beams * V),
+                              axis=-1)[:, :num_beams]
+            beam_idx, tok = pick // V, pick % V
+            scores = np.take_along_axis(cand.reshape(B, num_beams * V),
+                                        pick, -1)
+            seqs = np.concatenate(
+                [np.take_along_axis(seqs, beam_idx[..., None], 1),
+                 tok[..., None]], -1)
+            done = np.take_along_axis(done, beam_idx, 1)
+            fin_len = np.take_along_axis(fin_len, beam_idx, 1)
+            if eos_token_id is not None:
+                just = (~done) & (tok == eos_token_id)
+                fin_len = np.where(just, t + 1, fin_len)
+                done |= just
+    finally:
+        for m, tr in modes:
+            m.training = tr
+
+    if length_penalty:
+        # per-hypothesis length: tokens up to and incl. its first EOS
+        scores = scores / (np.maximum(fin_len, 1) ** length_penalty)
+    best = scores.argmax(-1)                                   # [B]
+    out = seqs[np.arange(B), best]
+    if out.shape[1] < S0 + max_new_tokens:  # early-EOS: pad with EOS
+        pad = np.full((B, S0 + max_new_tokens - out.shape[1]),
+                      eos_token_id if eos_token_id is not None else 0,
+                      out.dtype)
+        out = np.concatenate([out, pad], 1)
+    return Tensor(jnp.asarray(out))

@@ -209,7 +209,9 @@ class GPTForCausalLM(Layer):
 
     # ------------------------------------------------------------ generation
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
-                 top_p=1.0, seed=None, use_cache=True):
+                 top_p=1.0, seed=None, use_cache=True,
+                 decode_strategy="sampling", num_beams=4, length_penalty=0.0,
+                 eos_token_id=None):
         """Autoregressive generation.
 
         ``use_cache=True`` (default): jitted two-phase decode via the shared
@@ -220,6 +222,13 @@ class GPTForCausalLM(Layer):
         loop; sampling supports temperature/top-k/top-p via jax PRNG.
         ``use_cache=False``: the eager full-prefix loop (reference parity /
         debug path)."""
+        if decode_strategy == "beam_search":
+            from ._decode import beam_search
+
+            return beam_search(self, input_ids, max_new_tokens,
+                               num_beams=num_beams,
+                               length_penalty=length_penalty,
+                               eos_token_id=eos_token_id)
         if not use_cache:
             return self._generate_eager(input_ids, max_new_tokens, temperature,
                                         top_k, top_p, seed)

@@ -400,3 +400,34 @@ def test_llama_cache_mode_key_padding():
         m.llama(paddle.to_tensor(padded),
                 attention_mask=paddle.to_tensor(np.ones((1, 3), "int64")),
                 cache=fresh_caches())
+
+
+def test_beam_search_decode():
+    """decode_strategy='beam_search': beam-1 equals greedy; wider beams
+    return sequences at least as likely; EOS freezes finished beams."""
+    m, _ = _small_llama()
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(6).randint(4, 96, (2, 4)).astype("int64"))
+    greedy = m.generate(ids, max_new_tokens=5, temperature=0.0).numpy()
+    b1 = m.generate(ids, max_new_tokens=5, decode_strategy="beam_search",
+                    num_beams=1).numpy()
+    np.testing.assert_array_equal(greedy, b1)
+    b4 = m.generate(ids, max_new_tokens=5, decode_strategy="beam_search",
+                    num_beams=4).numpy()
+    assert b4.shape == greedy.shape
+
+    def seq_logp(seq):
+        logits = m(paddle.to_tensor(seq[None])).numpy()[0]
+        lp = 0.0
+        for t in range(4, seq.shape[0]):
+            row = logits[t - 1].astype(np.float64)
+            row = row - (np.log(np.exp(row - row.max()).sum()) + row.max())
+            lp += row[seq[t]]
+        return lp
+
+    for b in range(2):
+        assert seq_logp(b4[b]) >= seq_logp(greedy[b]) - 1e-6
+    be = m.generate(ids, max_new_tokens=5, decode_strategy="beam_search",
+                    num_beams=3, eos_token_id=7)
+    assert be.shape == [2, 9]
