@@ -126,9 +126,23 @@ def beam_search(model, input_ids, max_new_tokens, num_beams=4,
             [np.repeat(ids0[:, None], num_beams, 1), top[..., None]], -1)
         done = np.zeros((B, num_beams), bool)
         fin_len = np.full((B, num_beams), max_new_tokens, np.int64)
+        # finished-hypothesis POOL per item: a completed beam is recorded
+        # the moment it hits EOS, so later eviction from the active set
+        # cannot lose it (reference BeamHypotheses semantics)
+        pool = [[] for _ in range(B)]  # (penalized score, seq list)
+
+        def penalize(sc, ln):
+            return sc / (max(ln, 1) ** length_penalty) if length_penalty \
+                else sc
+
+        def record(b, k, t):
+            pool[b].append((penalize(scores[b, k], t), seqs[b, k].copy()))
+
         if eos_token_id is not None:
             done |= top == eos_token_id
             fin_len = np.where(done, 1, fin_len)
+            for b, k in zip(*np.nonzero(done)):
+                record(b, k, 1)
 
         for t in range(1, max_new_tokens):
             if done.all():
@@ -155,15 +169,29 @@ def beam_search(model, input_ids, max_new_tokens, num_beams=4,
                 just = (~done) & (tok == eos_token_id)
                 fin_len = np.where(just, t + 1, fin_len)
                 done |= just
+                for b, k in zip(*np.nonzero(just)):
+                    record(b, k, t + 1)
     finally:
         for m, tr in modes:
             m.training = tr
 
-    if length_penalty:
-        # per-hypothesis length: tokens up to and incl. its first EOS
-        scores = scores / (np.maximum(fin_len, 1) ** length_penalty)
-    best = scores.argmax(-1)                                   # [B]
-    out = seqs[np.arange(B), best]
+    # best hypothesis = max over the finished pool and the live beams
+    out_rows = []
+    gen_total = seqs.shape[1] - S0
+    for b in range(B):
+        cands = list(pool[b])
+        for k in range(num_beams):
+            if not done[b, k]:  # live beam: penalize by full current length
+                cands.append((penalize(scores[b, k], gen_total),
+                              seqs[b, k]))
+        best_seq = max(cands, key=lambda x: x[0])[1]
+        if len(best_seq) < seqs.shape[1]:  # pool snapshot from an early step
+            padv = eos_token_id if eos_token_id is not None else 0
+            best_seq = np.concatenate(
+                [best_seq, np.full(seqs.shape[1] - len(best_seq), padv,
+                                   best_seq.dtype)])
+        out_rows.append(best_seq)
+    out = np.stack(out_rows)
     if out.shape[1] < S0 + max_new_tokens:  # early-EOS: pad with EOS
         pad = np.full((B, S0 + max_new_tokens - out.shape[1]),
                       eos_token_id if eos_token_id is not None else 0,

@@ -444,3 +444,73 @@ print("TSAN_CLEAN")
                        capture_output=True, text=True, timeout=300)
     assert r.returncode == 0 and "TSAN_CLEAN" in r.stdout, \
         f"rc={r.returncode}\n{r.stdout}\n{r.stderr[-3000:]}"
+
+
+def test_detection_map_metric():
+    """VOC mAP: hand-computed PR curves for both AP rules, padding-aware
+    gt, greedy one-match-per-gt, and end-to-end consumption of a
+    detector's padded eval output."""
+    from paddle_tpu.metric import DetectionMAP
+
+    gt = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], "float32")
+    gl = np.array([0, 0])
+    det = np.array([[0, 0, 10, 10], [50, 50, 60, 60], [20, 20, 30, 30]],
+                   "float32")
+    sc = np.array([0.9, 0.8, 0.7])
+    lb = np.array([0, 0, 0])
+
+    m = DetectionMAP(num_classes=1, map_type="integral")
+    m.update(det, sc, lb, gt, gl)
+    np.testing.assert_allclose(m.accumulate(), 0.5 + 0.5 * 2 / 3, rtol=1e-6)
+
+    m11 = DetectionMAP(num_classes=1, map_type="11point")
+    m11.update(det, sc, lb, gt, gl)
+    np.testing.assert_allclose(m11.accumulate(), (6 + 5 * 2 / 3) / 11,
+                               rtol=1e-6)
+
+    # duplicate hits on one gt count as FP; padded gt rows (label -1) ignored
+    m2 = DetectionMAP(num_classes=2, map_type="integral")
+    gt_pad = np.array([[0, 0, 10, 10], [0, 0, 0, 0]], "float32")
+    gl_pad = np.array([0, -1])
+    m2.update(np.array([[0, 0, 10, 10], [1, 1, 10, 10]], "float32"),
+              np.array([0.9, 0.8]), np.array([0, 0]), gt_pad, gl_pad)
+    np.testing.assert_allclose(m2.accumulate(), 1.0, rtol=1e-6)  # TP then FP
+
+    # end-to-end: detector padded eval output feeds straight in
+    from paddle_tpu.vision.models import ppyoloe
+
+    paddle.seed(0)
+    model = ppyoloe(num_classes=2, size="s")
+    model.eval()
+    img = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 3, 64, 64).astype("float32"))
+    res = model(img)[0]
+    meval = DetectionMAP(num_classes=2, map_type="integral")
+    meval.update(res["boxes"], res["scores"], res["labels"],
+                 np.array([[8, 8, 40, 40]], "float32"), np.array([1]),
+                 valid=res["valid"])
+    assert 0.0 <= meval.accumulate() <= 1.0
+
+
+def test_detection_map_difficult_gt():
+    """VOC semantics: difficult gts don't count toward recall, and
+    matching one is neither TP nor FP (evaluate_difficult=False)."""
+    from paddle_tpu.metric import DetectionMAP
+
+    gt = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], "float32")
+    gl = np.array([0, 0])
+    diff = np.array([False, True])
+    det = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], "float32")
+    sc = np.array([0.9, 0.8])
+    lb = np.array([0, 0])
+
+    m = DetectionMAP(num_classes=1, map_type="integral",
+                     evaluate_difficult=False)
+    m.update(det, sc, lb, gt, gl, gt_difficult=diff)
+    # only the non-difficult gt counts: 1 TP / 1 gt, difficult match ignored
+    np.testing.assert_allclose(m.accumulate(), 1.0, rtol=1e-6)
+
+    m2 = DetectionMAP(num_classes=1, map_type="integral",
+                      evaluate_difficult=True)
+    m2.update(det, sc, lb, gt, gl, gt_difficult=diff)
+    np.testing.assert_allclose(m2.accumulate(), 1.0, rtol=1e-6)  # both TPs

@@ -431,3 +431,50 @@ def test_beam_search_decode():
     be = m.generate(ids, max_new_tokens=5, decode_strategy="beam_search",
                     num_beams=3, eos_token_id=7)
     assert be.shape == [2, 9]
+
+
+def test_beam_search_finished_pool_not_evicted():
+    """A hypothesis that finished on EOS must survive even if live beams
+    later evict it from the active set (finished-pool semantics)."""
+    from paddle_tpu.text.models._decode import beam_search
+
+    class FakeLM:
+        """Scripted LM: token 3=EOS. From prompt [1], the best first step is
+        EOS (logp -1.0); the runner-up path (token 2, -1.2) then decays
+        hard every step, so the final live scores are far below the
+        finished -1.0 hypothesis."""
+
+        training = False
+
+        def sublayers(self, include_self=True):
+            return [self]
+
+        def eval(self):
+            return self
+
+        def __call__(self, t):
+            import jax.numpy as jnp
+
+            import paddle_tpu as paddle
+
+            arr = np.asarray(t._value)
+            N, S = arr.shape
+            V = 6
+            logits = np.full((N, S, V), -20.0, "float32")
+            for n in range(N):
+                last = arr[n, -1]
+                if last == 3:          # after EOS: anything, frozen anyway
+                    logits[n, -1, 3] = 0.0
+                elif last == 1:        # prompt: EOS best, token-2 close
+                    logits[n, -1, 3] = 8.0
+                    logits[n, -1, 2] = 7.8
+                else:                  # continuation: uniform awfulness
+                    logits[n, -1, 4] = 0.0
+                    logits[n, -1, 5] = -0.1
+            return paddle.to_tensor(logits)
+
+    ids = __import__("paddle_tpu").to_tensor(np.int64([[1]]))
+    out = beam_search(FakeLM(), ids, max_new_tokens=4, num_beams=2,
+                      eos_token_id=3)
+    # the finished [1, 3, ...] hypothesis must win over decayed live beams
+    assert out.numpy()[0, 1] == 3, out.numpy()
