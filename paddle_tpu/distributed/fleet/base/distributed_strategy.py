@@ -54,9 +54,18 @@ class DistributedStrategy:
         self.a_sync = False
         self.a_sync_configs = {}
 
+    _PIPELINE_DEFAULTS = {"micro_batch_size": 1, "accumulate_steps": 1,
+                          "schedule_mode": "1F1B"}
+
     def __setattr__(self, k, v):
         if k == "hybrid_configs" and hasattr(self, "hybrid_configs"):
             merged = dict(_HYBRID_DEFAULTS)
+            merged.update(v or {})
+            object.__setattr__(self, k, merged)
+        elif k == "pipeline_configs" and hasattr(self, "pipeline_configs"):
+            # partial dicts keep the documented defaults (reference
+            # strategy protobuf semantics), so schedule_mode never vanishes
+            merged = dict(self._PIPELINE_DEFAULTS)
             merged.update(v or {})
             object.__setattr__(self, k, merged)
         else:

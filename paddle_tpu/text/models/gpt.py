@@ -402,7 +402,7 @@ class GPTForCausalLMPipe(Layer):
     manual pp (x mp x dp)."""
 
     def __init__(self, lm: "GPTForCausalLM" = None, mesh=None, n_micro=1,
-                 batch_axis=None, schedule="gpipe", **kwargs):
+                 batch_axis=None, schedule=None, **kwargs):
         super().__init__()
         self.lm = lm if lm is not None else GPTForCausalLM(**kwargs)
         if mesh is None:
@@ -412,6 +412,30 @@ class GPTForCausalLMPipe(Layer):
             mesh = hcg.mesh if hcg is not None else None
         if mesh is None:
             raise ValueError("GPTForCausalLMPipe needs a mesh (fleet.init first)")
+        if schedule is None:
+            # reference contract: with strategy.pipeline ENABLED,
+            # pipeline_configs['schedule_mode'] selects the schedule
+            # ('F-then-B'/'1F1B'/'Interleave'); otherwise gpipe
+            schedule = "gpipe"
+            try:
+                from ...distributed import fleet as _fleet
+
+                st = _fleet.get_strategy()
+                if st is not None and getattr(st, "pipeline", False):
+                    mode = str(st.pipeline_configs.get(
+                        "schedule_mode", "1F1B")).strip().lower()
+                    table = {"1f1b": "1f1b", "interleave": "interleaved",
+                             "interleaved": "interleaved",
+                             "f-then-b": "gpipe", "gpipe": "gpipe"}
+                    if mode not in table:
+                        import warnings
+
+                        warnings.warn(
+                            f"unknown pipeline schedule_mode {mode!r}; "
+                            "falling back to gpipe (F-then-B)")
+                    schedule = table.get(mode, "gpipe")
+            except Exception:
+                pass
         self._mesh = mesh
         self._n_micro = n_micro
         self._batch_axis = batch_axis

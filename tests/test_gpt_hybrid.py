@@ -169,3 +169,66 @@ def test_gpt_pipe_1f1b_matches_gpipe():
                                    rtol=2e-5, atol=2e-6)
     finally:
         topo.set_hybrid_communicate_group(None)
+
+
+def test_pipe_schedule_from_strategy():
+    """strategy.pipeline_configs['schedule_mode'] selects the pipeline
+    schedule (reference contract) and hybrid training still matches."""
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.text.models import GPTForCausalLMPipe
+
+    import paddle_tpu.distributed.fleet as _fl
+
+    prev_strategy = _fl.get_strategy()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 2}
+    strategy.pipeline = True
+    strategy.pipeline_configs = {"schedule_mode": "1F1B"}
+    # defaults-merge: a partial update keeps schedule_mode
+    strategy.pipeline_configs = {"accumulate_steps": 4}
+    assert strategy.pipeline_configs["schedule_mode"] == "1F1B"
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(0)
+        pipe = GPTForCausalLMPipe(vocab_size=64, hidden_size=32,
+                                  num_hidden_layers=2, num_attention_heads=2,
+                                  max_position_embeddings=32, n_micro=2)
+        assert pipe._schedule == "1f1b"
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(1, 64, (4, 8)).astype("int64"))
+        loss_1f1b = float(pipe(ids, labels=ids))
+        # 1F1B is an execution ORDER: numerics equal the gpipe schedule
+        pipe_ref = GPTForCausalLMPipe(lm=pipe.lm, n_micro=2,
+                                      schedule="gpipe")
+        loss_gpipe = float(pipe_ref(ids, labels=ids))
+        np.testing.assert_allclose(loss_1f1b, loss_gpipe, rtol=2e-5)
+        # Interleave spelling maps and RUNS
+        strategy.pipeline_configs = {"schedule_mode": "Interleave"}
+        paddle.seed(0)
+        pipe2 = GPTForCausalLMPipe(vocab_size=64, hidden_size=32,
+                                   num_hidden_layers=4, num_attention_heads=2,
+                                   max_position_embeddings=32, n_micro=2)
+        assert pipe2._schedule == "interleaved"
+        assert np.isfinite(float(pipe2(ids, labels=ids)))
+        # explicit argument still wins; unknown mode warns
+        pipe3 = GPTForCausalLMPipe(vocab_size=64, hidden_size=32,
+                                   num_hidden_layers=2, num_attention_heads=2,
+                                   max_position_embeddings=32, n_micro=2,
+                                   schedule="gpipe")
+        assert pipe3._schedule == "gpipe"
+        import warnings as _w
+
+        strategy.pipeline_configs = {"schedule_mode": "VPP"}
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            pipe4 = GPTForCausalLMPipe(vocab_size=64, hidden_size=32,
+                                       num_hidden_layers=2,
+                                       num_attention_heads=2,
+                                       max_position_embeddings=32, n_micro=2)
+        assert pipe4._schedule == "gpipe"
+        assert any("schedule_mode" in str(x.message) for x in rec)
+    finally:
+        from paddle_tpu.distributed import topology as topo
+
+        topo.set_hybrid_communicate_group(None)
+        _fl._FLEET["strategy"] = prev_strategy
