@@ -34,8 +34,7 @@ class DistributedStrategy:
         self.sharding = False
         self.sharding_configs = {"stage": 1, "sharding_degree": 1, "offload": False}
         self.pipeline = False
-        self.pipeline_configs = {"micro_batch_size": 1, "accumulate_steps": 1,
-                                 "schedule_mode": "1F1B"}
+        self.pipeline_configs = dict(self._PIPELINE_DEFAULTS)
         self.tensor_parallel = False
         self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
         self.gradient_merge = False
@@ -63,9 +62,10 @@ class DistributedStrategy:
             merged.update(v or {})
             object.__setattr__(self, k, merged)
         elif k == "pipeline_configs" and hasattr(self, "pipeline_configs"):
-            # partial dicts keep the documented defaults (reference
-            # strategy protobuf semantics), so schedule_mode never vanishes
-            merged = dict(self._PIPELINE_DEFAULTS)
+            # partial dicts merge onto the CURRENT config (reference
+            # protobuf assign semantics): earlier settings survive and
+            # schedule_mode never vanishes
+            merged = dict(self.pipeline_configs)
             merged.update(v or {})
             object.__setattr__(self, k, merged)
         else:

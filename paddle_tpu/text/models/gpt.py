@@ -434,7 +434,7 @@ class GPTForCausalLMPipe(Layer):
                             f"unknown pipeline schedule_mode {mode!r}; "
                             "falling back to gpipe (F-then-B)")
                     schedule = table.get(mode, "gpipe")
-            except Exception:
+            except ImportError:  # fleet not importable: single-process use
                 pass
         self._mesh = mesh
         self._n_micro = n_micro

@@ -1,8 +1,8 @@
 """Scalar summary writer (VisualDL / TensorBoard-analog, SURVEY.md §5.5).
 
-Writes JSONL scalar events (always) and mirrors to TensorBoard via
-jax.profiler-compatible layout when tensorboardX is available (it is not in
-this image, so JSONL is the format of record; it is trivially plottable).
+Writes JSONL scalar events (trivially plottable, the greppable record) AND
+real TensorBoard event files via the dependency-free TFRecord/proto encoder
+in :mod:`._tfevents` — point actual TensorBoard at ``logdir``.
 """
 
 from __future__ import annotations
@@ -17,12 +17,16 @@ class SummaryWriter:
         self.logdir = logdir
         os.makedirs(logdir, exist_ok=True)
         self._f = open(os.path.join(logdir, "scalars.jsonl"), "a")
+        from ._tfevents import TFEventWriter
+
+        self._tb = TFEventWriter(logdir)
 
     def add_scalar(self, tag, value, step=None, walltime=None):
         self._f.write(json.dumps({
             "tag": tag, "value": float(value), "step": step,
             "time": walltime or time.time(),
         }) + "\n")
+        self._tb.add_scalar(tag, value, step, walltime)
 
     def add_scalars(self, main_tag, tag_scalar_dict, step=None):
         for k, v in tag_scalar_dict.items():
@@ -34,6 +38,7 @@ class SummaryWriter:
 
     def flush(self):
         self._f.flush()
+        self._tb.flush()
 
     def close(self):
         try:
@@ -41,6 +46,7 @@ class SummaryWriter:
             self._f.close()
         except ValueError:
             pass
+        self._tb.close()
 
     def __enter__(self):
         return self
