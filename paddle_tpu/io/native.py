@@ -1,4 +1,4 @@
-"""ctypes bridge to the C++ input-pipeline kernels (native/paddle_tpu_native.cc).
+"""ctypes bridge to the C++ input-pipeline kernels (paddle_tpu/native/*.cc).
 
 Reference analog: the reference's C++ DataLoader workers and data ops — the
 parts of the runtime that must not run under the Python GIL.  The library
@@ -19,8 +19,8 @@ _LOCK = threading.Lock()
 _LIB = None
 _TRIED = False
 
-_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__)))), "native", "paddle_tpu_native.cc")
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native", "paddle_tpu_native.cc")
 _CACHE = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu")
 
 

@@ -1,0 +1,6 @@
+"""Native C++ runtime sources (SURVEY.md §2.1: data-loader/transform kernels).
+
+The .cc here is built lazily by paddle_tpu.io.native with g++; shipping it as
+package data keeps the wheel pure-Python while still delivering native code
+to installed users.
+"""
