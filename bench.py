@@ -1,17 +1,26 @@
-"""Driver benchmark: ResNet-50 fused-train-step throughput on the real chip.
+"""Driver benchmark: all three BASELINE.md metrics plus roofline evidence.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints ONE JSON line.  Headline metric stays ResNet-50 fused-train-step
+imgs/sec vs a same-run hand-written raw-JAX baseline; the same object now
+carries (VERDICT r3 next-round #1/#2/#4):
 
-vs_baseline compares against a hand-written raw-JAX NHWC bf16 ResNet-50 FULL
-train step (benchmarks/raw_resnet50.py: fwd+bwd, BN batch+running stats, CE,
-momentum+wd update, donated single jit) measured IN THE SAME RUN on the same
-chip — i.e. 1.0 means "the framework trains exactly as fast as expert
-hand-written JAX on identical hardware under identical conditions".  The
-baseline is re-measured each run because the axon-tunneled chip's absolute
-throughput drifts between sessions (round-2 recorded 2707 imgs/s for the
-same raw program; the same-run measurement removes that drift from the
-ratio).  BASELINE.md has no retrievable reference numbers; the v5e-256-pod
-numbers in BASELINE.json are not measurable on one chip.
+- bert:       ERNIE/BERT-base fine-tune samples/sec through the jitted
+              TrainStep vs same-run raw-JAX transformer step (BASELINE #2)
+- allreduce:  psum bus-bandwidth microbench (BASELINE #3; degenerate with
+              n_devices=1 on the single tunneled chip — reported as such,
+              the multi-device path runs on the CPU mesh in tests)
+- roofline:   measured bf16 matmul TFLOP/s + HBM GB/s through this exact
+              dispatch path, so every MFU below is also expressed as a
+              fraction of what THIS chip+tunnel can actually do
+- attention:  Pallas flash kernel vs XLA attention sweep (seq 1k/2k/4k,
+              fwd and fwd+bwd) — measured, replacing README assertions
+- batch sweep 128→256 for ResNet
+
+vs_baseline semantics are unchanged: 1.0 = the framework trains exactly as
+fast as expert hand-written JAX measured in the same run on the same chip
+(the axon tunnel's absolute throughput drifts between sessions; same-run
+ratios cancel that).  MFU fields use the v5e bf16 datasheet peak (197
+TFLOP/s/chip; the ~394 figure floating around is the int8 TOPS line).
 """
 
 import json
@@ -21,7 +30,7 @@ import time
 import numpy as np
 
 
-def measure_framework(B=128, iters=15):
+def _measure_framework_resnet(B=128, iters=15):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     import paddle_tpu.optimizer as opt
@@ -46,20 +55,166 @@ def measure_framework(B=128, iters=15):
     return B / dt
 
 
-def main():
-    B = 128
-    fw_ips = measure_framework(B)
-    from benchmarks.raw_resnet50 import measure as measure_raw
+def _measure_framework_bert(B=64, S=128, iters=15):
+    """BERT-base fine-tune through the fused TrainStep (to_static path)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.text.models import BertForSequenceClassification
 
-    raw_ips = measure_raw(B)
-    print(json.dumps({
+    paddle.seed(0)
+    m = BertForSequenceClassification(num_classes=2)
+    o = opt.AdamW(learning_rate=2e-5, parameters=m.parameters(),
+                  weight_decay=0.01)
+    step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss(),
+                                amp_level="O2", amp_dtype="bfloat16")
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 30522, (B, S)).astype("int64"))
+    y = paddle.to_tensor(rs.randint(0, 2, (B,)).astype("int64"))
+    loss = step(ids, y)
+    float(loss)
+    t0 = time.time()
+    for _ in range(iters):
+        loss = step(ids, y)
+    float(loss)
+    dt = (time.time() - t0) / iters
+    return B / dt
+
+
+def _mfu_fields(flops_per_sec, peak, matmul_tflops):
+    out = {"achieved_tflops": round(flops_per_sec / 1e12, 2),
+           "frac_of_measured_matmul": round(
+               flops_per_sec / (matmul_tflops * 1e12), 3)}
+    if peak:
+        out["mfu_vs_peak"] = round(flops_per_sec / peak, 3)
+    return out
+
+
+# Each section runs in its OWN subprocess with a fresh TPU context: device
+# state left by one section (live HBM buffers, executable caches) measurably
+# poisons the next — observed: the raw BERT step at 457 samples/s alone vs
+# 2.9 samples/s after the framework section ran in the same process.  One
+# process at a time holds the chip; sections run sequentially.
+def _section(name):
+    import os
+    import subprocess
+
+    env = dict(os.environ, BENCH_SECTION=name)
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       capture_output=True, text=True, env=env,
+                       cwd=os.path.dirname(os.path.abspath(__file__)))
+    if r.returncode != 0:
+        raise RuntimeError(f"bench section {name} failed:\n{r.stdout}\n{r.stderr}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def _run_section(name):
+    from benchmarks import micro
+
+    if name == "roofline":
+        kind, peak = micro.device_peak_flops()
+        return {"kind": kind, "peak": peak,
+                "matmul_tflops": micro.matmul_tflops(),
+                "hbm_gbs": micro.hbm_bandwidth_gbs()}
+    if name == "resnet":
+        return {"fw128": _measure_framework_resnet(128),
+                "fw256": _measure_framework_resnet(256)}
+    if name == "resnet_raw":
+        from benchmarks.raw_resnet50 import measure as measure_raw_resnet
+
+        return {"raw128": measure_raw_resnet(128),
+                "raw256": measure_raw_resnet(256)}
+    if name == "bert":
+        return {"fw": _measure_framework_bert(64, 128)}
+    if name == "bert_raw":
+        from benchmarks.raw_bert import measure as measure_raw_bert
+
+        return {"raw": measure_raw_bert(64, 128)}
+    if name == "allreduce":
+        bw, n = micro.allreduce_bus_bw()
+        return {"bw": bw, "n": n}
+    if name == "attention":
+        return {"sweep": micro.attention_sweep()}
+    raise ValueError(name)
+
+
+def main():
+    import os
+
+    section = os.environ.get("BENCH_SECTION")
+    if section:
+        print(json.dumps(_run_section(section)))
+        return
+
+    from benchmarks.raw_resnet50 import fwd_flops_per_image
+    from benchmarks.raw_bert import train_flops_per_token
+
+    roof = _section("roofline")
+    kind, peak = roof["kind"], roof["peak"]
+    mm_tflops, hbm_gbs = roof["matmul_tflops"], roof["hbm_gbs"]
+
+    # --- BASELINE #1: ResNet-50 ---
+    B = 128
+    rn = _section("resnet")
+    rn_raw = _section("resnet_raw")
+    fw_ips, fw_ips_256 = rn["fw128"], rn["fw256"]
+    raw_ips, raw_ips_256 = rn_raw["raw128"], rn_raw["raw256"]
+    rn_train_flops = 3 * fwd_flops_per_image()
+
+    # --- BASELINE #2: BERT/ERNIE-base fine-tune ---
+    BB, S = 64, 128
+    bert_fw = _section("bert")["fw"]
+    bert_raw = _section("bert_raw")["raw"]
+    bert_flops = train_flops_per_token(S) * S  # per sample
+
+    # --- BASELINE #3: allreduce bus bandwidth ---
+    ar = _section("allreduce")
+    ar_bw, n_dev = ar["bw"], ar["n"]
+
+    # --- attention kernel sweep ---
+    attn = _section("attention")["sweep"]
+
+    out = {
         "metric": "resnet50_train_imgs_per_sec",
         "value": round(fw_ips, 1),
         "unit": "imgs/sec (bf16 O2, B=128, fused train step, 1 chip)",
         "vs_baseline": round(fw_ips / raw_ips, 3),
         "baseline_imgs_per_sec_same_run": round(raw_ips, 1),
         "baseline": "hand-written raw-JAX NHWC bf16 full train step, same run/chip",
-    }))
+        "device_kind": kind,
+        "roofline": {
+            "matmul_bf16_tflops_measured": round(mm_tflops, 1),
+            "hbm_gbs_measured": round(hbm_gbs, 1),
+            "peak_bf16_tflops_datasheet": peak / 1e12 if peak else None,
+            "matmul_frac_of_peak": round(mm_tflops * 1e12 / peak, 3) if peak else None,
+        },
+        "resnet50_mfu": _mfu_fields(fw_ips * rn_train_flops, peak, mm_tflops),
+        "batch_sweep": {
+            "b256_imgs_per_sec": round(fw_ips_256, 1),
+            "b256_vs_baseline": round(fw_ips_256 / raw_ips_256, 3),
+            "b256_baseline_same_run": round(raw_ips_256, 1),
+        },
+        "bert_base_finetune": {
+            "metric": "ernie3_base_ft_samples_per_sec",
+            "value": round(bert_fw, 1),
+            "unit": f"samples/sec (bf16 O2, B={BB}, seq={S}, fused train step, 1 chip)",
+            "vs_baseline": round(bert_fw / bert_raw, 3),
+            "baseline_samples_per_sec_same_run": round(bert_raw, 1),
+            "baseline": "hand-written raw-JAX BERT-base AdamW step, same run/chip",
+            "mfu": _mfu_fields(bert_fw * bert_flops, peak, mm_tflops),
+        },
+        "allreduce": {
+            "metric": "allreduce_bus_bandwidth_gbs",
+            "value": round(ar_bw, 1) if ar_bw else None,
+            "n_devices": n_dev,
+            "note": ("single tunneled chip: cross-chip collective not "
+                     "measurable; multi-device psum path validated on the "
+                     "8-device CPU mesh in tests/test_bench_micro.py"
+                     if n_dev < 2 else "psum over 1-axis mesh, ring bus-bw convention"),
+        },
+        "attention_pallas_vs_xla": attn,
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
