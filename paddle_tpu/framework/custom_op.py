@@ -1,0 +1,134 @@
+"""Custom-operator plugin surface (SURVEY.md §2.1 custom-operator row).
+
+The reference lets users build C++/CUDA ops out-of-tree (``PD_BUILD_OP`` +
+``paddle.utils.cpp_extension.load`` / ``load_op_library``) that then behave
+like built-ins: callable from Python, autograd-aware, usable in static
+graphs.  The TPU-native equivalent maps exactly onto jax's extension
+points — a custom op is a pure function over jax arrays (typically a Pallas
+kernel for the hand-tuned case), its gradient is a ``jax.custom_vjp`` pair,
+and "behaving like a built-in" means dispatching through the same
+``tensor.dispatch.apply`` registry every framework op uses, so it is
+tape-recorded in eager mode and traces transparently under ``to_static`` /
+``TrainStep``.
+
+    def swish_fwd(x, beta):            # pallas_call or plain jax
+        ...
+    def swish_vjp_fwd(x, beta): ...
+    def swish_vjp_bwd(res, g): ...
+
+    op = paddle.register_op("fused_swish", swish_fwd,
+                            vjp=(swish_vjp_fwd, swish_vjp_bwd))
+    y = op(x_tensor, 1.0)              # or paddle.ops.fused_swish(...)
+
+``load_op_library(path)`` keeps the reference's entry-point shape: it loads
+a Python plugin file whose top level registers ops (the TPU analog of
+dlopen'ing a .so full of PD_BUILD_OP registrations).
+"""
+
+from __future__ import annotations
+
+import runpy
+from typing import Callable, Sequence
+
+import jax
+
+_REGISTRY: dict[str, "CustomOp"] = {}
+
+
+class CustomOp:
+    """A registered custom op: callable on Tensors, dispatchable, jittable."""
+
+    def __init__(self, name: str, fn: Callable, raw_fn: Callable):
+        self.name = name
+        self.fn = fn          # grad-aware (custom_vjp applied if given)
+        self.raw_fn = raw_fn  # the user's original kernel
+        self.__name__ = name
+
+    def __call__(self, *args, **kwargs):
+        from ..tensor import dispatch
+
+        return dispatch.apply(self.fn, *args, op_name=self.name, **kwargs)
+
+    def __repr__(self):
+        return f"<CustomOp {self.name}>"
+
+
+def register_op(name: str, fn: Callable | None = None, *,
+                vjp: Sequence[Callable] | Callable | None = None,
+                method: bool = False, override: bool = False):
+    """Install ``fn`` (jax arrays in/out — e.g. a Pallas kernel) as a
+    first-class dispatchable op named ``name``.
+
+    Args:
+        fn: pure function over jax arrays.  Omit to use as a decorator.
+        vjp: gradient rule.  Either a ``(fwd, bwd)`` pair with
+            ``jax.custom_vjp`` semantics (``fwd(*args) -> (out, residuals)``,
+            ``bwd(residuals, g) -> grads tuple``), or a single ``bwd(res, g)``
+            whose residuals are the op's inputs.  None = differentiate
+            through ``fn`` with ordinary AD.
+        method: also attach as a ``Tensor`` method.
+        override: allow replacing an existing registration.
+
+    Returns the :class:`CustomOp` (callable with Tensors; also reachable as
+    ``paddle_tpu.ops.<name>``).
+    """
+    if fn is None:
+        return lambda f: register_op(name, f, vjp=vjp, method=method,
+                                     override=override)
+    if not name.isidentifier():
+        raise ValueError(f"op name {name!r} is not a valid identifier")
+    if name in _REGISTRY and not override:
+        raise ValueError(f"op {name!r} already registered "
+                         "(pass override=True to replace)")
+    from .. import ops as ops_ns
+    if hasattr(ops_ns, name) and name not in _REGISTRY and not override:
+        raise ValueError(f"op name {name!r} collides with a built-in op")
+
+    grad_fn = fn
+    if vjp is not None:
+        if callable(vjp):
+            bwd = vjp
+
+            def _auto_fwd(*args):
+                return fn(*args), args
+
+            fwd_rule, bwd_rule = _auto_fwd, bwd
+        else:
+            fwd_rule, bwd_rule = vjp
+        grad_fn = jax.custom_vjp(fn)
+        grad_fn.defvjp(fwd_rule, bwd_rule)
+
+    op = CustomOp(name, grad_fn, fn)
+    _REGISTRY[name] = op
+    setattr(ops_ns, name, op)
+    if method:
+        from ..tensor.tensor import Tensor
+
+        setattr(Tensor, name, lambda self, *a, **kw: op(self, *a, **kw))
+    return op
+
+
+def get_op(name: str) -> CustomOp | None:
+    return _REGISTRY.get(name)
+
+
+def deregister_op(name: str) -> None:
+    """Remove a registration (tests / plugin reload)."""
+    op = _REGISTRY.pop(name, None)
+    if op is not None:
+        from .. import ops as ops_ns
+
+        if getattr(ops_ns, name, None) is op:
+            delattr(ops_ns, name)
+
+
+def load_op_library(path: str) -> list[str]:
+    """Load a plugin file whose top level calls :func:`register_op`.
+
+    Reference analog: ``paddle.incubate.load_op_library('custom.so')`` —
+    here the plugin is Python registering Pallas/jax kernels.  Returns the
+    names the plugin registered.
+    """
+    before = set(_REGISTRY)
+    runpy.run_path(path, run_name=f"paddle_tpu_plugin")
+    return sorted(set(_REGISTRY) - before)

@@ -2,6 +2,8 @@
 
 from . import download  # noqa: F401
 from .summary_writer import SummaryWriter  # noqa: F401
+# custom-op plugin surface (reference: paddle.utils.cpp_extension / PD_BUILD_OP)
+from ..framework.custom_op import register_op, load_op_library  # noqa: F401
 
 
 def try_import(module_name, err_msg=None):

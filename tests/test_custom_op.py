@@ -1,0 +1,177 @@
+"""Custom-operator plugin surface (SURVEY.md §2.1 custom-operator row:
+PD_BUILD_OP / load_op_library -> register_op over Pallas + jax.custom_vjp).
+
+The flagship test registers a REAL Pallas kernel (fused scaled-swish) with a
+hand-written VJP and trains it inside the fused TrainStep — the full
+"user kernel behaves like a built-in" contract: eager tape, to_static
+tracing, gradients, optimizer update.
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.framework import custom_op
+
+# CPU CI runs the kernel in pallas interpret mode; on TPU it compiles to
+# Mosaic for real (same code path the shipped flash-attention kernels use)
+INTERPRET = jax.default_backend() != "tpu"
+
+
+def _swish_kernel(x_ref, o_ref, *, beta):
+    x = x_ref[...]
+    o_ref[...] = (x * jax.nn.sigmoid(beta * x)).astype(o_ref.dtype)
+
+
+def _swish_pallas(x, beta=1.0):
+    from jax.experimental import pallas as pl
+    import functools
+
+    return pl.pallas_call(
+        functools.partial(_swish_kernel, beta=beta),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=INTERPRET,
+    )(x)
+
+
+def _swish_fwd(x, beta=1.0):
+    return _swish_pallas(x, beta), (x, beta)
+
+
+def _swish_bwd(res, g):
+    x, beta = res
+    s = jax.nn.sigmoid(beta * x)
+    return (g * (s + beta * x * s * (1 - s)),)
+
+
+@pytest.fixture
+def swish_op():
+    op = custom_op.register_op("fused_swish", lambda x: _swish_pallas(x),
+                               vjp=(lambda x: _swish_fwd(x), _swish_bwd),
+                               override=True)
+    yield op
+    custom_op.deregister_op("fused_swish")
+
+
+def _ref_swish(x):
+    return x * (1.0 / (1.0 + np.exp(-x)))
+
+
+def test_register_and_call_eager(swish_op):
+    x = paddle.to_tensor(np.linspace(-3, 3, 12, dtype="float32"))
+    y = paddle.ops.fused_swish(x)
+    np.testing.assert_allclose(y.numpy(), _ref_swish(x.numpy()), rtol=1e-5)
+
+
+def test_eager_grad_uses_custom_vjp(swish_op):
+    xn = np.linspace(-2, 2, 8, dtype="float32")
+    x = paddle.to_tensor(xn, stop_gradient=False)
+    y = swish_op(x)
+    y.sum().backward()
+    s = 1.0 / (1.0 + np.exp(-xn))
+    expect = s + xn * s * (1 - s)
+    np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-5)
+
+
+def test_traced_under_jit(swish_op):
+    @paddle.jit.to_static
+    def f(x):
+        return paddle.ops.fused_swish(x) * 2.0
+
+    x = paddle.to_tensor(np.ones(4, dtype="float32"))
+    np.testing.assert_allclose(f(x).numpy(), 2 * _ref_swish(np.ones(4)),
+                               rtol=1e-5)
+
+
+def test_name_collision_and_override():
+    with pytest.raises(ValueError):
+        custom_op.register_op("flash_attention", lambda x: x)
+    op1 = custom_op.register_op("tmp_op_xyz", lambda x: x)
+    try:
+        with pytest.raises(ValueError):
+            custom_op.register_op("tmp_op_xyz", lambda x: x + 1)
+        op2 = custom_op.register_op("tmp_op_xyz", lambda x: x + 1,
+                                    override=True)
+        assert custom_op.get_op("tmp_op_xyz") is op2
+    finally:
+        custom_op.deregister_op("tmp_op_xyz")
+    assert custom_op.get_op("tmp_op_xyz") is None
+
+
+def test_bwd_only_vjp_spelling():
+    # vjp=<bwd fn> uses the inputs as residuals
+    op = custom_op.register_op(
+        "tmp_square", lambda x: x * x,
+        vjp=lambda res, g: (g * 2.0 * res[0],), override=True)
+    try:
+        x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        y = op(x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+    finally:
+        custom_op.deregister_op("tmp_square")
+
+
+def test_method_attachment():
+    op = custom_op.register_op("tmp_triple", lambda x: 3 * x, method=True,
+                               override=True)
+    try:
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        np.testing.assert_allclose(x.tmp_triple().numpy(), [6.0])
+    finally:
+        custom_op.deregister_op("tmp_triple")
+        from paddle_tpu.tensor.tensor import Tensor
+
+        delattr(Tensor, "tmp_triple")
+
+
+def test_custom_pallas_op_trains_in_train_step(swish_op):
+    """A user Pallas kernel as the activation of a small MLP, trained through
+    the fused TrainStep — gradients flow through the custom VJP inside one
+    compiled XLA program."""
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(16, 32)
+            self.l2 = nn.Linear(32, 4)
+
+        def forward(self, x):
+            return self.l2(paddle.ops.fused_swish(self.l1(x)))
+
+    paddle.seed(0)
+    m = Net()
+    o = opt.Adam(learning_rate=1e-2, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss())
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(32, 16).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 4, (32,)).astype("int64"))
+    losses = [float(step(x, y)) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_load_op_library(tmp_path):
+    plugin = tmp_path / "my_ops_plugin.py"
+    plugin.write_text(
+        "import paddle_tpu as paddle\n"
+        "paddle.register_op('tmp_plugin_relu6',\n"
+        "                   lambda x: x.clip(0.0, 6.0) if hasattr(x, 'clip')"
+        " else x, override=True)\n"
+        "import jax.numpy as jnp\n"
+        "paddle.register_op('tmp_plugin_neg', lambda x: -x, override=True)\n")
+    names = paddle.load_op_library(str(plugin))
+    try:
+        assert set(names) == {"tmp_plugin_relu6", "tmp_plugin_neg"}
+        x = paddle.to_tensor(np.array([-1.0, 7.0], np.float32))
+        np.testing.assert_allclose(paddle.ops.tmp_plugin_neg(x).numpy(),
+                                   [1.0, -7.0])
+    finally:
+        for n in names:
+            custom_op.deregister_op(n)
