@@ -6,6 +6,8 @@ row: "fused attention/ffn become Pallas kernels") and softmax_mask_fuse.
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import asp  # noqa: F401
+from . import fp8  # noqa: F401
 from .nn import functional  # noqa: F401
 from .optimizer import ExponentialMovingAverage, LookAhead, ModelAverage  # noqa: F401
 

