@@ -45,3 +45,59 @@ class ElasticSupervisor:
                 print(f"[elastic] restart {restarts}/{self.max_restarts} "
                       f"from step {self.manager.latest_step()}")
                 time.sleep(self.backoff * restarts)
+
+
+class PodSupervisor:
+    """Process-level elastic supervision (reference: launch master heartbeat
+    + elastic pod restart, SURVEY.md §5.3 / §3.5 "(on failure & elastic on)
+    kill pod -> re-rendezvous -> restart").
+
+    Spawns one worker process per host, watches them, and on ANY worker
+    dying (crash, OOM-kill, SIGKILL) kills the remaining pod, re-builds the
+    rendezvous (fresh coordinator address — the coordination service of the
+    dead job must not be rejoined), and relaunches.  Workers are expected
+    to resume from their CheckpointManager's latest step (the in-process
+    ElasticSupervisor above, or equivalent restore logic).
+
+    ``make_workers(attempt) -> list[(argv, env)]`` builds the pod for a
+    given attempt; returning fresh ports per attempt is the caller's
+    re-rendezvous hook.
+    """
+
+    def __init__(self, make_workers, max_restarts=3, poll_seconds=0.2):
+        self.make_workers = make_workers
+        self.max_restarts = max_restarts
+        self.poll = poll_seconds
+
+    def run(self):
+        import subprocess
+
+        attempt = 0
+        while True:
+            specs = self.make_workers(attempt)
+            procs = [subprocess.Popen(argv, env=env) for argv, env in specs]
+            failed = False
+            try:
+                while True:
+                    states = [p.poll() for p in procs]
+                    if any(rc not in (None, 0) for rc in states):
+                        failed = True
+                        break
+                    if all(rc == 0 for rc in states):
+                        return 0
+                    time.sleep(self.poll)
+            finally:
+                # kill the pod: survivors of a failed attempt must not
+                # linger holding the old rendezvous
+                if failed:
+                    for p in procs:
+                        if p.poll() is None:
+                            p.kill()
+                    for p in procs:
+                        p.wait()
+            attempt += 1
+            if attempt > self.max_restarts:
+                raise RuntimeError(
+                    f"pod failed {attempt} times (max_restarts="
+                    f"{self.max_restarts})")
+            print(f"[elastic] pod restart {attempt}/{self.max_restarts}")

@@ -18,9 +18,54 @@ from ..tensor.tensor import Tensor
 
 
 def _to_arrays(tree):
-    return jax.tree_util.tree_map(
+    tree = jax.tree_util.tree_map(
         lambda v: v._value if isinstance(v, Tensor) else v, tree,
         is_leaf=lambda v: isinstance(v, Tensor))
+    return _globalize(tree)
+
+
+def _globalize(tree):
+    """Multi-process save support: orbax refuses process-local arrays in a
+    multi-host job.  Replicated (per-process identical) leaves — the normal
+    state_dict case under data parallelism — become fully-replicated GLOBAL
+    arrays; already-global (sharded) leaves pass through."""
+    if jax.process_count() == 1:
+        return tree
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental import multihost_utils as mh
+
+    mesh = Mesh(np.asarray(jax.devices()), ("_ckpt",))
+
+    def conv(v):
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            return v  # already a global (sharded) array
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            return mh.host_local_array_to_global_array(
+                np.asarray(v), mesh, P())
+        return v
+
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def _localize(tree):
+    """Restore-side inverse of _globalize: fully-replicated global arrays
+    become ordinary process-local arrays so eager compute can use them."""
+    if jax.process_count() == 1:
+        return tree
+
+    import jax.numpy as jnp
+
+    def conv(v):
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            # only REPLICATED global arrays localize (addressable_data(0)
+            # is the whole value); a genuinely sharded array must stay
+            # global — its first shard would silently truncate it
+            if v.is_fully_replicated:
+                return jnp.asarray(v.addressable_data(0))
+            return v
+        return v
+
+    return jax.tree_util.tree_map(conv, tree)
 
 
 def _checkpointer():
@@ -60,9 +105,9 @@ def load_checkpoint(path, template=None, shardings=None, to_tensors=True):
         tmpl = _to_arrays(template)
 
         def abstract(v, sh=None):
-            shape = tuple(v.shape) if hasattr(v, "shape") else ()
-            dtype = v.dtype if hasattr(v, "dtype") else np.float32
-            return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
+            if not hasattr(v, "shape"):
+                return v  # non-array leaf (step counters...): as saved
+            return jax.ShapeDtypeStruct(tuple(v.shape), v.dtype, sharding=sh)
 
         if shardings is not None:
             flat_t, treedef = jax.tree_util.tree_flatten(tmpl)
@@ -73,6 +118,8 @@ def load_checkpoint(path, template=None, shardings=None, to_tensors=True):
         out = ckptr.restore(path, tmpl)
     else:
         out = ckptr.restore(path)
+    if shardings is None:
+        out = _localize(out)
     if to_tensors:
         out = jax.tree_util.tree_map(lambda v: Tensor(v) if hasattr(v, "shape") else v, out)
     return out
@@ -113,6 +160,8 @@ class CheckpointManager:
             tmpl = _to_arrays(template)
 
             def abstract(v, sh=None):
+                if not hasattr(v, "shape"):
+                    return v  # non-array leaf: restore as saved
                 return jax.ShapeDtypeStruct(tuple(v.shape), v.dtype, sharding=sh)
 
             if shardings is not None:
@@ -124,6 +173,8 @@ class CheckpointManager:
                 tmpl = jax.tree_util.tree_map(abstract, tmpl)
             args = ocp.args.StandardRestore(tmpl)
         out = self._mgr.restore(step, args=args)
+        if shardings is None:
+            out = _localize(out)
         if to_tensors:
             out = jax.tree_util.tree_map(
                 lambda v: Tensor(v) if hasattr(v, "shape") else v, out)

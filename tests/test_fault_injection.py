@@ -1,0 +1,157 @@
+"""Real fault injection (SURVEY.md §5.3; VERDICT r3 missing #5): SIGKILL one
+worker of a 2-process CPU mesh mid-training, let the PodSupervisor kill the
+pod, re-rendezvous and relaunch, and assert the resumed run reproduces the
+uninterrupted run's loss curve exactly.
+
+Worker design: deterministic MLP training (fixed data, fixed init) with a
+per-step orbax checkpoint (params + optimizer state + momentum), each rank
+appending its per-step losses to a shared log.  Rank 1 SIGKILLs itself at
+step 3 of attempt 0 — a real process death, not an exception — so recovery
+exercises the supervisor's pod-kill + restart path and the restore path
+both.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import json, os, signal, sys
+os.environ.pop("XLA_FLAGS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+import paddle_tpu.distributed as dist
+from paddle_tpu.io.checkpoint import CheckpointManager
+
+dist.init_parallel_env()
+rank = dist.get_rank()
+
+TOTAL_STEPS = 8
+KILL_AT = int(os.environ.get("KILL_AT_STEP", "-1"))
+ckpt_dir = os.environ["CKPT_DIR"]
+loss_log = os.environ["LOSS_LOG"]
+
+paddle.seed(0)
+m = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 4))
+o = opt.Momentum(learning_rate=0.05, momentum=0.9, parameters=m.parameters())
+lossf = nn.CrossEntropyLoss()
+rs = np.random.RandomState(7)
+x = paddle.to_tensor(rs.randn(32, 16).astype("float32"))
+y = paddle.to_tensor(rs.randint(0, 4, (32,)).astype("int64"))
+
+mgr = CheckpointManager(ckpt_dir, max_to_keep=2)
+
+
+def pack():
+    return {"model": {k: v for k, v in m.state_dict().items()},
+            "opt": o.state_dict()}
+
+
+start = mgr.latest_step()
+if start is not None:
+    state = mgr.restore(start)
+    m.set_state_dict(state["model"])
+    o.set_state_dict(state["opt"])
+    start += 1
+else:
+    start = 0
+
+for step in range(start, TOTAL_STEPS):
+    l = lossf(m(x), y)
+    l.backward()
+    o.step()
+    o.clear_grad()
+    if rank == 0:
+        with open(loss_log, "a") as f:
+            f.write(json.dumps({"step": step, "loss": float(l)}) + "\n")
+    mgr.save(step, pack(), force=True)
+    mgr.wait_until_finished()
+    if step == KILL_AT and rank == 1:
+        os.kill(os.getpid(), signal.SIGKILL)   # real process death
+
+print(f"WORKER_DONE rank={rank}", flush=True)
+"""
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _run_pod(tmp_path, tag, kill_at_step):
+    """Run a 2-worker pod under the PodSupervisor; returns the loss curve."""
+    from paddle_tpu.distributed.elastic import PodSupervisor
+
+    script = tmp_path / f"worker_{tag}.py"
+    script.write_text(_WORKER)
+    ckpt_dir = tmp_path / f"ckpt_{tag}"
+    loss_log = tmp_path / f"losses_{tag}.jsonl"
+    kill_marker = tmp_path / f"killed_{tag}"
+
+    def make_workers(attempt):
+        p0, p1 = _free_ports(2)
+        eps = f"127.0.0.1:{p0},127.0.0.1:{p1}"
+        specs = []
+        for rank in range(2):
+            env = {k: v for k, v in os.environ.items()
+                   if not k.startswith(("PADDLE_", "JAX_COORD"))}
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PADDLE_TRAINER_ENDPOINTS"] = eps
+            env["PADDLE_TRAINERS_NUM"] = "2"
+            env["PADDLE_TRAINER_ID"] = str(rank)
+            env["PADDLE_CURRENT_ENDPOINT"] = eps.split(",")[rank]
+            env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+            env["CKPT_DIR"] = str(ckpt_dir)
+            env["LOSS_LOG"] = str(loss_log)
+            # inject the fault only on the FIRST attempt
+            if kill_at_step >= 0 and not kill_marker.exists():
+                env["KILL_AT_STEP"] = str(kill_at_step)
+            specs.append(([sys.executable, str(script)], env))
+        if kill_at_step >= 0:
+            kill_marker.write_text("armed")  # next attempt runs clean
+        return specs
+
+    rc = PodSupervisor(make_workers, max_restarts=2).run()
+    assert rc == 0
+    curve = {}
+    with open(loss_log) as f:
+        for line in f:
+            rec = json.loads(line)
+            curve[rec["step"]] = rec["loss"]  # resume overwrites later steps
+    return curve
+
+
+def test_sigkill_worker_resumes_and_matches_uninterrupted(tmp_path):
+    interrupted = _run_pod(tmp_path, "faulty", kill_at_step=3)
+    control = _run_pod(tmp_path, "control", kill_at_step=-1)
+
+    assert set(control) == set(range(8))
+    # every step present after recovery, including the re-run of step 4+
+    assert set(interrupted) == set(range(8))
+    for step in range(8):
+        np.testing.assert_allclose(
+            interrupted[step], control[step], rtol=1e-6,
+            err_msg=f"loss diverged at step {step} after fault recovery")
