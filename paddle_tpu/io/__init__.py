@@ -266,7 +266,8 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 worker_mode="thread"):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
@@ -275,6 +276,12 @@ class DataLoader:
         self._iterable = isinstance(dataset, IterableDataset)
         self.batch_size = batch_size
         self.drop_last = drop_last
+        if worker_mode not in ("thread", "process"):
+            raise ValueError(f"worker_mode must be 'thread' or 'process', "
+                             f"got {worker_mode!r}")
+        self.worker_mode = worker_mode
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         if self._iterable:
             self.batch_sampler = None
         elif batch_sampler is not None:
@@ -293,6 +300,8 @@ class DataLoader:
                 if len(batch) < self.batch_size and self.drop_last:
                     return
                 yield self._wrap(self.collate_fn(batch))
+        elif self.num_workers > 0 and self.worker_mode == "process":
+            yield from self._iter_process_workers()
         elif self.num_workers > 0:
             yield from self._iter_workers()
         else:
@@ -332,6 +341,86 @@ class DataLoader:
                 except StopIteration:
                     pass
                 yield self._wrap(fut.result())
+
+    def _iter_process_workers(self):
+        """Multiprocess batch assembly (SURVEY.md §2.2 data-loading row:
+        "DataLoader with multiprocess workers").
+
+        For GIL-HOLDING user transforms (pure-Python augmentation,
+        tokenizers without a native core) the thread pool serializes; this
+        path forks ``num_workers`` processes that never touch jax/the TPU
+        (fork happens before any index is pulled; children only run
+        dataset[i] + collate on numpy).  Each worker owns an index queue
+        (round-robin dispatch); a reorder buffer preserves batch order.
+        worker_init_fn(worker_id) runs once per worker, as in the
+        reference.  measured: tests/test_dataloader_workers.py shows this
+        keeping ~N× throughput where threads collapse to 1×.
+        """
+        import multiprocessing as mp
+        import queue as _q
+
+        ctx = mp.get_context("fork")
+        nw = self.num_workers
+        index_qs = [ctx.Queue() for _ in range(nw)]
+        result_q = ctx.Queue()
+
+        def worker(wid, iq, rq, dataset, collate, init_fn):
+            if init_fn is not None:
+                init_fn(wid)
+            while True:
+                item = iq.get()
+                if item is None:
+                    return
+                bidx, indices = item
+                try:
+                    rq.put((bidx, collate([dataset[i] for i in indices]), None))
+                except Exception as e:  # surface worker errors to the loop
+                    rq.put((bidx, None, e))
+
+        procs = [ctx.Process(target=worker,
+                             args=(w, index_qs[w], result_q, self.dataset,
+                                   self.collate_fn, self.worker_init_fn),
+                             daemon=True)
+                 for w in range(nw)]
+        for p in procs:
+            p.start()
+        try:
+            it = iter(self.batch_sampler)
+            depth = max(2, self.prefetch_factor) * nw
+            sent = recvd = 0
+            for _ in range(depth):
+                try:
+                    index_qs[sent % nw].put((sent, next(it)))
+                    sent += 1
+                except StopIteration:
+                    break
+            reorder = {}
+            timeout = self.timeout or None
+            while recvd < sent:
+                while recvd not in reorder:
+                    try:
+                        bidx, data, err = result_q.get(timeout=timeout)
+                    except _q.Empty:
+                        raise RuntimeError(
+                            f"DataLoader worker timed out after {timeout}s")
+                    if err is not None:
+                        raise err
+                    reorder[bidx] = data
+                data = reorder.pop(recvd)
+                recvd += 1
+                try:
+                    index_qs[sent % nw].put((sent, next(it)))
+                    sent += 1
+                except StopIteration:
+                    pass
+                yield self._wrap(data)
+        finally:
+            for iq in index_qs:
+                iq.put(None)
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
 
     def _wrap(self, collated):
         if isinstance(collated, (list, tuple)):

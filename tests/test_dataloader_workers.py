@@ -1,0 +1,126 @@
+"""Multiprocess DataLoader workers (SURVEY.md §2.2 data-loading row;
+VERDICT r3 missing #6: the claim that the input pipeline keeps a train step
+fed must be MEASURED, not asserted).
+
+The throughput test uses a deliberately GIL-holding transform (pure-Python
+arithmetic loop): thread workers serialize on the GIL, process workers
+parallelize.  The artifact the verdict asked for is the measured ratio.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class _GilHeavyDataset(Dataset):
+    """Each sample burns ~3 ms of pure-Python bytecode (GIL held)."""
+
+    def __init__(self, n=64, work=300000):
+        self.n = n
+        self.work = work
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        acc = 0
+        for k in range(self.work):  # GIL-bound on purpose
+            acc += k * k % 7
+        return np.full((8,), float(i + acc % 2), np.float32), np.int64(i % 4)
+
+
+class _NumpyDataset(Dataset):
+    def __init__(self, n=32):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        rs = np.random.RandomState(i)
+        return rs.rand(4, 4).astype("float32"), np.int64(i % 2)
+
+
+def _drain(loader):
+    t0 = time.time()
+    batches = [b for b in loader]
+    return time.time() - t0, batches
+
+
+def test_process_workers_correctness():
+    ds = _NumpyDataset(32)
+    ref = [b for b in DataLoader(ds, batch_size=8, num_workers=0)]
+    got = [b for b in DataLoader(ds, batch_size=8, num_workers=3,
+                                 worker_mode="process")]
+    assert len(ref) == len(got) == 4
+    for (rx, ry), (gx, gy) in zip(ref, got):
+        np.testing.assert_array_equal(rx.numpy(), gx.numpy())
+        np.testing.assert_array_equal(ry.numpy(), gy.numpy())
+
+
+def test_worker_init_fn_runs_per_process():
+    import multiprocessing as mp
+
+    ids = mp.get_context("fork").Queue()
+    loader = DataLoader(_NumpyDataset(16), batch_size=4, num_workers=2,
+                        worker_mode="process",
+                        worker_init_fn=lambda wid: ids.put(wid))
+    list(loader)
+    seen = set()
+    while not ids.empty():
+        seen.add(ids.get())
+    assert seen == {0, 1}
+
+
+def test_worker_error_propagates():
+    class Bad(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if i == 5:
+                raise ValueError("boom at 5")
+            return np.zeros(2, np.float32)
+
+    loader = DataLoader(Bad(), batch_size=2, num_workers=2,
+                        worker_mode="process")
+    with pytest.raises(ValueError, match="boom at 5"):
+        list(loader)
+
+
+def test_bad_worker_mode_rejected():
+    with pytest.raises(ValueError):
+        DataLoader(_NumpyDataset(4), worker_mode="greenlet")
+
+
+def test_process_workers_beat_threads_under_gil_heavy_transform():
+    """The measured artifact: 4 process workers vs 4 thread workers on a
+    GIL-bound transform.  Threads serialize (~1x single-stream); processes
+    genuinely parallelize.  Demand a conservative 1.5x to stay robust on a
+    loaded CI host.  Needs >=2 usable cores — on a 1-core container the
+    ratio is physically capped at 1x, so only correctness is checkable."""
+    import os
+
+    usable = len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity") \
+        else (os.cpu_count() or 1)
+    if usable < 2:
+        pytest.skip(f"only {usable} usable CPU core(s): process-vs-thread "
+                    "throughput is not measurable here")
+    ds = _GilHeavyDataset(n=64)
+    thread_loader = DataLoader(ds, batch_size=8, num_workers=4)
+    process_loader = DataLoader(ds, batch_size=8, num_workers=4,
+                                worker_mode="process")
+    # warm both paths once (process startup, thread pool spinup)
+    _drain(DataLoader(_GilHeavyDataset(n=8), batch_size=8, num_workers=4,
+                      worker_mode="process"))
+    t_thread, b1 = _drain(thread_loader)
+    t_proc, b2 = _drain(process_loader)
+    assert len(b1) == len(b2) == 8
+    speedup = t_thread / t_proc
+    print(f"gil-heavy loader speedup process/thread = {speedup:.2f}x "
+          f"(thread {t_thread:.2f}s, process {t_proc:.2f}s)")
+    assert speedup > 1.5, (t_thread, t_proc)
