@@ -26,7 +26,8 @@ from .topology import (  # noqa: F401
 )
 from .auto_parallel import (  # noqa: F401
     ProcessMesh, shard_tensor, shard_layer, shard_op, Shard, Replicate, Partial,
-    reshard, dtensor_from_fn, unshard_dtensor,
+    reshard, dtensor_from_fn, dtensor_from_local, unshard_dtensor,
+    get_dist_attr, DistModel, to_static, save_state_dict, load_state_dict,
 )
 
 import importlib as _importlib

@@ -70,8 +70,12 @@ class Replicate(Placement):
 
 
 class Partial(Placement):
-    """Pending-reduction placement: materialized by the partitioner; accepted
-    for API parity and treated as Replicate at annotation time."""
+    """Pending-reduction placement: each device along the mesh axis holds a
+    PARTIAL term of the value (e.g. a row-parallel matmul's per-shard
+    product); the reshard engine materializes it with psum (-> Replicate)
+    or psum_scatter (-> Shard).  Storage: the stacked per-device partials
+    live as a leading axis of the dist tensor's array, sharded over the
+    mesh axis (see ``dtensor_from_local`` / ``reshard``)."""
 
     def __init__(self, reduce_type=None):
         self.reduce_type = reduce_type
@@ -151,21 +155,138 @@ def _spec_from_placements(ndim, mesh: ProcessMesh, placements):
 def shard_tensor(x, process_mesh=None, placements=None, mesh=None, dtype=None,
                  stop_gradient=None):
     """Lay ``x`` out over the mesh per placements; returns a Tensor whose
-    jax.Array carries the NamedSharding (the DistTensor)."""
+    jax.Array carries the NamedSharding (the DistTensor).  The (mesh,
+    placements) pair is recorded as the tensor's dist_attr so ``reshard``
+    can compute placement->placement transitions."""
     pm = process_mesh if process_mesh is not None else mesh
     if placements is None:
         placements = [Replicate()] * len(pm.dim_names)
+    if any(isinstance(p, Partial) for p in placements):
+        raise ValueError(
+            "shard_tensor cannot create a Partial layout from a full value "
+            "(the partials would be fabricated); build it from the "
+            "per-device terms with dtensor_from_local")
     v = x._value if isinstance(x, Tensor) else jax.numpy.asarray(x)
     spec = _spec_from_placements(v.ndim, pm, placements)
     out_v = jax.device_put(v, NamedSharding(pm.jax_mesh, spec))
     if isinstance(x, Tensor):
         x._value = out_v
+        x._dist_attr = (pm, tuple(placements))
         return x
-    return Tensor(out_v, stop_gradient=True if stop_gradient is None else stop_gradient)
+    t = Tensor(out_v, stop_gradient=True if stop_gradient is None else stop_gradient)
+    t._dist_attr = (pm, tuple(placements))
+    return t
+
+
+def get_dist_attr(x):
+    """(ProcessMesh, placements) of a dist tensor, or None."""
+    return getattr(x, "_dist_attr", None)
+
+
+def dtensor_from_local(local, process_mesh, placements):
+    """Build a dist tensor from per-device local pieces (reference:
+    dist.auto_parallel dtensor_from_local / LocalLayer output conversion).
+
+    Single-controller form: ``local`` carries one leading stacked axis per
+    non-Replicate mesh dim (in mesh-dim order) holding the per-device
+    pieces — for ``Shard(d)`` the shards (folded into data dim ``d``), for
+    ``Partial()`` the unsummed per-device terms (kept as a leading axis,
+    each device holding only its own term, until ``reshard`` reduces them).
+    At most one Partial axis is supported.
+    """
+    pm = process_mesh
+    if sum(1 for p in placements if p.is_partial()) > 1:
+        raise NotImplementedError("at most one Partial mesh axis")
+    v = np.asarray(local.numpy() if isinstance(local, Tensor) else local)
+    lead = [(ax, p) for ax, p in enumerate(placements) if not p.is_replicated()]
+    for k, (ax, _) in enumerate(lead):
+        want = pm.shape[ax]
+        if v.shape[k] != want:
+            raise ValueError(
+                f"stacked axis {k} has size {v.shape[k]}, expected mesh dim "
+                f"{pm.dim_names[ax]!r} size {want}")
+    # fold Shard stacked axes into their data dims, right-to-left so the
+    # remaining leading-axis indices stay valid
+    n_lead = len(lead)
+    for k in reversed(range(n_lead)):
+        ax, p = lead[k]
+        if not isinstance(p, Shard):
+            continue
+        data_pos = n_lead + p.dim  # data dims start after the leading axes
+        v = np.moveaxis(v, k, data_pos - 1)
+        v = v.reshape(v.shape[:data_pos - 1]
+                      + (v.shape[data_pos - 1] * v.shape[data_pos],)
+                      + v.shape[data_pos + 1:])
+        n_lead -= 1
+    # final layout: remaining leading axes are the Partial stacks
+    entries = [pm.dim_names[ax] for ax, p in lead if p.is_partial()]
+    data_entries = [None] * (v.ndim - len(entries))
+    for ax, p in enumerate(placements):
+        if isinstance(p, Shard):
+            data_entries[p.dim] = pm.dim_names[ax]
+    spec = PartitionSpec(*(entries + data_entries))
+    g = jax.device_put(jax.numpy.asarray(v), NamedSharding(pm.jax_mesh, spec))
+    t = Tensor(g)
+    t._dist_attr = (pm, tuple(placements))
+    return t
+
+
+def _materialize_partial(t, target_placements):
+    """Partial -> Replicate/Shard: the real reduction, via a shard_map
+    collective over the partial mesh axis (psum / psum_scatter)."""
+    from .communication import shard_map as _sm  # version shim
+    from jax import lax
+
+    pm, placements = t._dist_attr
+    (ax,) = [i for i, p in enumerate(placements) if p.is_partial()]
+    axis_name = pm.dim_names[ax]
+    v = t._value  # [mesh_ax, *data]
+    tgt = target_placements[ax]
+    in_spec = PartitionSpec(*([axis_name] + [None] * (v.ndim - 1)))
+
+    if isinstance(tgt, Shard):
+        d = tgt.dim
+
+        def red(s):  # s: [1, *data] local partial
+            return lax.psum_scatter(s[0], axis_name, scatter_dimension=d,
+                                    tiled=True)
+
+        ent = [None] * (v.ndim - 1)
+        ent[d] = axis_name
+        out_spec = PartitionSpec(*ent)
+    else:
+
+        def red(s):
+            return lax.psum(s, axis_name)[0]
+
+        out_spec = PartitionSpec(*([None] * (v.ndim - 1)))
+    f = _sm(red, pm.jax_mesh, in_spec, out_spec)
+    return jax.jit(f)(v)
 
 
 def reshard(x, process_mesh=None, placements=None, mesh=None):
-    return shard_tensor(x, process_mesh, placements, mesh)
+    """The reshard engine (reference: auto_parallel reshard function +
+    converter machinery): transition a dist tensor between placements.
+
+    - Partial -> Replicate: psum over the partial mesh axis
+    - Partial -> Shard(d): psum_scatter (reduce-scatter) over the axis
+    - Shard/Replicate -> anything non-partial: XLA resharding (device_put
+      with the target NamedSharding — the compiler emits the all-gather /
+      all-to-all / slice collectives)
+    """
+    pm = process_mesh if process_mesh is not None else mesh
+    src = get_dist_attr(x)
+    if src is not None and any(p.is_partial() for p in src[1]):
+        if placements is None:
+            placements = [Replicate()] * len(pm.dim_names)
+        if any(isinstance(p, Partial) for p in placements):
+            raise ValueError("reshard target may not keep Partial axes that "
+                             "change mesh; materialize first")
+        v = _materialize_partial(x, placements)
+        t = Tensor(v, stop_gradient=x.stop_gradient) if not isinstance(x, Tensor) else x
+        t._value = v
+        return shard_tensor(t, pm, placements)
+    return shard_tensor(x, pm, placements)
 
 
 def unshard_dtensor(x):
@@ -211,3 +332,101 @@ def shard_op(fn, process_mesh=None, in_placements=None, out_placements=None):
 
 def dtensor_from_fn(fn, process_mesh, placements, *args, **kwargs):
     return shard_tensor(fn(*args, **kwargs), process_mesh, placements)
+
+
+class DistModel:
+    """What ``paddle.distributed.to_static`` returns (reference:
+    auto_parallel/api.py DistModel): the dist-annotated layer compiled into
+    one SPMD train/eval program.  Train step = the fused TrainStep (fwd +
+    bwd + update in a single donated XLA module); the parameters keep
+    whatever shardings their dist_attrs gave them, and the partitioner
+    propagates layouts through the step."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None):
+        from ..jit.train_step import TrainStep
+
+        self.network = layer
+        self._loader = loader
+        self._loss = loss
+        self._opt = optimizer
+        self._mode = "train"
+        self._train_step = None
+        if optimizer is not None:
+            self._train_step = TrainStep(layer, optimizer, loss_fn=loss)
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            if self._train_step is None:
+                raise RuntimeError("DistModel needs an optimizer to train; "
+                                   "pass one to dist.to_static")
+            return self._train_step(*args)
+        from ..framework.state import no_grad_ctx
+
+        with no_grad_ctx():
+            if self._loss is not None and len(args) > 1:
+                # reference DistModel eval semantics: with a loss, the last
+                # argument is the labels and the call returns the loss
+                out = self.network(*args[:-1])
+                return self._loss(out, args[-1])
+            return self.network(*args)
+
+    def state_dict(self, *a, **k):
+        return self.network.state_dict(*a, **k)
+
+    def set_state_dict(self, sd):
+        return self.network.set_state_dict(sd)
+
+    def dist_main_program(self, mode=None):  # reference debugging hook
+        return None
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """reference: paddle.distributed.to_static(layer, loader, loss, opt) —
+    returns a DistModel running one compiled SPMD program per step."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+# ------------------------------------------------- distributed checkpointing
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
+    """reference: paddle.distributed.save_state_dict — sharded save; each
+    array writes its own shards (orbax/tensorstore underneath)."""
+    from ..io.checkpoint import save_checkpoint
+
+    return save_checkpoint(state_dict, path)
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, offload=False):
+    """reference: paddle.distributed.load_state_dict — IN-PLACE restore
+    with re-shard-on-load: each tensor in ``state_dict`` is restored into
+    its CURRENT sharding (which may differ from save-time topology — the
+    distributed checkpoint converter capability, SURVEY.md §5.4)."""
+    from ..io.checkpoint import load_checkpoint
+
+    def _sharding_of(v):
+        if isinstance(v, Tensor):
+            return v._value.sharding
+        # non-array leaves (optimizer step counters, LR scalars) have no
+        # layout — restore them as-is
+        return getattr(v, "sharding", None)
+
+    shardings = jax.tree_util.tree_map(
+        _sharding_of, state_dict, is_leaf=lambda v: isinstance(v, Tensor))
+    out = load_checkpoint(path, template=state_dict, shardings=shardings,
+                          to_tensors=False)
+    flat_out, _ = jax.tree_util.tree_flatten(out)
+    flat_in, _ = jax.tree_util.tree_flatten(
+        state_dict, is_leaf=lambda v: isinstance(v, Tensor))
+    for dst, src in zip(flat_in, flat_out):
+        if isinstance(dst, Tensor):
+            dst._value = src
+    return state_dict

@@ -1,0 +1,127 @@
+"""Auto-parallel depth (VERDICT r3 missing #7): the reshard engine with
+real Partial materialization, dtensor_from_local, dist.to_static DistModel,
+and the distributed checkpoint converter (save/load_state_dict with
+re-shard-on-load).  All on the virtual 8-device CPU mesh (SURVEY.md §4).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import (
+    ProcessMesh, Shard, Replicate, Partial, shard_tensor, reshard,
+    dtensor_from_local, get_dist_attr,
+)
+
+
+def _mesh2d():
+    return ProcessMesh(np.arange(8).reshape(2, 4), ["x", "y"])
+
+
+def test_shard_tensor_records_dist_attr_and_lays_out():
+    mesh = _mesh2d()
+    x = paddle.to_tensor(np.random.RandomState(0).rand(8, 12).astype("float32"))
+    d = shard_tensor(x, mesh, [Shard(0), Shard(1)])
+    pm, pl = get_dist_attr(d)
+    assert pm is mesh and pl == (Shard(0), Shard(1))
+    # per-device shard is (8/2, 12/4)
+    assert d._value.addressable_shards[0].data.shape == (4, 3)
+
+
+def test_shard_tensor_rejects_partial():
+    mesh = _mesh2d()
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    with pytest.raises(ValueError):
+        shard_tensor(x, mesh, [Partial(), Replicate()])
+
+
+def test_dtensor_from_local_shard_axis():
+    mesh = ProcessMesh(np.arange(8), ["x"])
+    pieces = np.arange(8 * 3 * 2, dtype=np.float32).reshape(8, 3, 2)
+    d = dtensor_from_local(pieces, mesh, [Shard(0)])
+    assert list(d.shape) == [24, 2]
+    np.testing.assert_array_equal(d.numpy(), pieces.reshape(24, 2))
+    # device k holds piece k
+    shard0 = d._value.addressable_shards[0]
+    np.testing.assert_array_equal(np.asarray(shard0.data), pieces[0])
+
+
+def test_partial_reshard_to_replicate_sums():
+    """The row-parallel-matmul case: per-device partial products reduce to
+    the true product on reshard(Partial -> Replicate)."""
+    mesh = ProcessMesh(np.arange(8), ["x"])
+    rs = np.random.RandomState(0)
+    a = rs.rand(4, 8).astype("float32")
+    b = rs.rand(8, 5).astype("float32")
+    # device k computes a[:, k] (outer) b[k, :] — a genuine partial term
+    partials = np.stack([np.outer(a[:, k], b[k, :]) for k in range(8)])
+    d = dtensor_from_local(partials, mesh, [Partial()])
+    out = reshard(d, mesh, [Replicate()])
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+    assert get_dist_attr(out)[1] == (Replicate(),)
+
+
+def test_partial_reshard_to_shard_reduce_scatters():
+    mesh = ProcessMesh(np.arange(8), ["x"])
+    rs = np.random.RandomState(1)
+    partials = rs.rand(8, 16, 6).astype("float32")
+    d = dtensor_from_local(partials, mesh, [Partial()])
+    out = reshard(d, mesh, [Shard(0)])
+    np.testing.assert_allclose(out.numpy(), partials.sum(0), rtol=1e-5)
+    # really sharded on dim 0
+    assert out._value.addressable_shards[0].data.shape == (2, 6)
+
+
+def test_reshard_shard_to_shard_transition():
+    mesh = ProcessMesh(np.arange(8), ["x"])
+    x = paddle.to_tensor(np.random.RandomState(2).rand(8, 8).astype("float32"))
+    d = shard_tensor(x, mesh, [Shard(0)])
+    d2 = reshard(d, mesh, [Shard(1)])
+    assert d2._value.addressable_shards[0].data.shape == (8, 1)
+    np.testing.assert_array_equal(d2.numpy(), x.numpy())
+
+
+def test_dist_to_static_trains():
+    mesh = ProcessMesh(np.arange(8), ["x"])
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    # annotate: shard the big weights over the mesh (ZeRO-flavored layout),
+    # picking a dim divisible by the mesh size
+    for p in m.parameters():
+        if p._value.ndim == 2:
+            dim = 1 if p._value.shape[1] % 8 == 0 else 0
+            shard_tensor(p, mesh, [Shard(dim)])
+    o = opt.AdamW(learning_rate=1e-2, parameters=m.parameters())
+    dm = dist.to_static(m, loss=nn.CrossEntropyLoss(), optimizer=o)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(32, 16).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 4, (32,)).astype("int64"))
+    losses = [float(dm(x, y)) for _ in range(6)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    dm.eval()
+    out = dm(x)
+    assert out.shape == [32, 4]
+
+
+def test_save_load_state_dict_reshards_on_load(tmp_path):
+    """Save with one layout, load into ANOTHER topology — the distributed
+    checkpoint converter capability (SURVEY.md §5.4)."""
+    mesh_row = ProcessMesh(np.arange(8), ["x"])
+    w = np.random.RandomState(3).rand(8, 16).astype("float32")
+
+    src = {"w": shard_tensor(paddle.to_tensor(w.copy()), mesh_row, [Shard(0)])}
+    dist.save_state_dict(src, str(tmp_path / "ckpt"))
+
+    # destination: different mesh shape AND different placement
+    mesh2 = ProcessMesh(np.arange(8).reshape(2, 4), ["a", "b"])
+    dst = {"w": shard_tensor(paddle.to_tensor(np.zeros_like(w)), mesh2,
+                             [Replicate(), Shard(1)])}
+    dist.load_state_dict(dst, str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(dst["w"].numpy(), w, rtol=1e-6)
+    # layout of the DESTINATION prevails (re-shard on load)
+    assert dst["w"]._value.addressable_shards[0].data.shape == (8, 4)
