@@ -356,7 +356,12 @@ class Gamma(Distribution):
 
 
 class Geometric(Distribution):
-    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+    """P(X=k) = (1-p)^(k-1) p, k = 1, 2, ... (trials to first success).
+
+    Reference semantics (ADVICE r3): paddle's Geometric is over TRIALS
+    (support k>=1, mean 1/p) — NOT torch's failures-before-success
+    convention (k>=0).  Mean/variance/entropy follow the trials pmf.
+    """
 
     def __init__(self, probs, name=None):
         self.probs_ = _t(probs)
@@ -365,13 +370,28 @@ class Geometric(Distribution):
     def sample(self, shape=()):
         shp = _shape(shape) + self.batch_shape
         u = jax.random.uniform(self._key(), shp, jnp.float32, 1e-7, 1.0)
-        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_._value)))
+        return Tensor(
+            jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_._value)) + 1.0)
 
     rsample = sample
 
     def log_prob(self, value):
-        return _apply(lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+        return _apply(lambda v, p: (v - 1) * jnp.log1p(-p) + jnp.log(p),
                       _t(value), self.probs_, op_name="geometric_log_prob")
+
+    @property
+    def mean(self):
+        return _apply(lambda p: 1.0 / p, self.probs_, op_name="geometric_mean")
+
+    @property
+    def variance(self):
+        return _apply(lambda p: (1.0 - p) / (p * p), self.probs_,
+                      op_name="geometric_variance")
+
+    def entropy(self):
+        return _apply(
+            lambda p: (-(1 - p) * jnp.log1p(-p) - p * jnp.log(p)) / p,
+            self.probs_, op_name="geometric_entropy")
 
 
 class Gumbel(Distribution):

@@ -12,6 +12,7 @@ flags for script compatibility and ``summary()`` says what actually runs.
 from __future__ import annotations
 
 import os
+import re
 
 import numpy as np
 
@@ -33,15 +34,29 @@ class Config:
 
     def __init__(self, prog_file=None, params_file=None, model_dir=None):
         self._prefix = None
+
+        def _strip(path):
+            t = str(path)
+            for suffix in (".stablehlo", ".pdmodel", ".spec.json",
+                           ".pdparams", ".pdiparams", ".json"):
+                if t.endswith(suffix):
+                    return t[: -len(suffix)]
+            return t
+
         target = prog_file if prog_file is not None else model_dir
         if target is not None:
-            t = str(target)
-            for suffix in (".stablehlo", ".pdmodel", ".spec.json",
-                           ".pdparams", ".json"):
-                if t.endswith(suffix):
-                    t = t[: -len(suffix)]
-                    break
-            self._prefix = t
+            self._prefix = _strip(target)
+        # the predictor loads weights from the prog_file-derived prefix; a
+        # params_file pointing elsewhere would silently load the wrong
+        # weights (ADVICE r3) — reject the mismatch loudly
+        if params_file is not None and self._prefix is not None:
+            if _strip(params_file) != self._prefix:
+                raise ValueError(
+                    f"params_file {params_file!r} does not share prog_file's "
+                    f"prefix {self._prefix!r}: this runtime stores program "
+                    "and params under one jit.save prefix "
+                    "(model.stablehlo + model.pdparams); re-export with "
+                    "paddle.jit.save or pass matching paths")
         self._flags = {}
         self._device = "tpu"
         self._device_id = 0
@@ -191,7 +206,21 @@ class Predictor:
         return [f"output_{i}" for i in range(n)]
 
     def get_output_handle(self, name):
-        i = int(name.split("_")[-1])
+        # validate against the advertised names: reference-style names like
+        # 'save_infer_model/scale_0.tmp_0' must not map to arbitrary slots
+        # (ADVICE r3).  Positional 'output_<i>' spellings beyond the current
+        # count stay allowed — the reference API permits fetching handles
+        # BEFORE the first run() reveals how many outputs exist.
+        names = self.get_output_names()
+        if name in names:
+            i = names.index(name)
+        elif re.fullmatch(r"output_\d+", name):
+            i = int(name.rsplit("_", 1)[1])  # pre-run positional fetch
+        else:
+            raise KeyError(
+                f"unknown output name {name!r}; valid names are {names} "
+                "(this runtime names outputs positionally — use "
+                "get_output_names())")
         if i >= len(self._outputs):  # pre-run fetch (reference API permits)
             while len(self._outputs) <= i:
                 self._outputs.append(PredictorTensor(f"output_{len(self._outputs)}"))

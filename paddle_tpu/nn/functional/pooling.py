@@ -173,6 +173,13 @@ def _max_unpool(x, indices, kernel_size, stride, padding, output_size, n,
                 data_format):
     """Scatter pooled values back to their argmax positions (zeros
     elsewhere) — the exact inverse of max_pool with return_mask."""
+    if isinstance(padding, str):
+        # reference max_unpool takes only numeric padding; resolving
+        # 'SAME'/'VALID' from the already-downsampled dims would compute a
+        # wrong output size (ADVICE r3)
+        raise ValueError(
+            f"max_unpool{n}d does not accept string padding {padding!r}; "
+            "pass the numeric padding used by the matching max_pool")
     k = _norm_tuple(kernel_size, n)
     s = _norm_tuple(stride if stride is not None else kernel_size, n)
     pad = _norm_padding(padding, n)
@@ -181,10 +188,8 @@ def _max_unpool(x, indices, kernel_size, stride, padding, output_size, n,
         if output_size is not None:
             out_sp = tuple(int(o) for o in output_size[-n:])
         else:
-            pairs = _resolve_pad(pad, tuple(v.shape[2:]), k, s, False) \
-                if isinstance(pad, str) else \
-                tuple((pp, pp) if isinstance(pp, int) else tuple(pp)
-                      for pp in pad)
+            pairs = tuple((pp, pp) if isinstance(pp, int) else tuple(pp)
+                          for pp in pad)
             out_sp = tuple((v.shape[2 + i] - 1) * s[i] - sum(pairs[i]) + k[i]
                            for i in range(n))
         N, C = v.shape[:2]

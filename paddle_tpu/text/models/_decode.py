@@ -91,9 +91,11 @@ def beam_search(model, input_ids, max_new_tokens, num_beams=4,
     """Reference-style beam search (PaddleNLP generate
     decode_strategy='beam_search'): maintain num_beams hypotheses per batch
     item, expand by log-prob, keep the global top beams, penalize each
-    hypothesis by ITS OWN finished length at the end.  Eager full-prefix
-    evaluation — beam bookkeeping is host logic; each scoring pass is one
-    jitted forward, with only the LAST position's logits leaving the device.
+    hypothesis by ITS OWN finished length at the end.  Beam bookkeeping is
+    host logic; scoring runs through ONE compiled static-shape forward
+    (prefixes right-padded to S0+max_new_tokens, last-position logits
+    gathered by traced index), so all steps share a single trace and only
+    [N, V] logits leave the device.
 
     model: a causal LM Layer (called as model(ids) -> [N, S, V] logits).
     Returns a Tensor [B, S0 + max_new_tokens] (best beam per item).
@@ -107,9 +109,37 @@ def beam_search(model, input_ids, max_new_tokens, num_beams=4,
     modes = [(m, m.training) for m in model.sublayers(include_self=True)]
     model.eval()
 
-    def last_logits(arr):
-        out = model(Tensor(jnp.asarray(arr)))
-        # slice on DEVICE: only [N, V] crosses to host, not [N, S, V]
+    # Static-shape scoring (ADVICE r3): every pass feeds [N, S_max] ids
+    # right-padded to the final length, and gathers the logits of the
+    # current last position with a traced index.  Causality makes padding
+    # after position pos-1 invisible to it, so one compiled program serves
+    # every step — no per-length retrace, no O(S^2) growth in traced work.
+    from ... import jit as _jit
+
+    S_max = S0 + max_new_tokens
+
+    @_jit.to_static
+    def _score(ids, pos):
+        out = model(ids)                       # [N, S_max, V]
+        from ...tensor.manipulation import index_select
+
+        return index_select(out, pos - 1, axis=1)[:, 0]  # [N, V]
+
+    _fallback = [False]  # model does host logic / can't trace -> eager path
+
+    def last_logits(arr, cur_len):
+        if not _fallback[0]:
+            try:
+                n = arr.shape[0]
+                padded = np.zeros((n, S_max), np.int64)
+                padded[:, :cur_len] = arr
+                pos = Tensor(jnp.asarray([cur_len], jnp.int64))
+                out = _score(Tensor(jnp.asarray(padded)), pos)
+                # only [N, V] crosses to host, not [N, S, V]
+                return np.asarray(out._value).astype(np.float64)
+            except Exception:
+                _fallback[0] = True
+        out = model(Tensor(jnp.asarray(arr[:, :cur_len])))
         return np.asarray(out._value[:, -1]).astype(np.float64)
 
     def log_softmax(l):
@@ -118,7 +148,7 @@ def beam_search(model, input_ids, max_new_tokens, num_beams=4,
 
     try:
         # first expansion: top num_beams continuations of each prompt
-        logp = log_softmax(last_logits(ids0))
+        logp = log_softmax(last_logits(ids0, S0))
         V = logp.shape[-1]
         top = np.argsort(-logp, axis=-1)[:, :num_beams]        # [B, beams]
         scores = np.take_along_axis(logp, top, -1)             # [B, beams]
@@ -147,7 +177,8 @@ def beam_search(model, input_ids, max_new_tokens, num_beams=4,
         for t in range(1, max_new_tokens):
             if done.all():
                 break
-            logp = log_softmax(last_logits(seqs.reshape(B * num_beams, -1)))
+            logp = log_softmax(last_logits(seqs.reshape(B * num_beams, -1),
+                                           seqs.shape[-1]))
             logp = logp.reshape(B, num_beams, V)
             if eos_token_id is not None:
                 # finished beams only extend with EOS at no cost

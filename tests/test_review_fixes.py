@@ -104,3 +104,73 @@ def test_bicubic_align_corners_differs_from_bilinear():
     np.testing.assert_allclose(cub.numpy()[0, 0, -1, -1], x.numpy()[0, 0, -1, -1], atol=1e-4)
     # but the interiors differ (cubic vs linear kernel)
     assert np.abs(cub.numpy() - lin.numpy()).max() > 1e-4
+
+
+# ---------------------------------------------------------------- ADVICE r4
+def test_geometric_trials_convention():
+    """Geometric is over TRIALS k>=1 (pmf p(1-p)^(k-1), mean 1/p) — the
+    reference's convention, not torch's failures-before-success (ADVICE r3)."""
+    from paddle_tpu.distribution import Geometric
+
+    import math
+
+    g = Geometric(0.25)
+    # log_prob at k=1 is log(p); at k=3 is 2*log(1-p)+log(p)
+    np.testing.assert_allclose(float(g.log_prob(paddle.to_tensor(1.0))),
+                               math.log(0.25), rtol=1e-6)
+    np.testing.assert_allclose(float(g.log_prob(paddle.to_tensor(3.0))),
+                               2 * math.log(0.75) + math.log(0.25), rtol=1e-6)
+    np.testing.assert_allclose(float(g.mean), 4.0, rtol=1e-6)
+    np.testing.assert_allclose(float(g.variance), 0.75 / 0.0625, rtol=1e-6)
+    paddle.seed(0)
+    s = g.sample([4000]).numpy()
+    assert s.min() >= 1.0  # support starts at 1
+    np.testing.assert_allclose(s.mean(), 4.0, rtol=0.1)
+
+
+def test_inference_config_params_file_mismatch_raises():
+    from paddle_tpu.inference import Config
+
+    # matching prefixes (reference two-file spelling) are accepted
+    Config("dir/model.pdmodel", "dir/model.pdiparams")
+    with np.testing.assert_raises(ValueError):
+        Config("dir/model.pdmodel", "elsewhere/weights.pdiparams")
+
+
+def test_max_unpool_rejects_string_padding():
+    x = paddle.to_tensor(np.random.RandomState(0).rand(1, 1, 8, 8).astype("float32"))
+    out, idx = F.max_pool2d(x, 2, stride=2, return_mask=True)
+    with np.testing.assert_raises(ValueError):
+        F.max_unpool2d(out, idx, 2, stride=2, padding="SAME")
+
+
+def test_fleet_init_warns_on_semantic_inert_knobs():
+    import warnings
+
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed import topology as topo
+
+    prev = fleet._FLEET["strategy"]
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.localsgd = True
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            fleet.init(is_collective=True, strategy=strategy)
+        assert any("localsgd" in str(w.message) for w in rec), \
+            [str(w.message) for w in rec]
+    finally:
+        topo.set_hybrid_communicate_group(None)
+        fleet._FLEET["strategy"] = prev
+
+
+def test_eager_send_recv_raises_cross_process(monkeypatch):
+    import jax
+
+    import paddle_tpu.distributed as dist
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with np.testing.assert_raises(RuntimeError):
+        dist.send(paddle.to_tensor(np.ones(2, np.float32)), dst=1)
+    with np.testing.assert_raises(RuntimeError):
+        dist.recv(paddle.to_tensor(np.ones(2, np.float32)), src=0)
