@@ -1,0 +1,242 @@
+"""paddle.quantization (reference: python/paddle/quantization/ — QuantConfig,
+QAT, PTQ, fake quanters/observers).
+
+TPU-native design: quantization here is SIMULATED (fake-quant) numerics —
+values round-trip through the int grid inside the traced program with a
+straight-through estimator (jax.custom_vjp), so QAT trains through rounding
+exactly like the reference's FakeQuantAbsMax kernels, and PTQ calibrates
+scales by observing absmax during forwards.  The export path is the scale
+dict (`extract_scales`); on TPU the deploy win is int8 MXU matmuls, which
+XLA picks when fed quantized operands (see incubate.fp8 for the fp8 twin).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..tensor.dispatch import apply
+from ..tensor.tensor import Tensor
+
+
+@jax.custom_vjp
+def _fake_quant(x, scale, qmin, qmax):
+    q = jnp.clip(jnp.round(x / scale), qmin, qmax)
+    return q * scale
+
+
+def _fq_fwd(x, scale, qmin, qmax):
+    return _fake_quant(x, scale, qmin, qmax), (x, scale)
+
+
+def _fq_bwd(res, g):
+    x, scale = res
+    # straight-through: pass gradient inside the clip range, zero outside
+    inside = (jnp.abs(x) <= scale * 127.0).astype(g.dtype)
+    return g * inside, None, None, None
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quant_absmax(x, bits=8):
+    """Per-tensor absmax fake-quant (the reference's default quanter)."""
+    qmax = 2.0 ** (bits - 1) - 1
+
+    def fn(v):
+        scale = jnp.maximum(jnp.max(jnp.abs(v)), 1e-8) / qmax
+        return _fake_quant(v, scale, -qmax, qmax)
+
+    return apply(fn, x, op_name="fake_quant_absmax")
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT quanter: fake-quant with a moving-average absmax scale buffer
+    (reference: quanter of the same name)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bits = bit_length
+        self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
+
+    def forward(self, x):
+        qmax = 2.0 ** (self.bits - 1) - 1
+        rate = self.moving_rate
+
+        def fn(v, s):
+            absmax = jnp.maximum(jnp.max(jnp.abs(v)), 1e-8)
+            new_s = jnp.where(s <= 1.0 + 1e-9, absmax,
+                              rate * s + (1 - rate) * absmax)
+            return _fake_quant(v, new_s / qmax, -qmax, qmax), new_s
+
+        out, new_scale = apply(fn, x, self.scale, n_outs=None,
+                               op_name="fake_quant_moving_absmax")
+        if self.training:
+            self.scale._value = new_scale._value  # buffer rebind
+        return out
+
+
+FakeQuanterWithAbsMaxObserverLayer = FakeQuanterWithAbsMaxObserver
+
+
+class AbsmaxObserver(Layer):
+    """PTQ observer: records the running absmax, passes values through."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.bits = quant_bits
+        self.register_buffer("absmax", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        def fn(v, a):
+            return v, jnp.maximum(a, jnp.max(jnp.abs(v)))
+
+        out, new_a = apply(fn, x, self.absmax, n_outs=None,
+                           op_name="absmax_observe")
+        self.absmax._value = new_a._value
+        return out
+
+    def scale(self):
+        qmax = 2.0 ** (self.bits - 1) - 1
+        return float(self.absmax.numpy()) / qmax
+
+
+class QuantConfig:
+    """reference: paddle.quantization.QuantConfig — maps layer types/
+    instances to (activation, weight) quanter factories."""
+
+    def __init__(self, activation=None, weight=None):
+        self.default_activation = activation
+        self.default_weight = weight
+        self._type_configs = {}
+        self._layer_configs = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._type_configs[t] = (activation, weight)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs[id(l)] = (activation, weight)
+
+    def _for(self, layer):
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        return self.default_activation, self.default_weight
+
+
+class _QuantedWrapper(Layer):
+    """Wraps a Linear/Conv-like layer: fake-quants activation + weight."""
+
+    def __init__(self, inner, act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = inner
+        self.act_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        w = self.inner.weight
+        orig = w._value
+        if self.weight_quanter is not None:
+            self.inner.weight._value = self.weight_quanter(
+                Tensor(orig))._value
+        try:
+            return self.inner(x)
+        finally:
+            self.inner.weight._value = orig
+
+
+def _quantable(layer):
+    from ..nn import Conv1D, Conv2D, Conv3D, Linear
+
+    return isinstance(layer, (Linear, Conv1D, Conv2D, Conv3D))
+
+
+def _wrap_model(model, make_act, make_w):
+    for name, sub in list(model.named_sublayers(include_self=False)):
+        parent = model
+        parts = name.split(".")
+        for p in parts[:-1]:
+            parent = getattr(parent, p)
+        if _quantable(sub) and not isinstance(parent, _QuantedWrapper):
+            wrapper = _QuantedWrapper(sub, make_act(), make_w())
+            setattr(parent, parts[-1], wrapper)
+    return model
+
+
+class QAT:
+    """Quantization-aware training (reference: paddle.quantization.QAT):
+    ``quantize(model)`` wraps quantable layers with fake-quanters; train as
+    usual (STE grads flow); scales live in buffers."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=True):
+        act, w = self.config.default_activation, self.config.default_weight
+        make_act = (lambda: act.__class__(**getattr(act, "_kwargs", {}))) \
+            if act is not None else (lambda: FakeQuanterWithAbsMaxObserver())
+        make_w = (lambda: w.__class__(**getattr(w, "_kwargs", {}))) \
+            if w is not None else (lambda: FakeQuanterWithAbsMaxObserver())
+        return _wrap_model(model, make_act, make_w)
+
+
+class PTQ:
+    """Post-training quantization: ``quantize`` inserts observers, run
+    calibration batches, then ``convert`` freezes observed scales into
+    fake-quant layers."""
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=True):
+        return _wrap_model(model, lambda: AbsmaxObserver(),
+                           lambda: AbsmaxObserver())
+
+    def convert(self, model, inplace=True):
+        for _, sub in model.named_sublayers(include_self=True):
+            if isinstance(sub, _QuantedWrapper):
+                for attr in ("act_quanter", "weight_quanter"):
+                    obs = getattr(sub, attr)
+                    if isinstance(obs, AbsmaxObserver):
+                        setattr(sub, attr, _FrozenFakeQuant(obs.scale(),
+                                                            obs.bits))
+        return model
+
+
+class _FrozenFakeQuant(Layer):
+    def __init__(self, scale, bits=8):
+        super().__init__()
+        self._scale = max(scale, 1e-8)
+        self._qmax = 2.0 ** (bits - 1) - 1
+
+    def forward(self, x):
+        s, qmax = self._scale, self._qmax
+        return apply(lambda v: _fake_quant(v, jnp.float32(s), -qmax, qmax),
+                     x, op_name="frozen_fake_quant")
+
+
+def extract_scales(model):
+    """{layer_name: scale} for every quanter in a quantized model — the
+    deploy artifact (reference: the scales written into the inference
+    program)."""
+    out = {}
+    for name, sub in model.named_sublayers(include_self=True):
+        if isinstance(sub, FakeQuanterWithAbsMaxObserver):
+            qmax = 2.0 ** (sub.bits - 1) - 1
+            out[name] = float(sub.scale.numpy()) / qmax
+        elif isinstance(sub, _FrozenFakeQuant):
+            out[name] = sub._scale
+        elif isinstance(sub, AbsmaxObserver):
+            out[name] = sub.scale()
+    return out

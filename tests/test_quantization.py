@@ -1,0 +1,110 @@
+"""paddle.quantization: QAT (fake-quant + STE training) and PTQ
+(observe -> convert) — SURVEY §2.2 incubate/slim adjacency; quantization is
+part of the reference's user surface (paddle.quantization)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.quantization import (
+    PTQ, QAT, AbsmaxObserver, FakeQuanterWithAbsMaxObserver, QuantConfig,
+    extract_scales, quant_absmax,
+)
+
+
+def test_fake_quant_roundtrip_error_bounded():
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(64).astype("float32"))
+    q = quant_absmax(x, bits=8)
+    err = np.abs(q.numpy() - x.numpy()).max()
+    step = np.abs(x.numpy()).max() / 127
+    assert err <= step * 0.51 + 1e-7
+    # int-grid check: q/scale are integers
+    scale = np.abs(x.numpy()).max() / 127
+    np.testing.assert_allclose(np.round(q.numpy() / scale),
+                               q.numpy() / scale, atol=1e-3)
+
+
+def test_ste_gradient_flows():
+    x = paddle.to_tensor(np.linspace(-0.5, 0.5, 9).astype("float32"),
+                         stop_gradient=False)
+    q = quant_absmax(x)
+    q.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(9), rtol=1e-6)
+
+
+def test_qat_quantize_and_train():
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    q = QAT(QuantConfig())
+    m = q.quantize(m)
+    # wrapped layers carry quanters
+    scales_before = extract_scales(m)
+    assert len(scales_before) >= 4
+    o = opt.Adam(learning_rate=1e-2, parameters=m.parameters())
+    lossf = nn.CrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(64, 16).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 4, (64,)).astype("int64"))
+    losses = []
+    for _ in range(15):
+        l = lossf(m(x), y)
+        l.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(l))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0] * 0.8, losses
+    # activation scales calibrated away from init
+    scales = extract_scales(m)
+    assert any(abs(v - 1.0 / 127) > 1e-6 for v in scales.values())
+
+
+def test_qat_model_output_is_quant_consistent():
+    paddle.seed(1)
+    m = nn.Linear(8, 8)
+    ref_out = m(paddle.to_tensor(np.ones((2, 8), "float32"))).numpy()
+    q = QAT(QuantConfig())
+    mq = q.quantize(nn.Sequential(m))
+    out = mq(paddle.to_tensor(np.ones((2, 8), "float32"))).numpy()
+    # int8 fake-quant keeps outputs close but not identical
+    assert not np.allclose(out, ref_out, atol=0)
+    np.testing.assert_allclose(out, ref_out, rtol=0.2, atol=0.2)
+
+
+def test_ptq_observe_then_convert():
+    paddle.seed(2)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    ptq = PTQ()
+    m = ptq.quantize(m)
+    rs = np.random.RandomState(3)
+    for _ in range(4):  # calibration
+        m(paddle.to_tensor(rs.randn(16, 8).astype("float32")))
+    m = ptq.convert(m)
+    scales = extract_scales(m)
+    assert len(scales) >= 4 and all(v > 0 for v in scales.values())
+    out = m(paddle.to_tensor(rs.randn(4, 8).astype("float32")))
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_quant_config_type_and_layer_overrides():
+    cfg = QuantConfig()
+    lin = nn.Linear(2, 2)
+    cfg.add_type_config(nn.Linear, activation=None, weight=None)
+    cfg.add_layer_config(lin, activation="A", weight="W")
+    assert cfg._for(lin) == ("A", "W")
+    assert cfg._for(nn.Linear(2, 2)) == (None, None)
+
+
+def test_qat_trains_through_train_step():
+    paddle.seed(4)
+    m = QAT(QuantConfig()).quantize(
+        nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2)))
+    o = opt.Adam(learning_rate=1e-2, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss())
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(32, 8).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 2, (32,)).astype("int64"))
+    losses = [float(step(x, y)) for _ in range(8)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
