@@ -1,0 +1,183 @@
+"""Paged attention — decode-time attention over a block-paged KV cache.
+
+Reference analog: the PagedAttention kernels serving stacks use for
+KV-cache memory management (and the reference inference engine's fused
+decode attention).  TPU-native design: the page table rides the kernel as
+SCALAR PREFETCH — Pallas resolves each grid step's HBM block address from
+``page_table[b, i]`` *before* the step runs, so pages stream HBM→VMEM with
+no gather materialization; online-softmax state (m, l, acc) lives in VMEM
+scratch across the page sweep, exactly like this repo's flash kernel
+(ops/flash_attention.py).
+
+Layout:
+    q          [B, H, D]           one decode token per sequence
+    k_pages    [P, page_size, H, D]  global page pool (shared across seqs)
+    v_pages    [P, page_size, H, D]
+    page_table [B, NP] int32       page ids per sequence (row-padded)
+    seq_lens   [B]     int32       valid token count per sequence
+
+Off-TPU (and for tiny shapes) the public entry falls back to a dense
+gather reference with identical semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _paged_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, page_size, scale):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    seq_len = lens_ref[b]
+
+    @pl.when(i * page_size < seq_len)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                  # [H, D]
+        k = k_ref[0].astype(jnp.float32)                  # [page, H, D]
+        v = v_ref[0].astype(jnp.float32)
+        # scores [H, page]: contract D, batch H
+        s = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,)))) * scale
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        s = jnp.where(pos < seq_len, s, NEG_INF)
+        m_new = jnp.maximum(m_scr[...], s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_scr[...] - m_new)
+        l_scr[...] = l_scr[...] * alpha + p.sum(axis=-1)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((0,), (1,))))          # [H, D]
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + pv
+        m_scr[...] = m_new
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _fin():
+        o_ref[0] = (acc_scr[...]
+                    / jnp.maximum(l_scr[...], 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _paged_pallas(q, k_pages, v_pages, page_table, seq_lens, scale,
+                  interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, D = q.shape
+    page_size = k_pages.shape[1]
+    NP = page_table.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, NP),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b, i, pt, ln: (b, 0, 0)),
+            pl.BlockSpec((1, page_size, H, D),
+                         lambda b, i, pt, ln: (pt[b, i], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, H, D),
+                         lambda b, i, pt, ln: (pt[b, i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, i, pt, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H,), jnp.float32),
+            pltpu.VMEM((H,), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, page_size=page_size, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_attention_ref(q, k_pages, v_pages, page_table, seq_lens,
+                        scale=None):
+    """Dense-gather reference with identical semantics (oracle + fallback)."""
+    B, H, D = q.shape
+    page_size = k_pages.shape[1]
+    NP = page_table.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    k = k_pages[page_table].reshape(B, NP * page_size, H, D)
+    v = v_pages[page_table].reshape(B, NP * page_size, H, D)
+    s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    pos = jnp.arange(NP * page_size)[None, None, :]
+    s = jnp.where(pos < seq_lens[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bht,bthd->bhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
+                    interpret=None):
+    """Decode attention over a paged KV cache (see module docstring).
+
+    Uses the Pallas scalar-prefetch kernel on TPU; dense reference
+    elsewhere.  All rows of ``page_table`` must index valid pages (pad rows
+    with any in-range id — padded pages are masked by ``seq_lens``).
+    """
+    B, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return paged_attention_ref(q, k_pages, v_pages, page_table,
+                                       seq_lens, scale)
+        interpret = False
+    return _paged_pallas(q, k_pages, v_pages, page_table, seq_lens, scale,
+                         interpret)
+
+
+class PagedKVCache:
+    """Block-paged KV cache manager (the allocator side of PagedAttention).
+
+    Pages are fixed-size blocks from one global pool; sequences grow by
+    whole pages, so HBM fragmentation is bounded by page_size·B instead of
+    max_seq·B.  Pure-functional jax state: (k_pages, v_pages, page_table,
+    seq_lens) threads through ``append``; the host-side free-list is static
+    round-robin (page i of seq b = b·max_pages + i), keeping every shape
+    static for jit.
+    """
+
+    def __init__(self, num_seqs, max_pages_per_seq, page_size, num_heads,
+                 head_dim, dtype=jnp.bfloat16):
+        self.page_size = page_size
+        total = num_seqs * max_pages_per_seq
+        self.k_pages = jnp.zeros((total, page_size, num_heads, head_dim), dtype)
+        self.v_pages = jnp.zeros_like(self.k_pages)
+        self.page_table = (
+            jnp.arange(num_seqs)[:, None] * max_pages_per_seq
+            + jnp.arange(max_pages_per_seq)[None, :]).astype(jnp.int32)
+        self.seq_lens = jnp.zeros((num_seqs,), jnp.int32)
+
+    def append(self, k_tok, v_tok):
+        """Write one token's K/V per sequence ([B, H, D]) at each seq's
+        current length; returns self (rebound arrays)."""
+        B = k_tok.shape[0]
+        page_idx = self.seq_lens // self.page_size
+        offset = self.seq_lens % self.page_size
+        pages = self.page_table[jnp.arange(B), page_idx]
+        self.k_pages = self.k_pages.at[pages, offset].set(k_tok)
+        self.v_pages = self.v_pages.at[pages, offset].set(v_tok)
+        self.seq_lens = self.seq_lens + 1
+        return self
+
+    def attend(self, q):
+        return paged_attention(q, self.k_pages, self.v_pages,
+                               self.page_table, self.seq_lens)

@@ -1,0 +1,100 @@
+"""Paged attention kernel + PagedKVCache manager (SURVEY.md §2.1 inference
+engine row adjacency: the serving-side decode attention primitive)."""
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.paged_attention import (
+    PagedKVCache, paged_attention, paged_attention_ref, _paged_pallas,
+)
+
+
+def _setup(B=3, H=4, D=16, page=8, np_pages=4, seed=0):
+    rs = np.random.RandomState(seed)
+    total = B * np_pages
+    q = jnp.asarray(rs.randn(B, H, D).astype("float32") * 0.5)
+    k_pages = jnp.asarray(rs.randn(total, page, H, D).astype("float32") * 0.5)
+    v_pages = jnp.asarray(rs.randn(total, page, H, D).astype("float32") * 0.5)
+    table = jnp.asarray(
+        rs.permutation(total).reshape(B, np_pages).astype("int32"))
+    lens = jnp.asarray(np.array([5, 17, 32 - 1], "int32")[:B])
+    return q, k_pages, v_pages, table, lens
+
+
+def _dense_oracle(q, k_pages, v_pages, table, lens):
+    """Independent numpy oracle (not the module's own ref)."""
+    B, H, D = q.shape
+    page = k_pages.shape[1]
+    out = np.zeros((B, H, D), "float32")
+    for b in range(B):
+        ks = np.concatenate([np.asarray(k_pages[p]) for p in np.asarray(table[b])], 0)
+        vs = np.concatenate([np.asarray(v_pages[p]) for p in np.asarray(table[b])], 0)
+        L = int(lens[b])
+        for h in range(H):
+            s = ks[:L, h] @ np.asarray(q[b, h]) / math.sqrt(D)
+            p_ = np.exp(s - s.max())
+            p_ /= p_.sum()
+            out[b, h] = p_ @ vs[:L, h]
+    return out
+
+
+def test_ref_matches_dense_oracle():
+    q, kp, vp, table, lens = _setup()
+    got = np.asarray(paged_attention_ref(q, kp, vp, table, lens))
+    want = _dense_oracle(q, kp, vp, table, lens)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_kernel_matches_ref_interpret():
+    q, kp, vp, table, lens = _setup()
+    got = np.asarray(_paged_pallas(q, kp, vp, table, lens,
+                                   1.0 / math.sqrt(q.shape[-1]),
+                                   interpret=True))
+    want = np.asarray(paged_attention_ref(q, kp, vp, table, lens))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_public_entry_dispatches_and_jits():
+    q, kp, vp, table, lens = _setup(seed=1)
+    f = jax.jit(lambda *a: paged_attention(*a))
+    got = np.asarray(f(q, kp, vp, table, lens))
+    want = np.asarray(paged_attention_ref(q, kp, vp, table, lens))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_paged_kv_cache_decode_loop_matches_full_attention():
+    """Grow the cache token by token, attend each step; the final step must
+    equal full attention over the accumulated keys."""
+    rs = np.random.RandomState(2)
+    B, H, D, page, maxp = 2, 2, 8, 4, 3
+    cache = PagedKVCache(B, maxp, page, H, D, dtype=jnp.float32)
+    T = 10
+    ks = rs.randn(T, B, H, D).astype("float32") * 0.5
+    vs = rs.randn(T, B, H, D).astype("float32") * 0.5
+    for t in range(T):
+        cache = cache.append(jnp.asarray(ks[t]), jnp.asarray(vs[t]))
+    assert int(cache.seq_lens[0]) == T
+    q = jnp.asarray(rs.randn(B, H, D).astype("float32") * 0.5)
+    got = np.asarray(cache.attend(q))
+    # dense oracle over the T tokens in insertion order
+    for b in range(B):
+        for h in range(H):
+            s = np.stack([ks[t, b, h] for t in range(T)]) @ np.asarray(q[b, h])
+            s /= math.sqrt(D)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            want = p @ np.stack([vs[t, b, h] for t in range(T)])
+            np.testing.assert_allclose(got[b, h], want, rtol=2e-4, atol=2e-4)
+
+
+def test_padded_pages_are_masked():
+    # identical prefixes, different padding in the tail pages -> same output
+    q, kp, vp, table, lens = _setup(seed=3)
+    vp2 = vp.at[np.asarray(table[0, -1])].set(999.0)  # poison a padded page
+    a = np.asarray(paged_attention_ref(q, kp, vp, table, lens))
+    b = np.asarray(paged_attention_ref(q, kp, vp2, table, lens))
+    np.testing.assert_allclose(a[0], b[0], rtol=1e-6)
