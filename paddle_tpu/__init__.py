@@ -67,7 +67,7 @@ _LAZY = ("nn", "optimizer", "amp", "io", "metric", "jit", "static", "vision",
          "distributed", "autograd", "device", "framework", "hapi", "profiler",
          "incubate", "utils", "sparse", "signal", "fft", "text", "ops",
          "distribution", "regularizer", "callbacks", "inference",
-         "audio", "version")
+         "audio", "version", "quantization")
 
 
 def __getattr__(name):
@@ -80,6 +80,12 @@ def __getattr__(name):
 
         globals()["Model"] = M
         return M
+    if name in ("register_op", "load_op_library"):
+        from .framework import custom_op as _co
+
+        globals()["register_op"] = _co.register_op
+        globals()["load_op_library"] = _co.load_op_library
+        return globals()[name]
     if name in ("save", "load"):
         from .framework import io as _io
 
@@ -95,6 +101,11 @@ def __getattr__(name):
 
         globals()["summary"] = s
         return s
+    if name == "flops":
+        from .hapi import flops as f
+
+        globals()["flops"] = f
+        return f
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 
@@ -135,3 +146,33 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     from .tensor import creation
 
     return creation.to_tensor(data, dtype, place, stop_gradient)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference: paddle.set_printoptions — maps onto numpy's printoptions
+    (Tensor repr prints via numpy)."""
+    import numpy as _np_
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np_.set_printoptions(**kw)
+
+
+def use_deterministic_algorithms(flag=True):
+    """reference: paddle.use_deterministic_algorithms.
+
+    XLA:TPU programs are already deterministic for a fixed program+seed, so
+    on this backend the call only records the request in the flag registry
+    (queryable via get_flags) — there is no runtime knob to flip, and the
+    already-initialized backend could not read one anyway."""
+    set_flags({"FLAGS_cudnn_deterministic": bool(flag)})

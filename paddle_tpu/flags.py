@@ -62,18 +62,25 @@ def get_flags(names=None) -> Dict[str, Any]:
         names = [names]
     out = {}
     for n in names:
-        if n not in _REGISTRY:
+        key = _canon(n)
+        if key not in _REGISTRY:
             raise ValueError(f"unknown flag {n!r}")
-        out[n] = _REGISTRY[n].get()
+        out[n] = _REGISTRY[key].get()
     return out
+
+
+def _canon(name: str) -> str:
+    # the reference spells flags both 'FLAGS_foo' (env style) and 'foo'
+    return name[6:] if name.startswith("FLAGS_") else name
 
 
 def set_flags(flags: Dict[str, Any]) -> None:
     """Set flags from a dict, e.g. ``set_flags({'check_nan_inf': True})``."""
     for k, v in flags.items():
-        if k not in _REGISTRY:
+        key = _canon(k)
+        if key not in _REGISTRY:
             raise ValueError(f"unknown flag {k!r}")
-        _REGISTRY[k].set(v)
+        _REGISTRY[key].set(v)
 
 
 def get_flag(name: str):

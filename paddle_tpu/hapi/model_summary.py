@@ -67,3 +67,38 @@ def summary(net, input_size=None, dtypes=None, input=None):
     print(f"Non-trainable params: {total - trainable:,}")
     print("-" * width)
     return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Analytic FLOPs of a Layer (reference: paddle.flops) — counted from
+    XLA's own cost model: trace the forward at ``input_size``, compile, and
+    read the 'flops' cost analysis (exact for the program that will run,
+    and free of per-layer bookkeeping).  Falls back to 0 if the backend
+    reports no analysis."""
+    import numpy as np
+    import jax
+
+    from ..framework import random as _rng
+    from ..framework.state import no_grad_ctx
+    from ..tensor.tensor import Tensor
+
+    params = {k: p._value for k, p in net.named_parameters()}
+    bufs = {k: b._value for k, b in net.named_buffers()}
+    x = np.zeros(tuple(input_size), np.float32)
+
+    def fwd(params, bufs, xv):
+        with no_grad_ctx(), _rng.rng_scope(jax.random.key(0)), \
+                net.bind(params, bufs):
+            return net(Tensor(xv))._value
+
+    try:
+        compiled = jax.jit(fwd).lower(params, bufs, x).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0] if analysis else {}
+        val = int(analysis.get("flops", 0))
+    except Exception:
+        val = 0
+    if print_detail:
+        print(f"Total Flops: {val}")
+    return val

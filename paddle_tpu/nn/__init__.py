@@ -2,7 +2,11 @@
 
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
-from .layer import Layer, LayerList, ParameterList, Sequential  # noqa: F401
+from .layer import Layer, LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
+# grad-clip classes are importable from paddle.nn in the reference too
+from ..optimizer.clip import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+)
 from .param_attr import ParamAttr  # noqa: F401
 from .layers.activation import *  # noqa: F401,F403
 from .layers.common import (  # noqa: F401
@@ -17,7 +21,7 @@ from .layers.conv import (  # noqa: F401
 )
 from .layers.loss import (  # noqa: F401
     BCELoss, BCEWithLogitsLoss, CTCLoss, CosineEmbeddingLoss, CrossEntropyLoss,
-    GaussianNLLLoss, HingeEmbeddingLoss, KLDivLoss, L1Loss, MSELoss,
+    GaussianNLLLoss, HSigmoidLoss, HingeEmbeddingLoss, KLDivLoss, L1Loss, MSELoss,
     MarginRankingLoss, MultiLabelSoftMarginLoss, MultiMarginLoss, NLLLoss,
     PoissonNLLLoss, SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
     TripletMarginWithDistanceLoss,
@@ -34,7 +38,8 @@ from .layers.pooling import (  # noqa: F401
     MaxUnPool2D, MaxUnPool3D,
 )
 from .layers.rnn import (  # noqa: F401
-    GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, RNNCellBase, SimpleRNN, SimpleRNNCell,
+    GRU, LSTM, RNN, BeamSearchDecoder, BiRNN, GRUCell, LSTMCell, RNNCellBase,
+    SimpleRNN, SimpleRNNCell, dynamic_decode,
 )
 from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,

@@ -12,3 +12,8 @@ from .norm import (  # noqa: F401
     normalize, rms_norm,
 )
 from .pooling import *  # noqa: F401,F403
+from .extras_r4 import (  # noqa: F401
+    elu_, gather_tree, hsigmoid_loss, margin_cross_entropy, npair_loss,
+    sparse_attention, temporal_shift, zeropad2d,
+)
+from ...ops import flash_attention  # noqa: F401 — reference F.flash_attention

@@ -1,0 +1,189 @@
+"""Round-4 functional long tail (reference: python/paddle/nn/functional/
+entries not yet covered): gather_tree, temporal_shift, zeropad2d,
+npair_loss, margin_cross_entropy (ArcFace-style), hsigmoid_loss,
+sparse_attention (dense-masked), and trailing inplace spellings."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.dispatch import apply
+from ...tensor.tensor import Tensor
+
+
+def gather_tree(ids, parents):
+    """Trace back beam-search parent pointers to final sequences
+    (reference: paddle.nn.functional.gather_tree; shapes [T, B, K])."""
+    def fn(idv, pv):
+        T = idv.shape[0]
+        # backward resolve over the static time axis
+        out = [None] * T
+        out[T - 1] = idv[T - 1]
+        parent = pv[T - 1]
+        for t in range(T - 2, -1, -1):
+            out[t] = jnp.take_along_axis(idv[t], parent, axis=-1)
+            parent = jnp.take_along_axis(pv[t], parent, axis=-1)
+        return jnp.stack(out, axis=0)
+
+    return apply(fn, ids, parents, op_name="gather_tree")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal shift (reference: F.temporal_shift): shift a channel
+    slice one step forward/backward along the segment (time) axis."""
+    def fn(v):
+        if data_format == "NHWC":
+            v = jnp.moveaxis(v, -1, 1)
+        NT, C, H, W = v.shape
+        N = NT // seg_num
+        v5 = v.reshape(N, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        back = jnp.concatenate(
+            [v5[:, 1:, :c1], jnp.zeros_like(v5[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v5[:, :1, c1:c2]), v5[:, :-1, c1:c2]], axis=1)
+        keep = v5[:, :, c2:]
+        out = jnp.concatenate([back, fwd, keep], axis=2).reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply(fn, x, op_name="temporal_shift")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    from .common import pad as _pad
+
+    return _pad(x, padding, mode="constant", value=0.0,
+                data_format=data_format)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair metric loss (reference: F.npair_loss)."""
+    def fn(a, p, y):
+        reg = (jnp.sum(a * a, -1).mean() + jnp.sum(p * p, -1).mean()) \
+            * l2_reg * 0.25
+        sim = a @ p.T                                   # [B, B]
+        same = (y[:, None] == y[None, :]).astype(jnp.float32)
+        tgt = same / same.sum(-1, keepdims=True)
+        ce = -(tgt * jax.nn.log_softmax(sim, axis=-1)).sum(-1).mean()
+        return ce + reg
+
+    return apply(fn, anchor, positive, labels, op_name="npair_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family margin loss (reference: F.margin_cross_entropy):
+    cos(m1·θ + m2) − m3 applied to the target logit, then scaled CE.
+    ``group`` accepts a TP group for API parity; the sharded-logits variant
+    routes through fleet's parallel_softmax_cross_entropy."""
+    def fn(lg, y):
+        lg = lg.astype(jnp.float32)
+        B, C = lg.shape
+        onehot = jax.nn.one_hot(y, C, dtype=jnp.float32)
+        target = jnp.clip((lg * onehot).sum(-1), -1.0, 1.0)
+        theta = jnp.arccos(target)
+        m_target = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = lg + onehot * (m_target - target)[:, None]
+        adj = adj * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        nll = -(onehot * logp).sum(-1)
+        if reduction == "mean":
+            loss = nll.mean()
+        elif reduction == "sum":
+            loss = nll.sum()
+        else:
+            loss = nll
+        if return_softmax:
+            return loss, jax.nn.softmax(adj, axis=-1)
+        return loss
+
+    n_outs = None if return_softmax else 1
+    return apply(fn, logits, label, op_name="margin_cross_entropy",
+                 n_outs=n_outs)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Functional hierarchical sigmoid (reference: F.hsigmoid_loss) —
+    default-tree semantics identical to nn.HSigmoidLoss."""
+    from ..layers.loss import HSigmoidLoss
+
+    h = HSigmoidLoss.__new__(HSigmoidLoss)
+    # build the static path tables without re-creating parameters
+    import numpy as np
+
+    from ..layer import Layer
+
+    Layer.__init__(h)
+    h.num_classes = num_classes
+    h.is_custom = path_table is not None
+    n_nodes = num_classes - 1
+    h.weight, h.bias = weight, bias
+    if not h.is_custom:
+        depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+        table = np.zeros((num_classes, depth), np.int64)
+        code = np.zeros((num_classes, depth), np.float32)
+        mask = np.zeros((num_classes, depth), np.float32)
+        for c in range(num_classes):
+            node = c + n_nodes
+            path = []
+            while node > 0:
+                parent = (node - 1) // 2
+                path.append((parent, float(node == 2 * parent + 2)))
+                node = parent
+            for d, (n, bit) in enumerate(reversed(path)):
+                if d < depth:
+                    table[c, d] = n
+                    code[c, d] = bit
+                    mask[c, d] = 1.0
+        h._table, h._code, h._mask = table, code, mask
+    if bias is None:
+        # HSigmoidLoss.forward consumes self.bias tensors; synthesize zeros
+        h.bias = Tensor(jnp.zeros((n_nodes,), jnp.float32))
+    return h.forward(input, label, path_table=path_table,
+                     path_code=path_code)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention with a CSR connectivity pattern (reference:
+    F.sparse_attention, CUDA-only there).  TPU-native: the CSR pattern
+    becomes a dense additive mask and XLA fuses the masked softmax — exact
+    same numerics; the sparsity is a masking semantic, not (yet) a skipped-
+    compute kernel."""
+    def fn(q, k, v, off, cols):
+        B, H, T, D = q.shape
+        nnz = cols.shape[-1]
+
+        def one(off_bh, cols_bh):
+            # row of CSR entry e = #row-ends <= e; padded entries masked
+            entry = jnp.arange(nnz)
+            rows = jnp.searchsorted(off_bh[1:], entry, side="right")
+            valid = entry < off_bh[-1]
+            upd = jnp.where(valid, 0.0, -1e9)
+            r_idx = jnp.where(valid, rows, 0)
+            c_idx = jnp.where(valid, cols_bh, 0)
+            m = jnp.full((T, T), -1e9, jnp.float32)
+            return m.at[r_idx, c_idx].max(upd)
+
+        mask = jax.vmap(jax.vmap(one))(off, cols)
+        s = jnp.einsum("bhtd,bhsd->bhts", q, k) / math.sqrt(D)
+        p = jax.nn.softmax(s.astype(jnp.float32) + mask, axis=-1)
+        return jnp.einsum("bhts,bhsd->bhtd", p, v).astype(q.dtype)
+
+    return apply(fn, query, key, value, sparse_csr_offset, sparse_csr_columns,
+                 op_name="sparse_attention")
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._inplace_unary(
+        lambda v: jnp.where(v > 0, v, alpha * jnp.expm1(v)), "elu_")

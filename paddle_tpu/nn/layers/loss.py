@@ -196,3 +196,86 @@ class TripletMarginWithDistanceLoss(Layer):
     def forward(self, input, positive, negative):
         return F.triplet_margin_with_distance_loss(input, positive, negative,
                                                    *self.args)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss (reference: paddle.nn.HSigmoidLoss).
+
+    Default mode builds the complete binary tree over ``num_classes``
+    leaves in heap numbering: leaf for class c is node ``c + num_classes-1``;
+    walking parents to the root yields each class's (node, code) path, which
+    is precomputed host-side into static [num_classes, depth] tables so the
+    traced forward is pure gathers + log-sigmoids (no per-class control
+    flow — XLA-friendly in place of the reference's custom CPU/GPU kernel).
+    """
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        import numpy as np
+
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        n_nodes = num_classes - 1
+        self.weight = self.create_parameter([n_nodes, feature_size],
+                                            attr=weight_attr)
+        self.bias = self.create_parameter([n_nodes], attr=bias_attr,
+                                          is_bias=True)
+        if not is_custom:
+            depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+            table = np.zeros((num_classes, depth), np.int64)
+            code = np.zeros((num_classes, depth), np.float32)
+            mask = np.zeros((num_classes, depth), np.float32)
+            for c in range(num_classes):
+                node = c + n_nodes  # leaf, heap numbering
+                path = []
+                while node > 0:
+                    parent = (node - 1) // 2
+                    path.append((parent, float(node == 2 * parent + 2)))
+                    node = parent
+                for d, (n, bit) in enumerate(reversed(path)):
+                    if d < depth:
+                        table[c, d] = n
+                        code[c, d] = bit
+                        mask[c, d] = 1.0
+            self._table, self._code, self._mask = table, code, mask
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        import jax
+        import jax.numpy as jnp
+
+        from ...tensor.dispatch import apply
+
+        if self.is_custom and (path_table is None or path_code is None):
+            raise ValueError("is_custom=True requires path_table/path_code")
+
+        if path_table is not None:
+            def fn(x, y, w, b, pt, pc):
+                rows = w[pt]                     # [B, D, F]
+                bias = b[pt]
+                logit = (rows * x[:, None, :]).sum(-1) + bias
+                sign = 1.0 - 2.0 * pc            # code 0 -> +, 1 -> -
+                valid = (pt >= 0).astype(jnp.float32)
+                ll = jax.nn.log_sigmoid(sign * logit) * valid
+                return -(ll.sum(-1))[:, None]
+
+            return apply(fn, input, label, self.weight, self.bias,
+                         path_table, path_code, op_name="hsigmoid_loss")
+
+        table, codes, mask = self._table, self._code, self._mask
+
+        def fn(x, y, w, b):
+            pt = jnp.asarray(table)[y]           # [B, D]
+            pc = jnp.asarray(codes)[y]
+            mk = jnp.asarray(mask)[y]
+            rows = w[pt]
+            bias = b[pt]
+            logit = (rows * x[:, None, :]).sum(-1) + bias
+            sign = 1.0 - 2.0 * pc
+            ll = jax.nn.log_sigmoid(sign * logit) * mk
+            return -(ll.sum(-1))[:, None]
+
+        return apply(fn, input, label, self.weight, self.bias,
+                     op_name="hsigmoid_loss")

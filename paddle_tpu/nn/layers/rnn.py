@@ -358,3 +358,112 @@ class GRU(_RNNBase):
                  time_major=False, dropout=0.0, **kw):
         super().__init__("GRU", input_size, hidden_size, num_layers, direction,
                          time_major, dropout)
+
+
+class BeamSearchDecoder(Layer):
+    """Beam-search decoding over an RNN cell (reference:
+    paddle.nn.BeamSearchDecoder + dynamic_decode).
+
+    TPU-native shape: the beam dim folds into the batch ([B*K, ...]) so the
+    cell always sees a static batch; beam bookkeeping (top-k over K*V,
+    state gather, finished freezing) is expressed in jnp ops per step and
+    driven by :func:`dynamic_decode`'s host loop (decode length is data-
+    dependent; each step is one dispatched program of fixed shape).
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*K, ...] (reference helper of the same name)."""
+        def fn(v):
+            return jnp.repeat(v, beam_size, axis=0)
+
+        return apply(fn, x, op_name="tile_beam_merge_with_batch")
+
+    def initialize(self, initial_cell_states):
+        K = self.beam_size
+        states = jax.tree_util.tree_map(
+            lambda t: self.tile_beam_merge_with_batch(t, K)
+            if isinstance(t, Tensor) else t, initial_cell_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        first = jax.tree_util.tree_leaves(
+            initial_cell_states, is_leaf=lambda t: isinstance(t, Tensor))[0]
+        B = first.shape[0]
+        tokens = Tensor(jnp.full((B * K,), self.start_token, jnp.int64))
+        # beam 0 live, others -inf so step 1 expands only the start beam
+        log_probs = jnp.where(jnp.arange(B * K) % K == 0, 0.0, -1e9)
+        finished = jnp.zeros((B * K,), bool)
+        return tokens, states, (log_probs, finished)
+
+    def step(self, time, tokens, states, beam_state):
+        K = self.beam_size
+        log_probs, finished = beam_state
+        inp = self.embedding_fn(tokens) if self.embedding_fn else tokens
+        out, next_states = self.cell(inp, states)
+        logits = self.output_fn(out) if self.output_fn else out
+        lv = unwrap(logits)
+        BK, V = lv.shape
+        B = BK // K
+        logp = jax.nn.log_softmax(lv.astype(jnp.float32), axis=-1)
+        # finished beams extend only with end_token at no cost
+        frozen = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        logp = jnp.where(finished[:, None], frozen[None, :], logp)
+        cand = (log_probs[:, None] + logp).reshape(B, K * V)
+        top_scores, pick = jax.lax.top_k(cand, K)       # [B, K]
+        beam_idx = pick // V + (jnp.arange(B) * K)[:, None]  # flat [B,K]
+        token = (pick % V).reshape(-1).astype(jnp.int64)
+        flat_idx = beam_idx.reshape(-1)
+
+        def gather(t):
+            if isinstance(t, Tensor):
+                return Tensor(jnp.take(unwrap(t), flat_idx, axis=0))
+            return t
+
+        next_states = jax.tree_util.tree_map(
+            gather, next_states, is_leaf=lambda t: isinstance(t, Tensor))
+        finished = jnp.take(finished, flat_idx) | (token == self.end_token)
+        return (Tensor(token), next_states,
+                (top_scores.reshape(-1), finished), flat_idx)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Run ``decoder`` until every beam finishes or ``max_step_num`` steps
+    (reference: paddle.nn.dynamic_decode).  Returns (ids [B, T, K],
+    scores [B, K]) (+ lengths when requested)."""
+    if max_step_num is None:
+        max_step_num = 64
+    tokens, states, beam_state = decoder.initialize(inits)
+    K = decoder.beam_size
+    steps = []
+    for t in range(max_step_num):
+        tokens, states, beam_state, reorder = decoder.step(
+            t, tokens, states, beam_state)
+        # top-k reorders beams: regather the HISTORY through the parent
+        # indices so slot k always holds the full prefix of hypothesis k
+        steps = [jnp.take(s, reorder, axis=0) for s in steps]
+        steps.append(unwrap(tokens))
+        if bool(beam_state[1].all()):
+            break
+    log_probs, finished = beam_state
+    ids = jnp.stack(steps, axis=-1)                  # [B*K, T]
+    B = ids.shape[0] // K
+    ids = ids.reshape(B, K, -1).transpose(0, 2, 1)   # [B, T, K]
+    scores = log_probs.reshape(B, K)
+    if output_time_major:
+        ids = ids.transpose(1, 0, 2)
+    outs = (Tensor(ids), Tensor(scores))
+    if return_length:
+        lengths = (ids != decoder.end_token).sum(axis=1 if not output_time_major else 0)
+        outs = outs + (Tensor(lengths),)
+    return outs

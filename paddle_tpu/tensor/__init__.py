@@ -22,8 +22,11 @@ from .attribute import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
 from .extras import *  # noqa: F401,F403
 
-# linalg is exposed as a namespace (paddle.linalg.*) plus a few top-level names
-from .linalg import norm, dist  # noqa: F401
+# linalg is exposed as a namespace (paddle.linalg.*) plus the top-level
+# spellings the reference also has
+from .linalg import (  # noqa: F401
+    norm, dist, cholesky, cholesky_solve, lu, lu_unpack, matrix_power,
+)
 
 
 def t(x, name=None):  # paddle.t — 2-D transpose
@@ -148,6 +151,16 @@ def _attach_methods():
     Tensor.normal_ = random.normal_
     Tensor.uniform_ = random.uniform_
     Tensor.bernoulli_ = random.bernoulli_
+    from . import extras as _ex
+
+    for _n in ("cauchy_", "geometric_", "log_normal_", "fill_diagonal_",
+               "erfinv_", "trunc_", "lerp_", "index_add_", "addmm_",
+               "put_along_axis_", "aminmax", "ravel", "msort", "pdist",
+               "fill_diagonal", "slice_scatter", "select_scatter",
+               "view_as_real", "view_as_complex", "gammaln", "i0e", "i1e",
+               "logaddexp2"):
+        if not hasattr(Tensor, _n):
+            setattr(Tensor, _n, getattr(_ex, _n))
 
 
 _attach_methods()

@@ -203,3 +203,339 @@ def as_strided(x, shape, stride, offset=0, name=None):
         return flat[idx].reshape(tuple(shape))
 
     return apply(fn, x, op_name="as_strided")
+
+
+# ------------------------------------------------- long-tail ops (round 4)
+def aminmax(x, axis=None, keepdim=False, name=None):
+    def fn(v):
+        return jnp.min(v, axis=axis, keepdims=keepdim), \
+            jnp.max(v, axis=axis, keepdims=keepdim)
+
+    return apply(fn, x, op_name="aminmax", n_outs=None)
+
+
+def msort(x, name=None):
+    return apply(lambda v: jnp.sort(v, axis=0), x, op_name="msort")
+
+
+def ravel(x, name=None):
+    return apply(lambda v: v.reshape(-1), x, op_name="ravel")
+
+
+def logaddexp2(x, y, name=None):
+    return apply(jnp.logaddexp2, x, y, op_name="logaddexp2")
+
+
+def iscomplex(x, name=None):
+    from .tensor import Tensor as _T
+
+    v = x._value if isinstance(x, _T) else jnp.asarray(x)
+    return _T(jnp.asarray(jnp.iscomplexobj(v)))
+
+
+def gammaln(x, name=None):
+    from jax.scipy.special import gammaln as f
+
+    return apply(f, x, op_name="gammaln")
+
+
+def gammainc(x, y, name=None):
+    from jax.scipy.special import gammainc as f
+
+    return apply(f, x, y, op_name="gammainc")
+
+
+def gammaincc(x, y, name=None):
+    from jax.scipy.special import gammaincc as f
+
+    return apply(f, x, y, op_name="gammaincc")
+
+
+def multigammaln(x, p, name=None):
+    from jax.scipy.special import multigammaln as f
+
+    return apply(lambda v: f(v, p), x, op_name="multigammaln")
+
+
+def i0e(x, name=None):
+    from jax.scipy.special import i0e as f
+
+    return apply(f, x, op_name="i0e")
+
+
+def i1e(x, name=None):
+    from jax.scipy.special import i1e as f
+
+    return apply(f, x, op_name="i1e")
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of a [N, D] matrix (upper triangle)."""
+    def fn(v):
+        n = v.shape[0]
+        # gather the (i<j) pairs FIRST: a full n x n matrix would put
+        # sqrt(0) on the diagonal, whose infinite derivative turns the
+        # whole backward into NaN even though the diagonal is discarded
+        iu, ju = jnp.triu_indices(n, k=1)
+        d = v[iu] - v[ju]
+        if p == 2.0:
+            return jnp.sqrt((d * d).sum(-1))
+        return (jnp.abs(d) ** p).sum(-1) ** (1.0 / p)
+
+    return apply(fn, x, op_name="pdist")
+
+
+def fill(x, value, name=None):
+    return apply(lambda v: jnp.full_like(v, value), x, op_name="fill")
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    def fn(v):
+        n = min(v.shape[-2], v.shape[-1])
+        i = jnp.arange(n - abs(offset))
+        rows = i + max(-offset, 0)
+        cols = i + max(offset, 0)
+        return v.at[..., rows, cols].set(value)
+
+    return apply(fn, x, op_name="fill_diagonal")
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    out = fill_diagonal(x, value, offset, wrap)
+    return x._inplace_from(out)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides=None, name=None):
+    """Write ``value`` into the slice of ``x`` given by axes/starts/ends
+    (reference: paddle.slice_scatter)."""
+    strides = strides or [1] * len(axes)
+
+    def fn(v, val):
+        idx = [slice(None)] * v.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(s, e, st)
+        return v.at[tuple(idx)].set(val)
+
+    return apply(fn, x, value, op_name="slice_scatter")
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1, name=None):
+    """Map global label ids to shard-local ids (reference: the
+    parameter-server-era shard_index op; kept for API parity — useful for
+    sharded-vocab losses)."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(f"shard_id {shard_id} out of range [0, {nshards})")
+    size = (index_num + nshards - 1) // nshards
+
+    def fn(v):
+        lo = shard_id * size
+        inside = (v >= lo) & (v < lo + size)
+        return jnp.where(inside, v - lo, ignore_value)
+
+    return apply(fn, x, op_name="shard_index")
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def view_as_real(x, name=None):
+    def fn(v):
+        return jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1)
+
+    return apply(fn, x, op_name="view_as_real")
+
+
+def view_as_complex(x, name=None):
+    return apply(jax.lax.complex, x[..., 0], x[..., 1], op_name="view_as_complex")
+
+
+def dequantize(x, scale, zero_point=0, name=None):
+    """Linear dequantize (reference: paddle dequantize ops): (q - zp) * scale."""
+    return apply(lambda q, s: (q.astype(jnp.float32) - zero_point) * s,
+                 x, scale, op_name="dequantize")
+
+
+# --------------------------------------------------- random long tail (r4)
+def standard_gamma(alpha, name=None):
+    from ..framework import random as _rng
+
+    key = _rng.next_key()
+    return apply(lambda a: jax.random.gamma(key, a), alpha,
+                 op_name="standard_gamma")
+
+
+def standard_exponential(shape, dtype="float32", name=None):
+    from ..framework import random as _rng
+    from ..framework import dtypes as _dt
+
+    key = _rng.next_key()
+    return Tensor(jax.random.exponential(key, tuple(shape), _dt.to_jax(dtype)))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype="float32", name=None):
+    from ..framework import random as _rng
+    from ..framework import dtypes as _dt
+
+    key = _rng.next_key()
+    g = jax.random.normal(key, tuple(shape or ()), _dt.to_jax(dtype))
+    return Tensor(jnp.exp(mean + std * g))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    from ..framework import random as _rng
+
+    key = _rng.next_key()
+
+    def fn(v):
+        g = jax.random.normal(key, v.shape, v.dtype if
+                              jnp.issubdtype(v.dtype, jnp.floating)
+                              else jnp.float32)
+        return jnp.exp(mean + std * g).astype(v.dtype)
+
+    return x._inplace_unary(fn, "log_normal_")
+
+
+def cauchy_(x, loc=0.0, scale=1.0, name=None):
+    from ..framework import random as _rng
+
+    key = _rng.next_key()
+
+    def fn(v):
+        u = jax.random.uniform(key, v.shape, jnp.float32, 1e-7, 1.0 - 1e-7)
+        return (loc + scale * jnp.tan(jnp.pi * (u - 0.5))).astype(v.dtype)
+
+    return x._inplace_unary(fn, "cauchy_")
+
+
+def geometric_(x, probs=0.5, name=None):
+    from ..framework import random as _rng
+
+    key = _rng.next_key()
+
+    def fn(v):
+        u = jax.random.uniform(key, v.shape, jnp.float32, 1e-7, 1.0)
+        return (jnp.floor(jnp.log(u) / jnp.log1p(-probs)) + 1.0).astype(v.dtype)
+
+    return x._inplace_unary(fn, "geometric_")
+
+
+# ---------------------------------------------------- inplace variants (r4)
+def addmm_(input, x, y, beta=1.0, alpha=1.0, name=None):
+    def fn(inp, a, b):
+        return beta * inp + alpha * (a @ b)
+
+    out = apply(fn, input, x, y, op_name="addmm_")
+    return input._inplace_from(out)
+
+
+def index_add_(x, index, axis, value, name=None):
+    def fn(v, idx, val):
+        idx_t = [slice(None)] * v.ndim
+        idx_t[axis] = idx
+        return v.at[tuple(idx_t)].add(val)
+
+    out = apply(fn, x, index, value, op_name="index_add_")
+    return x._inplace_from(out)
+
+
+def put_along_axis_(x, indices, values, axis, reduce="assign", name=None):
+    from .manipulation import put_along_axis
+
+    out = put_along_axis(x, indices, values, axis, reduce)
+    return x._inplace_from(out)
+
+
+def erfinv_(x, name=None):
+    from jax.scipy.special import erfinv as f
+
+    return x._inplace_unary(f, "erfinv_")
+
+
+def trunc_(x, name=None):
+    return x._inplace_unary(jnp.trunc, "trunc_")
+
+
+def lerp_(x, y, weight, name=None):
+    from .math import lerp
+
+    out = lerp(x, y, weight)
+    return x._inplace_from(out)
+
+
+# ------------------------------------------------ missing regulars (r4b)
+def add_n(inputs, name=None):
+    """Sum a list of tensors elementwise (reference: paddle.add_n)."""
+    if isinstance(inputs, (list, tuple)):
+        def fn(*vs):
+            out = vs[0]
+            for v in vs[1:]:
+                out = out + v
+            return out
+
+        return apply(fn, *inputs, op_name="add_n")
+    return apply(lambda v: v, inputs, op_name="add_n")
+
+
+def bitwise_invert(x, name=None):
+    return apply(jnp.invert, x, op_name="bitwise_invert")
+
+
+def erfc(x, name=None):
+    from jax.scipy.special import erfc as f
+
+    return apply(f, x, op_name="erfc")
+
+
+# ------------------------------------------- generated inplace variants
+# the reference pairs nearly every unary math op with an in-place `op_`
+# spelling; generate them from the same jnp rules so the tape/rebind
+# discipline is identical to the hand-written ones in math.py
+def _gen_inplace(name, fn):
+    def op_(x, *args, **kwargs):
+        return x._inplace_unary(lambda v: fn(v, *args, **kwargs),
+                                name + "_")
+
+    op_.__name__ = name + "_"
+    return op_
+
+
+_INPLACE_RULES = {
+    "acos": jnp.arccos, "acosh": jnp.arccosh, "asin": jnp.arcsin,
+    "asinh": jnp.arcsinh, "atan": jnp.arctan, "atanh": jnp.arctanh,
+    "cos": jnp.cos, "cosh": jnp.cosh, "sin": jnp.sin, "sinh": jnp.sinh,
+    "tan": jnp.tan, "expm1": jnp.expm1, "square": jnp.square,
+    "neg": jnp.negative, "frac": lambda v: v - jnp.trunc(v),
+    "deg2rad": jnp.deg2rad, "rad2deg": jnp.rad2deg,
+    "nan_to_num": jnp.nan_to_num, "i0": lambda v: jax.scipy.special.i0(v),
+    "digamma": lambda v: jax.scipy.special.digamma(v),
+    "lgamma": lambda v: jax.scipy.special.gammaln(v),
+    "erfc": lambda v: jax.scipy.special.erfc(v),
+}
+
+for _n, _f in _INPLACE_RULES.items():
+    globals().setdefault(_n + "_", _gen_inplace(_n, _f))
+
+
+def _gen_inplace_bin(name, fn):
+    def op_(x, y, *args, **kwargs):
+        from .tensor import Tensor as _T
+
+        yv = y._value if isinstance(y, _T) else y
+        return x._inplace_unary(lambda v: fn(v, yv, *args, **kwargs),
+                                name + "_")
+
+    op_.__name__ = name + "_"
+    return op_
+
+
+_INPLACE_BIN_RULES = {
+    "copysign": jnp.copysign, "hypot": jnp.hypot, "ldexp": jnp.ldexp,
+    "floor_mod": jnp.mod, "pow": jnp.power,
+    "polygamma": lambda v, n: jax.scipy.special.polygamma(n, v),
+}
+
+for _n, _f in _INPLACE_BIN_RULES.items():
+    globals().setdefault(_n + "_", _gen_inplace_bin(_n, _f))

@@ -194,3 +194,83 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     u, s, vt = jnp.linalg.svd(v, full_matrices=False)
     k = q or min(v.shape)
     return Tensor(u[:, :k]), Tensor(s[:k]), Tensor(vt[:k].T)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Unpack lu()'s packed factorization into (P, L, U) (reference:
+    paddle.linalg.lu_unpack; pivots are 1-based as lu() returns them)."""
+    v = unwrap(lu_data)
+    piv = unwrap(lu_pivots)
+    m, n = v.shape[-2], v.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(v[..., :, :k], -1) + jnp.eye(m, k, dtype=v.dtype)
+    U = jnp.triu(v[..., :k, :])
+
+    def perm_matrix(piv_1d):
+        # pivots -> permutation matrix: row swaps applied in order
+        perm = jnp.arange(m)
+        for i in range(piv_1d.shape[-1]):
+            j = piv_1d[i] - 1
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        return jnp.eye(m, dtype=v.dtype)[perm].T
+
+    if piv.ndim > 1:  # batched factorization: map the swap walk per batch
+        flat = piv.reshape(-1, piv.shape[-1])
+        P = jax.vmap(perm_matrix)(flat).reshape(piv.shape[:-1] + (m, m))
+    else:
+        P = perm_matrix(piv)
+    outs = []
+    outs.append(Tensor(P) if unpack_pivots else None)
+    if unpack_ludata:
+        outs.extend([Tensor(L), Tensor(U)])
+    return tuple(outs)
+
+
+def matrix_exp(x, name=None):
+    return apply(lambda v: jax.scipy.linalg.expm(v), x, op_name="matrix_exp")
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply ``other`` by the Q of a householder factorization
+    (reference: paddle.linalg.ormqr) — Q materialized via
+    householder_product, then one matmul."""
+    def fn(a, t, o):
+        q = jax.lax.linalg.householder_product(a, t)
+        qm = jnp.swapaxes(q, -1, -2) if transpose else q
+        return qm @ o if left else o @ qm
+
+    return apply(fn, x, tau, other, op_name="ormqr")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference: paddle.linalg.svd_lowrank —
+    Halko-Martinsson-Tropp subspace iteration)."""
+    def fn(v):
+        import jax.random as jrnd
+
+        m, n = v.shape[-2], v.shape[-1]
+        k = min(q, m, n)
+        g = jrnd.normal(jrnd.key(0), v.shape[:-2] + (n, k), v.dtype)
+        y = v @ g
+        for _ in range(niter):
+            y = v @ (jnp.swapaxes(v, -1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -1, -2) @ v
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u, s, jnp.swapaxes(vh, -1, -2)
+
+    if M is not None:
+        x = x - M if isinstance(x, Tensor) else Tensor(unwrap(x) - unwrap(M))
+    return apply(fn, x, op_name="svd_lowrank")
+
+
+def logdet(x, name=None):
+    """log(det(A)) (reference: paddle.linalg.logdet) — nan when det<=0,
+    since the log of a non-positive determinant is undefined over R."""
+    def fn(v):
+        sign, ld = jnp.linalg.slogdet(v)
+        return jnp.where(sign > 0, ld, jnp.nan)
+
+    return apply(fn, x, op_name="logdet")

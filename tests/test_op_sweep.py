@@ -772,6 +772,106 @@ spec("complex", lambda real, imag: paddle.complex(real, imag),
      lambda real, imag: real + 1j * imag,
      {"real": rnd(3, 4, seed=265), "imag": rnd(3, 4, seed=266)}, grad=False)
 
+# ---------------------------------------------------- round-4 long tail
+try:
+    from scipy import special as _sp
+except Exception:  # pragma: no cover
+    _sp = None
+
+U("gammaln", lambda x: np.vectorize(math.lgamma)(np.abs(x) + 0.5),
+  fn=lambda x: paddle.gammaln(paddle.abs(x) + 0.5))
+B("logaddexp2", np.logaddexp2)
+U("msort", lambda x: np.sort(x, axis=0))
+U("ravel", lambda x: x.reshape(-1))
+if _sp is not None:  # scipy provides the references for the special fns
+    U("i0e", lambda x: _sp.i0e(x), grad=False)
+    U("i1e", lambda x: _sp.i1e(x), grad=False)
+    spec("gammainc", lambda x, y: paddle.gammainc(x, y),
+         lambda x, y: _sp.gammainc(x, y),
+         {"x": pos(3, 4, seed=301), "y": pos(3, 4, seed=302)}, grad=False)
+    spec("gammaincc", lambda x, y: paddle.gammaincc(x, y),
+         lambda x, y: _sp.gammaincc(x, y),
+         {"x": pos(3, 4, seed=303), "y": pos(3, 4, seed=304)}, grad=False)
+    spec("multigammaln", lambda x: paddle.multigammaln(x + 3.0, 2),
+         lambda x: _sp.multigammaln(x + 3.0, 2),
+         {"x": pos(3, 4, seed=305)}, grad=False)
+spec("aminmax", lambda x: paddle.aminmax(x)[1], lambda x: x.max(),
+     {"x": rnd(3, 4, seed=306)})
+spec("pdist", lambda x: paddle.pdist(x),
+     lambda x: np.sqrt(((x[:, None, :] - x[None, :, :]) ** 2).sum(-1))[
+         np.triu_indices(x.shape[0], 1)],
+     {"x": rnd(5, 3, seed=307)})
+spec("fill", lambda x: paddle.fill(x, 2.5), lambda x: np.full_like(x, 2.5),
+     {"x": rnd(3, 4, seed=308)}, grad=False)
+spec("fill_diagonal", lambda x: paddle.fill_diagonal(x, 9.0),
+     lambda x: np.copyto(x.copy(), x) or _fd_ref(x, 9.0),
+     {"x": rnd(4, 4, seed=309)}, grad=False)
+spec("slice_scatter",
+     lambda x, v: paddle.slice_scatter(x, v, axes=[1], starts=[1], ends=[3]),
+     lambda x, v: _ss_ref(x, v),
+     {"x": rnd(3, 4, seed=310), "v": rnd(3, 2, seed=311)})
+spec("select_scatter",
+     lambda x, v: paddle.select_scatter(x, v, axis=1, index=2),
+     lambda x, v: _sel_ref(x, v),
+     {"x": rnd(3, 4, seed=312), "v": rnd(3, seed=313)})
+spec("shard_index",
+     lambda x: paddle.shard_index(paddle.to_tensor(
+         np.array([[0], [7], [15]], "int64")), 16, 2, 1),
+     lambda x: np.array([[-1], [-1], [7]], "int64"),
+     {"x": rnd(1, seed=314)}, grad=False)
+spec("view_as_real", lambda x: paddle.view_as_real(paddle.complex(x, x * 2)),
+     lambda x: np.stack([x, 2 * x], axis=-1),
+     {"x": rnd(3, 4, seed=315)}, grad=False)
+spec("view_as_complex",
+     lambda x: paddle.real(paddle.view_as_complex(x)),
+     lambda x: x[..., 0], {"x": rnd(3, 4, 2, seed=316)}, grad=False)
+spec("dequantize",
+     lambda x: paddle.dequantize(paddle.to_tensor(
+         np.array([[10, 20]], "int8")), paddle.to_tensor(0.5), zero_point=2),
+     lambda x: np.array([[4.0, 9.0]], "float32"),
+     {"x": rnd(1, seed=317)}, grad=False)
+spec("logdet", lambda x: paddle.linalg.logdet(
+         x @ x.transpose([1, 0]) + 3.0 * paddle.eye(4)),
+     lambda x: np.log(np.linalg.det(x @ x.T + 3.0 * np.eye(4, dtype="float32"))),
+     {"x": rnd(4, 4, seed=318)})
+if _sp is not None:
+    spec("matrix_exp", lambda x: paddle.linalg.matrix_exp(x * 0.3),
+         lambda x: _expm_ref(x * 0.3), {"x": rnd(4, 4, seed=319)}, grad=False)
+spec("cholesky_top", lambda x: paddle.cholesky(
+         x @ x.transpose([1, 0]) + 3.0 * paddle.eye(4)),
+     lambda x: np.linalg.cholesky(x @ x.T + 3.0 * np.eye(4, dtype="float32")),
+     {"x": rnd(4, 4, seed=320)}, grad=False)
+spec("broadcast_shape_fn",
+     lambda x: paddle.to_tensor(np.array(
+         paddle.broadcast_shape([3, 1, 4], [2, 4]), "int64")),
+     lambda x: np.array([3, 2, 4], "int64"),
+     {"x": rnd(1, seed=321)}, grad=False)
+
+
+def _fd_ref(x, val):
+    out = x.copy()
+    np.fill_diagonal(out, val)
+    return out
+
+
+def _ss_ref(x, v):
+    out = x.copy()
+    out[:, 1:3] = v
+    return out
+
+
+def _sel_ref(x, v):
+    out = x.copy()
+    out[:, 2] = v
+    return out
+
+
+def _expm_ref(x):
+    from scipy.linalg import expm
+
+    return expm(x.astype("float64")).astype("float32")
+
+
 SPECS = [s for s in SPECS if s is not None]
 _IDS = [s["id"] for s in SPECS]
 assert len(set(_IDS)) == len(_IDS), "duplicate spec ids"
