@@ -18,7 +18,6 @@ import jax.numpy as jnp
 import numpy as np
 
 _EXCLUDED: set[int] = set()  # id(Layer) excluded from pruning
-_MASKS: dict[int, object] = {}  # id(param) -> jnp mask
 
 
 def calculate_mask(w, n=2, m=4):
@@ -96,7 +95,10 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         if getattr(p, "_master", None) is not None:
             p._master = p._master * mask.astype(p._master.dtype)
         if with_mask:
-            _MASKS[id(p)] = mask
+            # the mask lives ON the parameter: a global id()-keyed registry
+            # can hand a STALE mask to an unrelated new param when ids are
+            # reused after GC (observed as flaky corruption in the suite)
+            p._asp_mask = mask
         out[name_of.get(id(p), f"param_{id(p)}")] = mask
     return out
 
@@ -118,7 +120,7 @@ def decorate(optimizer):
 
         with no_grad_ctx():
             for p in optimizer._parameter_list:
-                mask = _MASKS.get(id(p))
+                mask = getattr(p, "_asp_mask", None)
                 if mask is not None:
                     p._value = p._value * mask
                     if getattr(p, "_master", None) is not None:

@@ -33,7 +33,7 @@ _bool = bool  # guarded against the paddle-style module-level `bool` dtype alias
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "grad", "_grad_node", "_retain_grads",
                  "name", "persistable", "_master", "_grad_hooks", "_dist_attr",
-                 "__weakref__")
+                 "_asp_mask", "__weakref__")
 
     # let Tensor.__r*__ win over np.ndarray ops
     __array_priority__ = 100

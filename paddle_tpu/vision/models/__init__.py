@@ -26,6 +26,10 @@ from .vision_transformer import (  # noqa: F401
     VisionTransformer, vit_b_16, vit_b_32, vit_l_16, vit_s_16,
 )
 
+from .inception import (  # noqa: F401
+    GoogLeNet, InceptionV3, googlenet, inception_v3,
+)
+
 from .detection import (  # noqa: F401
     YOLOv3, FasterRCNN, ResNetBackbone, FPN, yolov3, ppyoloe, faster_rcnn,
 )

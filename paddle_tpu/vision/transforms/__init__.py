@@ -10,10 +10,10 @@ from .transforms import (  # noqa: F401
     BaseTransform, Compose, ToTensor, Normalize, Transpose, Resize, RandomResizedCrop,
     CenterCrop, RandomCrop, RandomHorizontalFlip, RandomVerticalFlip, Pad,
     BrightnessTransform, ContrastTransform, SaturationTransform, HueTransform,
-    ColorJitter, Grayscale, RandomRotation, RandomErasing,
+    ColorJitter, Grayscale, RandomAffine, RandomPerspective, RandomRotation, RandomErasing,
 )
 from . import functional  # noqa: F401
 from .functional import (  # noqa: F401
     to_tensor, normalize, resize, crop, center_crop, hflip, vflip, pad, to_grayscale,
-    adjust_brightness, adjust_contrast, adjust_saturation, adjust_hue, rotate, erase,
+    adjust_brightness, adjust_contrast, adjust_saturation, adjust_hue, affine, perspective, rotate, erase,
 )

@@ -226,3 +226,90 @@ def erase(img, i, j, h, w, v, inplace=False):
     img = img if inplace else img.copy()
     img[i:i + h, j:j + w] = v
     return img
+
+
+def _inverse_affine_matrix(angle, translate, scale, shear, center):
+    """Inverse of the torchvision/reference affine parameterization:
+    M = T(center) R(angle) Sh(shear) S(scale) T(-center) T(translate)."""
+    rot = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in (shear if isinstance(shear, (list, tuple))
+                                      else (shear, 0.0)))
+    cx, cy = center
+    tx, ty = translate
+    # forward matrix coefficients (as in the reference implementation)
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a * scale, b * scale, 0.0],
+                  [c * scale, d * scale, 0.0],
+                  [0.0, 0.0, 1.0]], np.float64)
+    m[0, 2] = cx + tx - m[0, 0] * cx - m[0, 1] * cy
+    m[1, 2] = cy + ty - m[1, 0] * cx - m[1, 1] * cy
+    return np.linalg.inv(m)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine warp (reference: paddle.vision.transforms.functional.affine):
+    rotation + translation + scale + shear about the center, inverse-mapped
+    with nearest/bilinear sampling."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    inv = _inverse_affine_matrix(angle, translate, scale, shear, center)
+    ys, xs = np.meshgrid(np.arange(h, dtype=np.float64),
+                         np.arange(w, dtype=np.float64), indexing="ij")
+    src_x = inv[0, 0] * xs + inv[0, 1] * ys + inv[0, 2]
+    src_y = inv[1, 0] * xs + inv[1, 1] * ys + inv[1, 2]
+    return _sample(img, src_y, src_x, interpolation, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Perspective warp mapping startpoints -> endpoints (reference:
+    F.perspective; points are [[x, y]] quads)."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    # solve the 8-dof homography sending endpoints -> startpoints (inverse
+    # map for sampling)
+    a = []
+    bvec = []
+    for (ex, ey), (sx_, sy_) in zip(endpoints, startpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx_ * ex, -sx_ * ey])
+        bvec.append(sx_)
+        a.append([0, 0, 0, ex, ey, 1, -sy_ * ex, -sy_ * ey])
+        bvec.append(sy_)
+    coef = np.linalg.lstsq(np.asarray(a, np.float64),
+                           np.asarray(bvec, np.float64), rcond=None)[0]
+    hm = np.append(coef, 1.0).reshape(3, 3)
+    ys, xs = np.meshgrid(np.arange(h, dtype=np.float64),
+                         np.arange(w, dtype=np.float64), indexing="ij")
+    den = hm[2, 0] * xs + hm[2, 1] * ys + hm[2, 2]
+    src_x = (hm[0, 0] * xs + hm[0, 1] * ys + hm[0, 2]) / den
+    src_y = (hm[1, 0] * xs + hm[1, 1] * ys + hm[1, 2]) / den
+    return _sample(img, src_y, src_x, interpolation, fill)
+
+
+def _sample(img, src_y, src_x, interpolation, fill):
+    h, w = img.shape[:2]
+    if interpolation == "bilinear":
+        y0 = np.floor(src_y).astype(np.int64)
+        x0 = np.floor(src_x).astype(np.int64)
+        wy = (src_y - y0)[..., None]
+        wx = (src_x - x0)[..., None]
+
+        def at(yi, xi):
+            valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+            v = img[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)].astype(np.float64)
+            return np.where(valid[..., None], v, float(fill))
+
+        out = (at(y0, x0) * (1 - wy) * (1 - wx) + at(y0, x0 + 1) * (1 - wy) * wx
+               + at(y0 + 1, x0) * wy * (1 - wx) + at(y0 + 1, x0 + 1) * wy * wx)
+        return out.astype(img.dtype)
+    yi = np.round(src_y).astype(np.int64)
+    xi = np.round(src_x).astype(np.int64)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full(img.shape, fill, dtype=img.dtype)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out

@@ -299,3 +299,71 @@ class RandomErasing(BaseTransform):
                 j = random.randint(0, w - ew)
                 return F.erase(img, i, j, eh, ew, self.value, self.inplace)
         return img
+
+
+class RandomAffine(BaseTransform):
+    """Random affine: rotation + translation + scale + shear (reference:
+    paddle.vision.transforms.RandomAffine)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        h, w = F._as_hwc(img).shape[:2]
+        angle = random.uniform(*self.degrees)
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+            translate = (tx, ty)
+        else:
+            translate = (0.0, 0.0)
+        scale = random.uniform(*self.scale) if self.scale is not None else 1.0
+        if self.shear is not None:
+            sh = self.shear
+            if isinstance(sh, numbers.Number):
+                sh = (-sh, sh)
+            if len(sh) == 2:
+                shear = (random.uniform(sh[0], sh[1]), 0.0)
+            else:
+                shear = (random.uniform(sh[0], sh[1]),
+                         random.uniform(sh[2], sh[3]))
+        else:
+            shear = (0.0, 0.0)
+        return F.affine(img, angle, translate, scale, shear,
+                        self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """Random four-point perspective distortion (reference transform)."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        h, w = F._as_hwc(img).shape[:2]
+        d = self.distortion_scale
+        hd = int(d * h / 2)
+        wd = int(d * w / 2)
+        start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
+        end = [[random.randint(0, wd), random.randint(0, hd)],
+               [w - 1 - random.randint(0, wd), random.randint(0, hd)],
+               [w - 1 - random.randint(0, wd), h - 1 - random.randint(0, hd)],
+               [random.randint(0, wd), h - 1 - random.randint(0, hd)]]
+        return F.perspective(img, start, end, self.interpolation, self.fill)
