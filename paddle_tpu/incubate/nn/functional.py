@@ -97,3 +97,137 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
 
     args = (x, norm_weight) if norm_bias is None else (x, norm_weight, norm_bias)
     return _apply(fn, *args, op_name="rms_norm")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """One fused matmul+bias (reference: incubate fused op over cublasLt;
+    XLA fuses the bias add into the GEMM epilogue on TPU)."""
+    from ...tensor.dispatch import apply
+    import jax.numpy as jnp
+
+    def fn(xv, yv, *b):
+        a = jnp.swapaxes(xv, -1, -2) if transpose_x else xv
+        w = jnp.swapaxes(yv, -1, -2) if transpose_y else yv
+        out = a @ w
+        return out + b[0] if b else out
+
+    args = (x, y) if bias is None else (x, y, bias)
+    return apply(fn, *args, op_name="fused_matmul_bias")
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     residual=None, bias=None, name=None):
+    """LayerNorm with optional pre-norm residual+bias fusion (reference:
+    fused_layer_norm / fused_bias_residual_layernorm)."""
+    from ...tensor.dispatch import apply
+    import jax
+    import jax.numpy as jnp
+
+    def fn(xv, g, b, *extra):
+        h = xv
+        i = 0
+        if residual is not None:
+            h = h + extra[i]
+            i += 1
+        if bias is not None:
+            h = h + extra[i]
+        mu = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.var(h, axis=-1, keepdims=True)
+        return ((h - mu) * jax.lax.rsqrt(var + epsilon)) * g + b
+
+    args = [x, norm_weight, norm_bias]
+    if residual is not None:
+        args.append(residual)
+    if bias is not None:
+        args.append(bias)
+    return apply(fn, *args, op_name="fused_layer_norm")
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    name=None):
+    """RoPE applied to q (and k) in one traced op (reference:
+    incubate.nn.functional.fused_rotary_position_embedding)."""
+    from ...tensor.dispatch import apply
+    import jax.numpy as jnp
+
+    def rope_one(t, sinv, cosv):
+        # t: [B, S, H, D]
+        if use_neox_rotary_style:
+            half = t.shape[-1] // 2
+            t1, t2 = t[..., :half], t[..., half:]
+            rotated = jnp.concatenate([-t2, t1], axis=-1)
+        else:
+            t1 = t[..., ::2]
+            t2 = t[..., 1::2]
+            rotated = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
+        return t * cosv + rotated * sinv
+
+    def build_sin_cos(t):
+        B, S, H, D = t.shape
+        if position_ids is not None:
+            pos = jnp.asarray(position_ids._value if hasattr(
+                position_ids, "_value") else position_ids).astype(jnp.float32)
+            if pos.ndim == 1:
+                pos = pos[None, :]
+        else:
+            pos = jnp.arange(S, dtype=jnp.float32)[None, :]  # [1 or B, S]
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+        ang = pos[..., None] * inv[None, None, :]            # [B?, S, D/2]
+        if use_neox_rotary_style:
+            # rotate-half pairs (i, i + D/2): frequencies tile as two halves
+            sinv = jnp.concatenate([jnp.sin(ang), jnp.sin(ang)], axis=-1)
+            cosv = jnp.concatenate([jnp.cos(ang), jnp.cos(ang)], axis=-1)
+        else:
+            # interleaved pairs (2i, 2i+1): each frequency repeats adjacently
+            sinv = jnp.repeat(jnp.sin(ang), 2, axis=-1)
+            cosv = jnp.repeat(jnp.cos(ang), 2, axis=-1)
+        return sinv[:, :, None, :], cosv[:, :, None, :]
+
+    def fn(qv, *rest):
+        i = 0
+        kv = None
+        if k is not None:
+            kv = rest[i]
+            i += 1
+        if sin is not None:
+            sinv, cosv = rest[i], rest[i + 1]
+            if sinv.ndim == 2:
+                sinv = sinv[None, :, None, :]
+                cosv = cosv[None, :, None, :]
+        else:
+            sinv, cosv = build_sin_cos(qv)
+        outs = [rope_one(qv, sinv, cosv)]
+        if kv is not None:
+            outs.append(rope_one(kv, sinv, cosv))
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    args = [q]
+    n_outs = 1
+    if k is not None:
+        args.append(k)
+        n_outs = None
+    if sin is not None:
+        args.extend([sin, cos])
+    out = apply(fn, *args, op_name="fused_rope", n_outs=n_outs)
+    if k is not None:
+        return out[0], out[1], v
+    return out
+
+
+def swiglu(x, y=None, name=None):
+    """silu(x) * y; with y=None, x splits in half (reference: incubate
+    swiglu used by Llama-family FFNs)."""
+    from ...tensor.dispatch import apply
+    import jax
+    import jax.numpy as jnp
+
+    def fn(xv, *ys):
+        if ys:
+            return jax.nn.silu(xv) * ys[0]
+        a, b = jnp.split(xv, 2, axis=-1)
+        return jax.nn.silu(a) * b
+
+    args = (x,) if y is None else (x, y)
+    return apply(fn, *args, op_name="swiglu")

@@ -3,6 +3,6 @@
 from . import lr  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .optimizer import (  # noqa: F401
-    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, Optimizer,
-    RMSProp, Rprop,
+    ASGD, LBFGS, SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Lars,
+    Momentum, NAdam, Optimizer, RAdam, RMSProp, Rprop,
 )

@@ -293,3 +293,23 @@ class CyclicLR(LRScheduler):
         else:
             s = 1.0
         return self.base_lr + amp * s
+
+
+class LinearLR(LRScheduler):
+    """Linear warm ramp between start_factor and end_factor over
+    total_steps (reference: paddle.optimizer.lr.LinearLR)."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(max(self.last_epoch, 0), self.total_steps)
+        factor = self.start_factor + (self.end_factor - self.start_factor) \
+            * t / self.total_steps
+        return self.base_lr * factor

@@ -471,3 +471,258 @@ class Rprop(Optimizer):
         g_eff = jnp.where(sign < 0, 0.0, g)
         new_p = p - jnp.sign(g_eff) * step
         return new_p.astype(p.dtype), {"prev": g_eff, "step_size": step}
+
+
+class NAdam(Optimizer):
+    """Nesterov-momentum Adam (reference: paddle.optimizer.NAdam)."""
+
+    _hyper_defaults = {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+                       "momentum_decay": 0.004}
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, beta1=beta1, beta2=beta2, epsilon=epsilon,
+                         momentum_decay=momentum_decay)
+
+    def init_state(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p),
+                "t": jnp.zeros([], jnp.float32),
+                "mu_prod": jnp.ones([], jnp.float32)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        if wd:
+            g = g + wd * p
+        b1, b2, eps = hyper["beta1"], hyper["beta2"], hyper["epsilon"]
+        psi = hyper["momentum_decay"]
+        t = state["t"] + 1
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * psi))
+        mu_next = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * psi))
+        mu_prod = state["mu_prod"] * mu_t
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * jnp.square(g)
+        mhat = mu_next * m / (1 - mu_prod * mu_next) \
+            + (1 - mu_t) * g / (1 - mu_prod)
+        vhat = v / (1 - b2 ** t)
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p.astype(p.dtype), {"m": m, "v": v, "t": t,
+                                       "mu_prod": mu_prod}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference: paddle.optimizer.RAdam): falls back to
+    un-adapted SGD-with-momentum while the variance estimate is unrectifiable."""
+
+    _hyper_defaults = {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8}
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, beta1=beta1, beta2=beta2, epsilon=epsilon)
+
+    def init_state(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p),
+                "t": jnp.zeros([], jnp.float32)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        if wd:
+            g = g + wd * p
+        b1, b2, eps = hyper["beta1"], hyper["beta2"], hyper["epsilon"]
+        t = state["t"] + 1
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2.0 * t * (b2 ** t) / (1 - b2 ** t)
+        r_num = (rho_t - 4) * (rho_t - 2) * rho_inf
+        r_den = (rho_inf - 4) * (rho_inf - 2) * rho_t
+        rect = jnp.sqrt(jnp.maximum(r_num / jnp.maximum(r_den, 1e-12), 0.0))
+        vhat = jnp.sqrt(v / (1 - b2 ** t)) + eps
+        adaptive = p - lr * rect * mhat / vhat
+        plain = p - lr * mhat
+        new_p = jnp.where(rho_t > 5.0, adaptive, plain)
+        return new_p.astype(p.dtype), {"m": m, "v": v, "t": t}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference: paddle.optimizer.ASGD): steps with the mean
+    of the last ``batch_num`` gradients (a circular buffer per param, as the
+    reference keeps) and maintains the online average of iterates."""
+
+    _hyper_defaults = {"batch_num": 1}
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        if batch_num < 1:
+            raise ValueError("batch_num must be >= 1")
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, batch_num=batch_num)
+        self._batch_num = int(batch_num)
+
+    def init_state(self, p):
+        s = {"avg": p, "t": jnp.zeros([], jnp.float32)}
+        if self._batch_num > 1:
+            s["grad_buf"] = jnp.zeros((self._batch_num,) + tuple(p.shape),
+                                      p.dtype)
+            s["grad_sum"] = jnp.zeros_like(p)
+        return s
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        if wd:
+            g = g + wd * p
+        t = state["t"] + 1
+        n = int(hyper["batch_num"])
+        new_state = {"t": t}
+        if n > 1:
+            slot = (t.astype(jnp.int32) - 1) % n
+            old = state["grad_buf"][slot]
+            grad_sum = state["grad_sum"] - old + g
+            new_state["grad_buf"] = state["grad_buf"].at[slot].set(g)
+            new_state["grad_sum"] = grad_sum
+            g_eff = grad_sum / jnp.minimum(t, float(n))
+        else:
+            g_eff = g
+        new_p = p - lr * g_eff
+        new_state["avg"] = state["avg"] + (new_p - state["avg"]) / t
+        return new_p.astype(p.dtype), new_state
+
+
+class Lars(Optimizer):
+    """Layer-wise adaptive rate scaling (reference: fleet's lars meta
+    optimizer / paddle LarsMomentum): trust ratio ||w||/(||g|| + wd*||w||)
+    scales the local LR per parameter."""
+
+    _hyper_defaults = {"momentum": 0.9, "lars_coeff": 0.001,
+                       "lars_weight_decay": 0.0005, "epsilon": 1e-9}
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, epsilon=1e-9, parameters=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         momentum=momentum, lars_coeff=lars_coeff,
+                         lars_weight_decay=lars_weight_decay, epsilon=epsilon)
+
+    def init_state(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, hyper, wd):
+        mu, coeff = hyper["momentum"], hyper["lars_coeff"]
+        lwd, eps = hyper["lars_weight_decay"], hyper["epsilon"]
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            coeff * w_norm / (g_norm + lwd * w_norm + eps), 1.0)
+        vel = mu * state["velocity"] + local_lr * lr * (g + lwd * p)
+        new_p = p - vel
+        return new_p.astype(p.dtype), {"velocity": vel}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with closure-driven strong-Wolfe-free backtracking
+    (reference: paddle.optimizer.LBFGS).  Unlike the pure-rule optimizers,
+    ``step(closure)`` re-evaluates the loss (the reference contract), so it
+    runs in the eager path only."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, history_size=10,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, parameters=None,
+                 line_search_fn=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        # step() bypasses the per-param rule path, so silently accepting
+        # these would run different dynamics than requested
+        if weight_decay:
+            raise ValueError("LBFGS does not support weight_decay; add an "
+                             "L2 term to the closure's loss instead")
+        if grad_clip is not None:
+            raise ValueError("LBFGS does not support grad_clip")
+        if line_search_fn not in (None, "backtracking"):
+            raise ValueError(f"unsupported line_search_fn "
+                             f"{line_search_fn!r} (only backtracking)")
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.max_iter = max_iter
+        self.history_size = history_size
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self._hist = []  # [(s, y, rho)] newest last
+
+    def _flat_params(self):
+        return jnp.concatenate([p._value.reshape(-1)
+                                for p in self._parameter_list])
+
+    def _set_flat(self, flat):
+        ofs = 0
+        for p in self._parameter_list:
+            n = p._value.size
+            p._value = flat[ofs:ofs + n].reshape(p._value.shape).astype(
+                p._value.dtype)
+            ofs += n
+
+    def _flat_grad(self):
+        gs = []
+        for p in self._parameter_list:
+            g = p.grad._value if p.grad is not None else jnp.zeros_like(p._value)
+            gs.append(g.reshape(-1))
+        return jnp.concatenate(gs)
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure returning the "
+                             "loss (reference contract)")
+        from ..framework.state import no_grad_ctx
+
+        loss = closure()
+        g = self._flat_grad()
+        x = self._flat_params()
+        for _ in range(self.max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self.tol_grad:
+                break
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y, rho in reversed(self._hist):
+                a = rho * jnp.dot(s, q)
+                alphas.append(a)
+                q = q - a * y
+            if self._hist:
+                s, y, _ = self._hist[-1]
+                gamma = jnp.dot(s, y) / jnp.maximum(jnp.dot(y, y), 1e-12)
+                q = q * gamma
+            for (s, y, rho), a in zip(self._hist, reversed(alphas)):
+                b = rho * jnp.dot(y, q)
+                q = q + s * (a - b)
+            d = -q
+            # backtracking line search on the closure
+            t = float(self.get_lr())
+            f0 = float(loss)
+            gtd = float(jnp.dot(g, d))
+            x_new = x
+            for _ls in range(10):
+                x_new = x + t * d
+                with no_grad_ctx():
+                    self._set_flat(x_new)
+                self.clear_grad()
+                loss = closure()
+                if float(loss) <= f0 + 1e-4 * t * gtd:
+                    break
+                t *= 0.5
+            g_new = self._flat_grad()
+            s_vec = x_new - x
+            y_vec = g_new - g
+            ys = float(jnp.dot(s_vec, y_vec))
+            if ys > 1e-10:
+                self._hist.append((s_vec, y_vec, 1.0 / ys))
+                if len(self._hist) > self.history_size:
+                    self._hist.pop(0)
+            if float(jnp.max(jnp.abs(s_vec))) < self.tol_change:
+                x, g = x_new, g_new
+                break
+            x, g = x_new, g_new
+        self._step_count += 1
+        return loss

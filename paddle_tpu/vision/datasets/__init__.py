@@ -9,6 +9,7 @@ from .folder import DatasetFolder, ImageFolder  # noqa: F401
 from .mnist import MNIST, FashionMNIST  # noqa: F401
 from .cifar import Cifar10, Cifar100  # noqa: F401
 from .flowers import Flowers  # noqa: F401
+from .voc import VOC2012  # noqa: F401
 
 __all__ = ["DatasetFolder", "ImageFolder", "MNIST", "FashionMNIST", "Cifar10",
-           "Cifar100", "Flowers"]
+           "Cifar100", "Flowers", "VOC2012"]

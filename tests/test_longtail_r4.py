@@ -305,3 +305,58 @@ def test_beam_search_decoder_beam4_matches_bruteforce():
     assert got_seq == best_seq, (got_seq, best_seq)
     np.testing.assert_allclose(float(scores.numpy()[0, 0]), best_score,
                                rtol=1e-4)
+
+
+def test_voc2012_dataset(tmp_path):
+    import os
+
+    voc = tmp_path / "VOCdevkit" / "VOC2012"
+    for d in ["ImageSets/Segmentation", "JPEGImages", "SegmentationClass"]:
+        os.makedirs(voc / d)
+    names = ["2007_000001", "2007_000002", "2007_000003"]
+    (voc / "ImageSets/Segmentation/train.txt").write_text("\n".join(names[:2]))
+    (voc / "ImageSets/Segmentation/val.txt").write_text(names[2])
+    rs = np.random.RandomState(0)
+    for n in names:
+        np.save(voc / "JPEGImages" / (n + ".npy"),
+                (rs.rand(8, 8, 3) * 255).astype("uint8"))
+        np.save(voc / "SegmentationClass" / (n + ".npy"),
+                rs.randint(0, 21, (8, 8)).astype("uint8"))
+    from paddle_tpu.vision.datasets import VOC2012
+
+    ds = VOC2012(data_file=str(tmp_path), mode="train")
+    assert len(ds) == 2
+    img, lbl = ds[1]
+    assert img.shape == (8, 8, 3) and lbl.shape == (8, 8)
+    val = VOC2012(data_file=str(tmp_path), mode="valid")
+    assert len(val) == 1
+    with pytest.raises(ValueError):
+        VOC2012(data_file=str(tmp_path), mode="bogus")
+    with pytest.raises(RuntimeError):
+        VOC2012(data_file=str(tmp_path / "nowhere"))
+
+
+def test_transforms_affine_perspective_and_models():
+    from paddle_tpu.vision import transforms as T
+    from paddle_tpu.vision.transforms import functional as TF
+
+    img = (np.random.RandomState(0).rand(12, 12, 3) * 255).astype("uint8")
+    np.testing.assert_array_equal(TF.affine(img, 0.0, (0, 0), 1.0, 0.0), img)
+    pts = [[0, 0], [11, 0], [11, 11], [0, 11]]
+    np.testing.assert_array_equal(TF.perspective(img, pts, pts), img)
+    # pure translation moves content
+    shifted = TF.affine(img, 0.0, (2, 0), 1.0, 0.0)
+    np.testing.assert_array_equal(shifted[:, 2:], img[:, :-2])
+    assert T.RandomAffine(15, translate=(0.2, 0.2))(img).shape == img.shape
+    assert T.RandomPerspective(prob=1.0)(img).shape == img.shape
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import GoogLeNet, InceptionV3
+
+    paddle.seed(0)
+    g = GoogLeNet(num_classes=5).eval()
+    x = paddle.to_tensor(np.random.RandomState(1).rand(1, 3, 64, 64).astype("float32"))
+    assert g(x).shape == [1, 5]
+    g.train()
+    out, a1, a2 = g(x)
+    assert out.shape == a1.shape == a2.shape == [1, 5]

@@ -1,0 +1,167 @@
+"""Round-4 optimizer family completion (NAdam/RAdam/ASGD/Lars/LBFGS,
+LinearLR) and incubate fused front-ends (SURVEY §2.2 optimizer + incubate
+rows)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate.nn import functional as IF
+
+
+def _train(cls, steps=15, **kw):
+    paddle.seed(0)
+    rs = np.random.RandomState(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 3))
+    o = cls(parameters=m.parameters(), **kw)
+    x = paddle.to_tensor(rs.randn(32, 8).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 3, (32,)).astype("int64"))
+    lossf = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(steps):
+        l = lossf(m(x), y)
+        l.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(l))
+    return losses
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (opt.NAdam, {"learning_rate": 0.02}),
+    (opt.RAdam, {"learning_rate": 0.02}),
+    (opt.ASGD, {"learning_rate": 0.05}),
+    (opt.Lars, {"learning_rate": 0.5}),
+])
+def test_new_optimizers_train(cls, kw):
+    losses = _train(cls, **kw)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.95, (cls.__name__, losses)
+
+
+def test_nadam_torch_parity():
+    import torch
+
+    rs = np.random.RandomState(1)
+    w0 = rs.randn(4, 3).astype("float32")
+    g = rs.randn(4, 3).astype("float32")
+
+    p = paddle.to_tensor(w0.copy())
+    p.stop_gradient = False
+    o = opt.NAdam(learning_rate=0.01, parameters=[p])
+    tp = torch.nn.Parameter(torch.tensor(w0.copy()))
+    to = torch.optim.NAdam([tp], lr=0.01)
+    for _ in range(5):
+        p.clear_grad()
+        (p * paddle.to_tensor(g)).sum().backward()
+        o.step()
+        to.zero_grad()
+        (tp * torch.tensor(g)).sum().backward()
+        to.step()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_radam_torch_parity():
+    import torch
+
+    rs = np.random.RandomState(2)
+    w0 = rs.randn(4, 3).astype("float32")
+    g = rs.randn(4, 3).astype("float32")
+    p = paddle.to_tensor(w0.copy())
+    p.stop_gradient = False
+    o = opt.RAdam(learning_rate=0.01, parameters=[p])
+    tp = torch.nn.Parameter(torch.tensor(w0.copy()))
+    to = torch.optim.RAdam([tp], lr=0.01)
+    for _ in range(8):  # cross the rho_t > 5 rectification boundary
+        p.clear_grad()
+        (p * paddle.to_tensor(g)).sum().backward()
+        o.step()
+        to.zero_grad()
+        (tp * torch.tensor(g)).sum().backward()
+        to.step()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_lbfgs_quadratic_converges_fast():
+    paddle.seed(0)
+    rs = np.random.RandomState(3)
+    m = nn.Linear(6, 1)
+    xt = paddle.to_tensor(rs.randn(64, 6).astype("float32"))
+    yt = paddle.to_tensor(rs.randn(64, 1).astype("float32"))
+    o = opt.LBFGS(parameters=m.parameters(), max_iter=25, learning_rate=1.0)
+
+    def closure():
+        o.clear_grad()
+        l = ((m(xt) - yt) ** 2).mean()
+        l.backward()
+        return l
+
+    with pytest.raises(ValueError):
+        o.step()
+    l0 = float(closure())
+    lN = float(o.step(closure))
+    # least squares: LBFGS should nearly solve it in one outer step
+    assert lN < l0 * 0.5, (l0, lN)
+
+
+def test_asgd_average_tracks():
+    paddle.seed(0)
+    p = paddle.to_tensor(np.ones((2,), "float32"))
+    p.stop_gradient = False
+    o = opt.ASGD(learning_rate=0.1, parameters=[p])
+    vals = []
+    for _ in range(3):
+        p.clear_grad()
+        (p * p).sum().backward()
+        o.step()
+        vals.append(p.numpy().copy())
+    avg = o._states[id(p)]["avg"]
+    np.testing.assert_allclose(np.asarray(avg), np.mean(vals, axis=0),
+                               rtol=1e-5)
+
+
+def test_linear_lr():
+    s = opt.lr.LinearLR(0.2, total_steps=4, start_factor=0.25, end_factor=1.0)
+    got = []
+    for _ in range(6):
+        got.append(round(s(), 6))
+        s.step()
+    np.testing.assert_allclose(got[:5], [0.05, 0.0875, 0.125, 0.1625, 0.2],
+                               rtol=1e-6)
+    assert got[5] == 0.2  # clamps after total_steps
+
+
+def test_fused_functional_fronts():
+    rs = np.random.RandomState(0)
+    # swiglu split and two-arg forms
+    x = paddle.to_tensor(rs.randn(3, 8).astype("float32"))
+    a, b = x.numpy()[:, :4], x.numpy()[:, 4:]
+    sw = IF.swiglu(x).numpy()
+    silu = a / (1 + np.exp(-a)) * b
+    np.testing.assert_allclose(sw, silu, rtol=1e-5)
+    # rope: norms preserved (rotation), and k rotates identically for q==k
+    q = paddle.to_tensor(rs.randn(2, 6, 2, 8).astype("float32"))
+    qr, kr, _ = IF.fused_rotary_position_embedding(q, q)
+    np.testing.assert_allclose(qr.numpy(), kr.numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.linalg.norm(qr.numpy(), axis=-1),
+        np.linalg.norm(q.numpy(), axis=-1), rtol=1e-4)
+    # fused_layer_norm with residual fusion
+    h = paddle.to_tensor(rs.randn(4, 8).astype("float32"))
+    r = paddle.to_tensor(rs.randn(4, 8).astype("float32"))
+    out = IF.fused_layer_norm(h, paddle.to_tensor(np.ones(8, "float32")),
+                              paddle.to_tensor(np.zeros(8, "float32")),
+                              residual=r).numpy()
+    want = h.numpy() + r.numpy()
+    want = (want - want.mean(-1, keepdims=True)) / np.sqrt(
+        want.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, want, rtol=2e-4, atol=1e-5)
+    # fused_matmul_bias with transpose
+    xm = paddle.to_tensor(rs.randn(3, 5).astype("float32"))
+    wm = paddle.to_tensor(rs.randn(4, 5).astype("float32"))
+    got = IF.fused_matmul_bias(xm, wm, transpose_y=True).numpy()
+    np.testing.assert_allclose(got, xm.numpy() @ wm.numpy().T, rtol=1e-5)
