@@ -187,33 +187,61 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 
     def fn(qv, *rest):
         i = 0
-        kv = None
+        kv = vv = None
         if k is not None:
             kv = rest[i]
+            i += 1
+        if v is not None:
+            vv = rest[i]
             i += 1
         if sin is not None:
             sinv, cosv = rest[i], rest[i + 1]
             if sinv.ndim == 2:
                 sinv = sinv[None, :, None, :]
                 cosv = cosv[None, :, None, :]
+            if position_ids is not None:
+                # gather the table rows at each token's position (the
+                # KV-cache decode case: positions are not 0..S-1)
+                pos = jnp.asarray(position_ids._value if hasattr(
+                    position_ids, "_value") else position_ids)
+                sinv = jnp.broadcast_to(
+                    sinv, (pos.shape[0],) + sinv.shape[1:])[
+                        jnp.arange(pos.shape[0])[:, None], pos]
+                cosv = jnp.broadcast_to(
+                    cosv, (pos.shape[0],) + cosv.shape[1:])[
+                        jnp.arange(pos.shape[0])[:, None], pos]
         else:
             sinv, cosv = build_sin_cos(qv)
-        outs = [rope_one(qv, sinv, cosv)]
-        if kv is not None:
-            outs.append(rope_one(kv, sinv, cosv))
+        # the reference rotates EVERY provided tensor, v included
+        outs = [rope_one(t, sinv, cosv)
+                for t in (qv, kv, vv) if t is not None]
         return tuple(outs) if len(outs) > 1 else outs[0]
 
     args = [q]
-    n_outs = 1
+    n_provided = 1
     if k is not None:
         args.append(k)
-        n_outs = None
+        n_provided += 1
+    if v is not None:
+        args.append(v)
+        n_provided += 1
     if sin is not None:
         args.extend([sin, cos])
-    out = apply(fn, *args, op_name="fused_rope", n_outs=n_outs)
-    if k is not None:
-        return out[0], out[1], v
-    return out
+    out = apply(fn, *args, op_name="fused_rope",
+                n_outs=1 if n_provided == 1 else None)
+    if n_provided == 1:
+        out = (out,)
+    out = list(out)
+    # reference returns a (q, k, v) triple with None placeholders
+    result = [None, None, None]
+    j = 0
+    for slot, t in enumerate((q, k, v)):
+        if t is not None:
+            result[slot] = out[j]
+            j += 1
+    if k is None and v is None:
+        return result[0]
+    return tuple(result)
 
 
 def swiglu(x, y=None, name=None):

@@ -15,3 +15,8 @@ from .models import (  # noqa: F401
     GPTModel, GPTForCausalLM,
 )
 from .tokenizer import SimpleTokenizer, BertTokenizer  # noqa: F401
+
+from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401
+from .datasets import (  # noqa: F401
+    Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)

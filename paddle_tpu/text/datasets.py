@@ -1,0 +1,298 @@
+"""paddle.text datasets (reference: python/paddle/text/datasets/ —
+UCIHousing, Imdb, Imikolov, Movielens, WMT14, WMT16).
+
+No network egress: every dataset takes ``data_file`` pointing at the
+standard local archive/directory the reference would have downloaded.
+Formats match the reference's extracted layouts; tests use synthetic
+fixtures in the same shapes.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class UCIHousing(Dataset):
+    """Boston-housing regression table: 14 whitespace columns, features
+    normalized (x - mean) / (max - min) over the FULL table — the
+    reference's feature_range normalization — then split 80/20."""
+
+    TRAIN_RATIO = 0.8
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        if data_file is None:
+            raise RuntimeError("no network egress; pass data_file "
+                               "(housing.data)")
+        rows = []
+        opener = gzip.open if str(data_file).endswith(".gz") else open
+        with opener(data_file, "rt") as f:
+            for line in f:
+                vals = line.split()
+                if len(vals) == 14:
+                    rows.append([float(v) for v in vals])
+        data = np.asarray(rows, np.float32)
+        n_train = int(len(data) * self.TRAIN_RATIO)
+        feats, target = data[:, :-1], data[:, -1:]
+        mx, mn, avg = feats.max(0), feats.min(0), feats.mean(0)
+        feats = (feats - avg) / np.maximum(mx - mn, 1e-6)
+        if mode == "train":
+            self.x, self.y = feats[:n_train], target[:n_train]
+        else:
+            self.x, self.y = feats[n_train:], target[n_train:]
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+_WORD_RE = re.compile(r"[A-Za-z0-9']+")
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (aclImdb tar layout: <mode>/{pos,neg}/*.txt inside the
+    archive).  Builds the frequency-cutoff word dict from the train split
+    (reference semantics); samples are (int64 ids, int64 label 0/1)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False):
+        if data_file is None:
+            raise RuntimeError("no network egress; pass data_file "
+                               "(aclImdb tar/tar.gz or extracted dir)")
+        self.mode = mode
+        # vocab needs train; samples need `mode` — one archive pass total
+        need = {"train", mode}
+        docs = {s: [] for s in need}
+        for split, label, text in self._iter_docs(data_file, need):
+            docs[split].append((text, label))
+        freq = {}
+        for text, _ in docs["train"]:
+            for w in _WORD_RE.findall(text.lower()):
+                freq[w] = freq.get(w, 0) + 1
+        vocab = sorted(w for w, c in freq.items() if c >= cutoff)
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [
+            (np.asarray([self.word_idx.get(w, unk)
+                         for w in _WORD_RE.findall(text.lower())], np.int64),
+             np.int64(label))
+            for text, label in docs[mode]]
+
+    @staticmethod
+    def _iter_docs(data_file, splits):
+        """Yield (split, label, text) in ONE pass over the dir/archive."""
+        labels = {"neg": 0, "pos": 1}
+        path = str(data_file)
+        if os.path.isdir(path):
+            root = path if os.path.basename(path) == "aclImdb" else \
+                os.path.join(path, "aclImdb")
+            for split in sorted(splits):
+                for sub, label in labels.items():
+                    d = os.path.join(root, split, sub)
+                    for name in sorted(os.listdir(d)) if os.path.isdir(d) else []:
+                        with open(os.path.join(d, name), errors="ignore") as f:
+                            yield split, label, f.read()
+        else:
+            pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+            with tarfile.open(path) as tf:
+                for member in tf:
+                    m = pat.search(member.name)
+                    if m and m.group(1) in splits:
+                        yield (m.group(1), labels[m.group(2)],
+                               tf.extractfile(member).read().decode(
+                                   "utf-8", "ignore"))
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i]
+
+
+class Imikolov(Dataset):
+    """PTB language-model n-grams (reference: imikolov dataset over the
+    simple-examples ptb.{train,valid}.txt files).
+
+    data_type='NGRAM' yields window_size-grams; 'SEQ' yields (input, target)
+    shifted sequences per line.
+    """
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=False):
+        if data_file is None:
+            raise RuntimeError("no network egress; pass data_file "
+                               "(simple-examples dir or ptb txt files' dir)")
+        names = {"train": "ptb.train.txt", "valid": "ptb.valid.txt",
+                 "test": "ptb.test.txt"}
+        root = str(data_file)
+        cand = [os.path.join(root, names[mode]),
+                os.path.join(root, "simple-examples", "data", names[mode])]
+        path = next((c for c in cand if os.path.exists(c)), None)
+        if path is None:
+            raise RuntimeError(f"no {names[mode]} under {root!r}")
+        train_path = path.replace(names[mode], names["train"])
+        freq = {}
+        with open(train_path) as f:
+            for line in f:
+                for w in line.split():
+                    freq[w] = freq.get(w, 0) + 1
+        vocab = sorted(w for w, c in freq.items() if c >= min_word_freq)
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        for tok in ("<s>", "<e>", "<unk>"):
+            self.word_idx.setdefault(tok, len(self.word_idx))
+        unk = self.word_idx["<unk>"]
+        self.samples = []
+        with open(path) as f:
+            for line in f:
+                ids = ([self.word_idx["<s>"]]
+                       + [self.word_idx.get(w, unk) for w in line.split()]
+                       + [self.word_idx["<e>"]])
+                if data_type.upper() == "NGRAM":
+                    if window_size <= 0:
+                        raise ValueError("NGRAM needs window_size > 0")
+                    for i in range(window_size, len(ids)):
+                        self.samples.append(
+                            np.asarray(ids[i - window_size:i + 1], np.int64))
+                else:  # SEQ
+                    if len(ids) > 1:
+                        self.samples.append(
+                            (np.asarray(ids[:-1], np.int64),
+                             np.asarray(ids[1:], np.int64)))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (ml-1m layout: users.dat, movies.dat,
+    ratings.dat with '::' separators).  Samples follow the reference shape:
+    (user_id, gender, age, occupation, movie_id, title_ids, genre_ids,
+    rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        if data_file is None:
+            raise RuntimeError("no network egress; pass data_file "
+                               "(ml-1m directory or tar)")
+        root = str(data_file)
+        if os.path.isdir(os.path.join(root, "ml-1m")):
+            root = os.path.join(root, "ml-1m")
+
+        def read(name):
+            with open(os.path.join(root, name), errors="ignore") as f:
+                return [ln.rstrip("\n").split("::") for ln in f if ln.strip()]
+
+        users = {u[0]: u for u in read("users.dat")}
+        movies = {}
+        titles, genres = {}, {}
+        for mid, title, genre in read("movies.dat"):
+            words = _WORD_RE.findall(title.lower())
+            for w in words:
+                titles.setdefault(w, len(titles))
+            gs = genre.split("|")
+            for g in gs:
+                genres.setdefault(g, len(genres))
+            movies[mid] = (words, gs)
+        rng = np.random.RandomState(rand_seed)
+        self.samples = []
+        for uid, mid, rating, _ts in read("ratings.dat"):
+            if uid not in users or mid not in movies:
+                continue
+            is_test = rng.rand() < test_ratio
+            if (mode == "test") != is_test:
+                continue
+            _, gender, age, occupation, _zip = users[uid]
+            words, gs = movies[mid]
+            self.samples.append((
+                np.int64(uid), np.int64(0 if gender == "M" else 1),
+                np.int64(age), np.int64(occupation), np.int64(mid),
+                np.asarray([titles[w] for w in words], np.int64),
+                np.asarray([genres[g] for g in gs], np.int64),
+                np.float32(rating)))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class _WMTBase(Dataset):
+    """Parallel-corpus reader: <prefix>.<src_lang> / <prefix>.<trg_lang>
+    line-aligned text files, dictionary truncated to dict_size by train-side
+    frequency.  Samples are (src_ids, trg_ids[:-1], trg_ids[1:]) with
+    <s>/<e>/<unk> ids 0/1/2 (reference convention)."""
+
+    SRC_LANG = "en"
+    TRG_LANG = "de"
+    FILES = {"train": "train", "dev": "dev", "test": "test"}
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang=None, download=False):
+        if data_file is None:
+            raise RuntimeError("no network egress; pass data_file "
+                               "(extracted corpus directory)")
+        src_lang, trg_lang = self.SRC_LANG, self.TRG_LANG
+        if lang is not None:
+            # reference: lang names the SOURCE side; the other becomes target
+            if lang not in (self.SRC_LANG, self.TRG_LANG):
+                raise ValueError(f"lang must be {self.SRC_LANG!r} or "
+                                 f"{self.TRG_LANG!r}, got {lang!r}")
+            if lang == self.TRG_LANG:
+                src_lang, trg_lang = self.TRG_LANG, self.SRC_LANG
+        root = str(data_file)
+        prefix = os.path.join(root, self.FILES[mode])
+        train_prefix = os.path.join(root, self.FILES["train"])
+        self.src_dict = self._dict(f"{train_prefix}.{src_lang}",
+                                   src_dict_size)
+        self.trg_dict = self._dict(f"{train_prefix}.{trg_lang}",
+                                   trg_dict_size)
+        with open(f"{prefix}.{src_lang}") as f:
+            src_lines = [ln.split() for ln in f]
+        with open(f"{prefix}.{trg_lang}") as f:
+            trg_lines = [ln.split() for ln in f]
+        self.samples = []
+        for s, t in zip(src_lines, trg_lines):
+            sid = [self.src_dict.get(w, 2) for w in s]
+            tid = [0] + [self.trg_dict.get(w, 2) for w in t] + [1]
+            self.samples.append((np.asarray(sid, np.int64),
+                                 np.asarray(tid[:-1], np.int64),
+                                 np.asarray(tid[1:], np.int64)))
+
+    @staticmethod
+    def _dict(path, size):
+        freq = {}
+        with open(path) as f:
+            for line in f:
+                for w in line.split():
+                    freq[w] = freq.get(w, 0) + 1
+        ordered = sorted(freq, key=lambda w: (-freq[w], w))
+        if size and size > 0:
+            ordered = ordered[:max(size - 3, 0)]
+        return {w: i + 3 for i, w in enumerate(ordered)}  # 0/1/2 reserved
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class WMT14(_WMTBase):
+    SRC_LANG, TRG_LANG = "en", "fr"
+
+
+class WMT16(_WMTBase):
+    SRC_LANG, TRG_LANG = "en", "de"

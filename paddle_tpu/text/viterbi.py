@@ -1,0 +1,103 @@
+"""ViterbiDecoder (reference: python/paddle/text/viterbi_decode.py).
+
+TPU-native: the forward max-sum recursion is a ``lax.scan`` over time
+(static shapes, one compiled program) collecting argmax backpointers; the
+backtrace is a second scan in reverse.  ``with_start_stop_tag`` follows the
+reference convention: the LAST tag index is the start tag and the
+SECOND-TO-LAST is the stop tag (their transition rows/columns bracket the
+sequence).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..nn.layer import Layer
+from ..tensor.dispatch import apply
+
+
+def viterbi_decode(potentials, transitions, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Best tag path per sequence.
+
+    Args:
+        potentials: [B, T, N] unary emission scores.
+        transitions: [N, N] transition scores (from-tag, to-tag).
+        lengths: [B] int valid timesteps per sequence.
+        include_bos_eos_tag: treat tag N-1 as BOS and N-2 as EOS
+            (reference ``with_start_stop_tag``).
+
+    Returns:
+        (scores [B], paths [B, T] int64) — positions beyond a sequence's
+        length hold 0.
+    """
+
+    def fn(pot, trans, lens):
+        B, T, N = pot.shape
+        start_idx, stop_idx = N - 1, N - 2
+        alpha = pot[:, 0]
+        if include_bos_eos_tag:
+            alpha = alpha + trans[start_idx][None, :]
+
+        def step(carry, xs):
+            alpha, t = carry
+            emit = xs  # [B, N]
+            # [B, from, to]
+            scores = alpha[:, :, None] + trans[None, :, :]
+            best_from = jnp.argmax(scores, axis=1)            # [B, N]
+            best_score = jnp.max(scores, axis=1) + emit
+            live = (t < lens)[:, None]
+            alpha = jnp.where(live, best_score, alpha)
+            ptr = jnp.where(live, best_from,
+                            jnp.arange(N)[None, :])           # identity hold
+            return (alpha, t + 1), ptr
+
+        (alpha, _), ptrs = lax.scan(
+            step, (alpha, jnp.ones((), jnp.int32)),
+            jnp.moveaxis(pot[:, 1:], 0, 1) if T > 1 else
+            jnp.zeros((0, B, N), pot.dtype))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, stop_idx][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last_tag = jnp.argmax(alpha, axis=-1)                 # [B]
+
+        # backtrace: walk ptrs from each sequence's end
+        def back(carry, xs):
+            tag, t = carry
+            ptr = xs                                           # [B, N]
+            prev = jnp.take_along_axis(ptr, tag[:, None], 1)[:, 0]
+            # only move while t < len (ptr rows past the end hold identity)
+            tag_prev = prev
+            return (tag_prev, t - 1), tag
+
+        (first_tag, _), rev_tags = lax.scan(
+            back, (last_tag, jnp.full((), T - 1, jnp.int32)), ptrs,
+            reverse=True)
+        # rev_tags[t] is the tag at position t+1; prepend position 0's tag
+        path = jnp.concatenate([first_tag[:, None],
+                                jnp.moveaxis(rev_tags, 0, 1)], axis=1) \
+            if T > 1 else first_tag[:, None]
+        mask = jnp.arange(T)[None, :] < lens[:, None]
+        return scores, jnp.where(mask, path, 0).astype(jnp.int64)
+
+    return apply(fn, potentials, transitions, lengths, n_outs=None,
+                 op_name="viterbi_decode")
+
+
+class ViterbiDecoder(Layer):
+    """reference: paddle.text.ViterbiDecoder — holds the transition matrix
+    option and decodes (potentials, lengths) batches."""
+
+    def __init__(self, transitions=None, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths, transitions=None):
+        trans = transitions if transitions is not None else self.transitions
+        if trans is None:
+            raise ValueError("ViterbiDecoder needs a transitions matrix")
+        return viterbi_decode(potentials, trans, lengths,
+                              self.include_bos_eos_tag)

@@ -5,9 +5,9 @@ Reads the standard extracted VOCdevkit layout (ImageSets/Segmentation
 split lists, JPEGImages, SegmentationClass).  Like the other in-repo
 datasets, there is no network egress: pass ``data_file`` pointing at the
 extracted ``VOC2012``/``VOCdevkit/VOC2012`` directory.  Images decode via
-PIL when available, else a tiny PPM/raw fallback, returning (image HWC
-uint8, label HW uint8) with 255 = ignore, matching the reference's
-semantics.
+PIL (``.npy`` raw-array files are also accepted for pre-decoded sets and
+test fixtures), returning (image HWC uint8, label HW uint8) with
+255 = ignore, matching the reference's semantics.
 """
 
 from __future__ import annotations

@@ -1,0 +1,119 @@
+"""paddle.text datasets + ViterbiDecoder (reference: python/paddle/text/)."""
+
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import (
+    Imdb, Imikolov, Movielens, UCIHousing, ViterbiDecoder, WMT16,
+    viterbi_decode,
+)
+
+
+def test_viterbi_matches_bruteforce():
+    rs = np.random.RandomState(0)
+    B, T, N = 2, 4, 5
+    pot = rs.randn(B, T, N).astype("float32")
+    trans = rs.randn(N, N).astype("float32")
+    lens = np.array([4, 2], "int64")
+    s, p = viterbi_decode(paddle.to_tensor(pot), paddle.to_tensor(trans),
+                          paddle.to_tensor(lens))
+    start, stop = N - 1, N - 2
+    for b in range(B):
+        L = int(lens[b])
+        best, bseq = -1e30, None
+        for seq in itertools.product(range(N), repeat=L):
+            sc = pot[b, 0, seq[0]] + trans[start, seq[0]]
+            for t in range(1, L):
+                sc += trans[seq[t - 1], seq[t]] + pot[b, t, seq[t]]
+            sc += trans[seq[L - 1], stop]
+            if sc > best:
+                best, bseq = sc, seq
+        np.testing.assert_allclose(float(s.numpy()[b]), best, rtol=1e-5)
+        assert tuple(p.numpy()[b, :L]) == bseq
+        assert (p.numpy()[b, L:] == 0).all()
+    # the Layer spelling
+    dec = ViterbiDecoder(transitions=paddle.to_tensor(trans))
+    s2, p2 = dec(paddle.to_tensor(pot), paddle.to_tensor(lens))
+    np.testing.assert_array_equal(p2.numpy(), p.numpy())
+
+
+def test_uci_housing(tmp_path):
+    rs = np.random.RandomState(0)
+    rows = rs.rand(50, 14).astype("float32")
+    path = tmp_path / "housing.data"
+    path.write_text("\n".join(" ".join(f"{v:.4f}" for v in r) for r in rows))
+    tr = UCIHousing(data_file=str(path), mode="train")
+    te = UCIHousing(data_file=str(path), mode="test")
+    assert len(tr) == 40 and len(te) == 10
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # features are normalized by TRAIN stats
+    assert abs(np.stack([tr[i][0] for i in range(40)]).mean()) < 0.15
+
+
+def test_imdb(tmp_path):
+    root = tmp_path / "aclImdb"
+    texts = {"pos": ["great great movie movie", "great fun"],
+             "neg": ["bad bad movie", "awful bad"]}
+    for split in ("train", "test"):
+        for sub, docs in texts.items():
+            d = root / split / sub
+            os.makedirs(d)
+            for i, t in enumerate(docs):
+                (d / f"{i}_7.txt").write_text(t)
+    ds = Imdb(data_file=str(tmp_path), mode="train", cutoff=2)
+    assert len(ds) == 4
+    ids, label = ds[0]
+    assert ids.dtype == np.int64 and label in (0, 1)
+    # cutoff honored: words seen >=2 times in train are in-vocab
+    assert "movie" in ds.word_idx and "fun" not in ds.word_idx
+
+
+def test_imikolov(tmp_path):
+    (tmp_path / "ptb.train.txt").write_text(
+        "the cat sat\nthe cat ran\nthe dog sat\n")
+    (tmp_path / "ptb.valid.txt").write_text("the cat sat\n")
+    ds = Imikolov(data_file=str(tmp_path), data_type="NGRAM", window_size=2,
+                  mode="train", min_word_freq=2)
+    assert len(ds) > 0
+    gram = ds[0]
+    assert gram.shape == (3,)
+    seq = Imikolov(data_file=str(tmp_path), data_type="SEQ", mode="valid",
+                   min_word_freq=2)
+    src, tgt = seq[0]
+    np.testing.assert_array_equal(src[1:], tgt[:-1])
+
+
+def test_movielens(tmp_path):
+    root = tmp_path / "ml-1m"
+    os.makedirs(root)
+    (root / "users.dat").write_text("1::M::25::4::94110\n2::F::35::7::02139\n")
+    (root / "movies.dat").write_text(
+        "10::Toy Story (1995)::Animation|Comedy\n20::Heat (1995)::Action\n")
+    (root / "ratings.dat").write_text(
+        "1::10::5::100\n1::20::3::101\n2::10::4::102\n2::20::2::103\n")
+    tr = Movielens(data_file=str(tmp_path), mode="train", test_ratio=0.25,
+                   rand_seed=0)
+    te = Movielens(data_file=str(tmp_path), mode="test", test_ratio=0.25,
+                   rand_seed=0)
+    assert len(tr) + len(te) == 4 and len(tr) >= 1
+    uid, gender, age, occ, mid, title, genre, rating = tr[0]
+    assert rating in (2.0, 3.0, 4.0, 5.0)
+    assert title.dtype == np.int64 and genre.dtype == np.int64
+
+
+def test_wmt16(tmp_path):
+    (tmp_path / "train.en").write_text("a b c\nb c d\n")
+    (tmp_path / "train.de").write_text("x y\ny z\n")
+    (tmp_path / "test.en").write_text("a q\n")
+    (tmp_path / "test.de").write_text("x q\n")
+    tr = WMT16(data_file=str(tmp_path), mode="train")
+    src, tin, tout = tr[0]
+    assert tin[0] == 0 and tout[-1] == 1  # <s> ... <e>
+    te = WMT16(data_file=str(tmp_path), mode="test")
+    src, tin, tout = te[0]
+    assert src[-1] == 2 and tout[-2] == 2  # 'q' unseen in train -> <unk>
