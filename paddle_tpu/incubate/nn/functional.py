@@ -204,6 +204,8 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                 # KV-cache decode case: positions are not 0..S-1)
                 pos = jnp.asarray(position_ids._value if hasattr(
                     position_ids, "_value") else position_ids)
+                if pos.ndim == 1:
+                    pos = pos[None, :]
                 sinv = jnp.broadcast_to(
                     sinv, (pos.shape[0],) + sinv.shape[1:])[
                         jnp.arange(pos.shape[0])[:, None], pos]

@@ -172,4 +172,124 @@ def save(program, model_path, protocol=4):
 def load(program, model_path, executor=None, var_list=None):
     raise NotImplementedError("static.load: use paddle.jit.load")
 
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """reference: paddle.static.save_inference_model(prefix, feeds, fetches,
+    exe).  Here inference programs ARE jit.save artifacts: when
+    ``fetch_vars`` is a Layer (or ``program`` carries one via
+    ``Program.layer``), export it with the feed specs; pure
+    Program-building workflows have no captured computation to export and
+    get a descriptive error pointing at the traced path."""
+    from .. import jit as _jit
+
+    layer = None
+    if hasattr(fetch_vars, "forward"):
+        layer = fetch_vars
+    elif program is not None and getattr(program, "layer", None) is not None:
+        layer = program.layer
+    if layer is None:
+        raise NotImplementedError(
+            "save_inference_model needs the model: pass the Layer as "
+            "fetch_vars (or set program.layer). Op-by-op Program "
+            "construction is not re-executed here — trace with "
+            "@paddle.jit.to_static and save that (SURVEY.md §3.2: this "
+            "runtime lowers whole traced models, not ProgramDescs).")
+    input_spec = list(feed_vars) if feed_vars is not None else None
+    return _jit.save(layer, path_prefix, input_spec=input_spec)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """reference: paddle.static.load_inference_model -> (program,
+    feed_names, fetch_names).  The returned 'program' is the loaded
+    TranslatedLayer (callable); names follow the positional convention."""
+    from .. import jit as _jit
+
+    layer = _jit.load(path_prefix)
+    spec = (getattr(layer, "_meta", None) or {}).get("input_spec", [])
+    feed_names = [(s.get("name") or f"feed_{i}")
+                  for i, s in enumerate(spec)]
+    return layer, feed_names, ["fetch_0"]
+
+
+class _GlobalScope:
+    """Compat scope object (reference: paddle.static.global_scope) — state
+    lives in Layers/Tensors here, so the scope only records variables users
+    explicitly stash via ``var()``."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        from ..tensor.tensor import Tensor
+
+        if name not in self._vars:
+            self._vars[name] = Tensor(0.0)  # placeholder; set_value rebinds
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_SCOPE = _GlobalScope()
+
+
+def global_scope():
+    return _SCOPE
+
+
+class scope_guard:
+    """Compat context manager (reference: paddle.static.scope_guard)."""
+
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        global _SCOPE
+        self._prev, _SCOPE = _SCOPE, self._scope
+        return self._scope
+
+    def __exit__(self, *exc):
+        global _SCOPE
+        _SCOPE = self._prev
+        return False
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference: paddle.static.gradients — here autodiff is jax.grad over
+    the traced function, exposed eagerly: returns d(sum(targets))/d(inputs)
+    via the tape (targets must depend on inputs through recorded ops)."""
+    from ..autograd import grad as _grad
+
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    outs = _grad(targets, inputs, grad_outputs=target_gradients,
+                 allow_unused=True)
+    return list(outs)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """reference: paddle.static.append_backward(loss) -> [(param, grad)].
+    Eager translation: run backward() on the loss and report the resulting
+    (param, param.grad) pairs."""
+    loss.backward()
+    params = parameter_list
+    if params is None:
+        from ..tensor.tensor import Parameter
+
+        # collect every Parameter reachable from the tape
+        seen, stack, params = set(), [loss._grad_node], []
+        while stack:
+            node = stack.pop()
+            if node is None or id(node) in seen:
+                continue
+            seen.add(id(node))
+            for a in getattr(node, "inputs", ()):  # recorded op inputs
+                if isinstance(a, Parameter) and all(a is not q for q in params):
+                    params.append(a)
+                if getattr(a, "_grad_node", None) is not None:
+                    stack.append(a._grad_node)
+    return [(p, p.grad) for p in params if getattr(p, "grad", None) is not None]
+
 from . import nn  # noqa: E402,F401 — control-flow ops (cond/while_loop/...)

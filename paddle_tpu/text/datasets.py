@@ -139,7 +139,7 @@ class Imikolov(Dataset):
         path = next((c for c in cand if os.path.exists(c)), None)
         if path is None:
             raise RuntimeError(f"no {names[mode]} under {root!r}")
-        train_path = path.replace(names[mode], names["train"])
+        train_path = os.path.join(os.path.dirname(path), names["train"])
         freq = {}
         with open(train_path) as f:
             for line in f:
@@ -159,9 +159,11 @@ class Imikolov(Dataset):
                 if data_type.upper() == "NGRAM":
                     if window_size <= 0:
                         raise ValueError("NGRAM needs window_size > 0")
-                    for i in range(window_size, len(ids)):
+                    # reference layout: window_size tokens TOTAL, the last
+                    # one being the target
+                    for i in range(window_size, len(ids) + 1):
                         self.samples.append(
-                            np.asarray(ids[i - window_size:i + 1], np.int64))
+                            np.asarray(ids[i - window_size:i], np.int64))
                 else:  # SEQ
                     if len(ids) > 1:
                         self.samples.append(

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from ..tensor.dispatch import apply as _apply
 from ..tensor.tensor import Tensor
+from ..nn.layer import Layer
 
 
 def _v(x):
@@ -355,3 +356,417 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
                          axis=-1)
 
     return _apply(impl, prior_box, prior_box_var, target_box, op_name="box_coder")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference: paddle.vision.ops
+    deform_conv2d; v2 when ``mask`` is given).
+
+    TPU-native formulation: instead of a per-point gather kernel, the
+    deformed sampling grid is evaluated with bilinear interpolation as a
+    batched gather (XLA lowers it to vectorized dynamic-slices), then the
+    kernel reduces to ONE dense matmul over the sampled patches — an
+    im2col whose columns were displaced by the learned offsets.
+    """
+    from ..tensor.dispatch import apply
+
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def fn(xv, ov, wv, *rest):
+        mb = rest[0] if mask is not None else None
+        bv = rest[-1] if bias is not None else None
+        N, C, H, W = xv.shape
+        Co, Cg, kh, kw = wv.shape
+        Ho = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        Wo = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        K = kh * kw
+        # base sampling positions [Ho, Wo, K]
+        oy = jnp.arange(Ho) * s[0] - p[0]
+        ox = jnp.arange(Wo) * s[1] - p[1]
+        ky = jnp.arange(kh) * d[0]
+        kx = jnp.arange(kw) * d[1]
+        base_y = oy[:, None, None, None] + ky[None, None, :, None]
+        base_x = ox[None, :, None, None] + kx[None, None, None, :]
+        base_y = jnp.broadcast_to(base_y, (Ho, Wo, kh, kw)).reshape(Ho, Wo, K)
+        base_x = jnp.broadcast_to(base_x, (Ho, Wo, kh, kw)).reshape(Ho, Wo, K)
+        # offsets [N, dg*2K, Ho, Wo] -> [N, dg, K, 2, Ho, Wo]
+        dg = deformable_groups
+        off = ov.reshape(N, dg, K, 2, Ho, Wo)
+        # sample positions per (n, dgroup, k, ho, wo)
+        pos_y = base_y.transpose(2, 0, 1)[None, None] + off[:, :, :, 0]
+        pos_x = base_x.transpose(2, 0, 1)[None, None] + off[:, :, :, 1]
+
+        def bilinear(img, py, px):
+            # img [C', H, W]; py/px [...]: gather with zero padding
+            y0 = jnp.floor(py)
+            x0 = jnp.floor(px)
+            wy = py - y0
+            wx = px - x0
+            out = 0.0
+            for yy, wyy in ((y0, 1 - wy), (y0 + 1, wy)):
+                for xx, wxx in ((x0, 1 - wx), (x0 + 1, wx)):
+                    yi = yy.astype(jnp.int32)
+                    xi = xx.astype(jnp.int32)
+                    valid = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+                    v = img[:, jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+                    out = out + v * (jnp.where(valid, wyy * wxx, 0.0))[None]
+                    # weights broadcast over the channel dim
+            return out
+
+        cpg = C // dg  # channels per deformable group
+
+        def per_image(img, py, px, m):
+            # py/px [dg, K, Ho, Wo]
+            cols = []
+            for g_ in range(dg):
+                sampled = bilinear(img[g_ * cpg:(g_ + 1) * cpg],
+                                   py[g_], px[g_])      # [cpg, K, Ho, Wo]
+                if m is not None:
+                    sampled = sampled * m[g_][None]
+                cols.append(sampled)
+            return jnp.concatenate(cols, axis=0)         # [C, K, Ho, Wo]
+
+        if mb is not None:
+            mv = mb.reshape(N, dg, K, Ho, Wo)
+            cols = jax.vmap(per_image)(xv, pos_y, pos_x, mv)
+        else:
+            cols = jax.vmap(lambda im, py, px: per_image(im, py, px, None))(
+                xv, pos_y, pos_x)                        # [N, C, K, Ho, Wo]
+        # grouped dense contraction: out[n,co,ho,wo] = sum_cg,k w * cols
+        gsz_in = C // groups
+        gsz_out = Co // groups
+        outs = []
+        for g_ in range(groups):
+            wg = wv[g_ * gsz_out:(g_ + 1) * gsz_out].reshape(gsz_out, -1)
+            cg = cols[:, g_ * gsz_in:(g_ + 1) * gsz_in].reshape(
+                N, gsz_in * K, Ho * Wo)
+            outs.append(jnp.einsum("ok,nkp->nop", wg, cg))
+        out = jnp.concatenate(outs, axis=1).reshape(N, Co, Ho, Wo)
+        if bv is not None:
+            out = out + bv.reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply(fn, *args, op_name="deform_conv2d")
+
+
+class DeformConv2D(Layer):
+    """Deformable conv layer (reference: paddle.vision.ops.DeformConv2D);
+    offsets (and v2 masks) are produced by the caller per forward."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k[0], k[1]],
+            attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, mask=mask,
+                             **self._cfg)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI pooling (reference: paddle.vision.ops
+    psroi_pool): channel block (i, j) pools only over spatial bin (i, j)."""
+    from ..tensor.dispatch import apply
+
+    osz = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+
+    def fn(xv, bv, bn):
+        N, C, H, W = xv.shape
+        ph, pw = osz
+        co = C // (ph * pw)
+        total = bv.shape[0]
+        # batch index per box from boxes_num
+        counts = jnp.asarray(bn)
+        bidx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                          total_repeat_length=total)
+
+        def one(box, b):
+            x1, y1, x2, y2 = box * spatial_scale
+            bh = jnp.maximum(y2 - y1, 1e-3) / ph
+            bw = jnp.maximum(x2 - x1, 1e-3) / pw
+            img = xv[b].reshape(co, ph, pw, H, W)
+            ys = jnp.arange(H, dtype=jnp.float32)
+            xs = jnp.arange(W, dtype=jnp.float32)
+            out = jnp.zeros((co, ph, pw), xv.dtype)
+            for i in range(ph):
+                for j in range(pw):
+                    in_y = ((ys >= y1 + i * bh) & (ys < y1 + (i + 1) * bh))
+                    in_x = ((xs >= x1 + j * bw) & (xs < x1 + (j + 1) * bw))
+                    m = (in_y[:, None] & in_x[None, :]).astype(xv.dtype)
+                    denom = jnp.maximum(m.sum(), 1.0)
+                    val = (img[:, i, j] * m[None]).sum((-2, -1)) / denom
+                    out = out.at[:, i, j].set(val)
+            return out
+
+        return jax.vmap(one)(bv, bidx)
+
+    return apply(fn, x, boxes, boxes_num, op_name="psroi_pool")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes per feature-map cell (reference:
+    paddle.vision.ops.prior_box)."""
+    from ..tensor.dispatch import apply
+
+    def fn(feat, img):
+        H, W = feat.shape[2], feat.shape[3]
+        IH, IW = img.shape[2], img.shape[3]
+        step_h = steps[1] or IH / H
+        step_w = steps[0] or IW / W
+        ars = []
+        for ar in aspect_ratios:
+            ars.append(ar)
+            if flip and ar != 1.0:
+                ars.append(1.0 / ar)
+        sizes = []
+        for idx, ms in enumerate(min_sizes):
+            if min_max_aspect_ratios_order:
+                # reference order=True layout: [min, max, other ars]
+                sizes.append((ms, ms))
+                if max_sizes:
+                    mx = max_sizes[idx]
+                    sizes.append(((ms * mx) ** 0.5, (ms * mx) ** 0.5))
+                for ar in ars:
+                    if ar != 1.0:
+                        sizes.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+            else:
+                for ar in ars:
+                    sizes.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+                if max_sizes:
+                    mx = max_sizes[idx]
+                    sizes.append(((ms * mx) ** 0.5, (ms * mx) ** 0.5))
+        P = len(sizes)
+        cy = (jnp.arange(H) + offset) * step_h
+        cx = (jnp.arange(W) + offset) * step_w
+        cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+        wh = jnp.asarray(sizes, jnp.float32)               # [P, 2(w,h)]
+        boxes = jnp.stack([
+            (cxg[..., None] - wh[None, None, :, 0] / 2) / IW,
+            (cyg[..., None] - wh[None, None, :, 1] / 2) / IH,
+            (cxg[..., None] + wh[None, None, :, 0] / 2) / IW,
+            (cyg[..., None] + wh[None, None, :, 1] / 2) / IH,
+        ], axis=-1)                                        # [H, W, P, 4]
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               boxes.shape)
+        return boxes, var
+
+    return apply(fn, input, image, op_name="prior_box", n_outs=None)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route RoIs to FPN levels by scale (reference: paddle.vision.ops
+    distribute_fpn_proposals).  Static-shape formulation: instead of
+    variable-size per-level lists, every level gets the FULL roi tensor
+    plus a boolean mask + restore index (the XLA-friendly contract used by
+    this repo's FPN head; masked rois carry zero weight downstream)."""
+    from ..tensor.dispatch import apply
+
+    n_levels = max_level - min_level + 1
+
+    def fn(rois):
+        off = 1.0 if pixel_offset else 0.0
+        w = jnp.maximum(rois[:, 2] - rois[:, 0] + off, 0.0)
+        h = jnp.maximum(rois[:, 3] - rois[:, 1] + off, 0.0)
+        scale = jnp.sqrt(w * h)
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        masks = tuple((lvl == (min_level + i)) for i in range(n_levels))
+        order = jnp.argsort(lvl, stable=True)
+        restore = jnp.argsort(order, stable=True).astype(jnp.int32)
+        return masks + (restore,)
+
+    outs = apply(fn, fpn_rois, op_name="distribute_fpn_proposals",
+                 n_outs=None)
+    return list(outs[:-1]), outs[-1]
+
+
+def read_file(filename, name=None):
+    """Read raw bytes as a uint8 tensor (reference: paddle.vision.ops
+    read_file)."""
+    from ..tensor.tensor import Tensor
+
+    with open(filename, "rb") as f:
+        data = f.read()
+    import numpy as _np
+
+    return Tensor(jnp.asarray(_np.frombuffer(data, _np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode an encoded-image uint8 tensor to CHW uint8 (reference:
+    paddle.vision.ops.decode_jpeg; PIL does the host-side decode)."""
+    import io as _io
+
+    import numpy as _np
+    from PIL import Image
+
+    from ..tensor.tensor import Tensor
+
+    raw = bytes(_np.asarray(x._value if hasattr(x, "_value") else x,
+                            _np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=False, name=None, scale_x_y=1.0):
+    """YOLOv3 loss for one detection head (reference: paddle.vision.ops
+    yolo_loss): BCE on xy + L1 on wh for matched anchors, objectness BCE
+    with the ignore-threshold rule, per-class BCE.
+
+    Static-shape formulation: gts are a padded [N, B, 4] block (zero rows =
+    padding); matching computes, for every gt, its best anchor over the
+    FULL anchor set and writes targets with one-hot scatters — no dynamic
+    gather/boolean compaction, so the whole loss jits.
+    """
+    from ..tensor.dispatch import apply
+
+    A = len(anchor_mask)
+    anc = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)   # [n_total, 2]
+
+    def fn(xv, gbox, glab, *rest):
+        gscore = rest[0] if gt_score is not None else None
+        N, C, H, W = xv.shape
+        pred = xv.reshape(N, A, C // A, H, W)
+        tx, ty = pred[:, :, 0], pred[:, :, 1]
+        tw, th = pred[:, :, 2], pred[:, :, 3]
+        tobj = pred[:, :, 4]
+        tcls = pred[:, :, 5:]
+        stride = downsample_ratio
+        img_w, img_h = W * stride, H * stride
+
+        # decode predicted boxes (normalized) for the ignore rule
+        gy, gx = jnp.meshgrid(jnp.arange(H, dtype=jnp.float32),
+                              jnp.arange(W, dtype=jnp.float32), indexing="ij")
+        mask_anc = anc[jnp.asarray(anchor_mask)]
+        alpha = scale_x_y
+        beta = -0.5 * (scale_x_y - 1.0)
+        px = (alpha * jax.nn.sigmoid(tx) + beta + gx[None, None]) / W
+        py = (alpha * jax.nn.sigmoid(ty) + beta + gy[None, None]) / H
+        pw = jnp.exp(jnp.clip(tw, -10, 10)) * mask_anc[None, :, 0, None, None] / img_w
+        ph = jnp.exp(jnp.clip(th, -10, 10)) * mask_anc[None, :, 1, None, None] / img_h
+
+        B = gbox.shape[1]
+        valid = (gbox[..., 2] > 0) & (gbox[..., 3] > 0)      # [N, B]
+
+        # best-anchor match per gt over the FULL anchor set (shape-only IoU)
+        gw = gbox[..., 2] * img_w
+        gh = gbox[..., 3] * img_h
+        inter = jnp.minimum(gw[..., None], anc[None, None, :, 0]) * \
+            jnp.minimum(gh[..., None], anc[None, None, :, 1])
+        union = gw[..., None] * gh[..., None] \
+            + anc[None, None, :, 0] * anc[None, None, :, 1] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)  # [N,B]
+        # responsibility only if the best anchor belongs to this head
+        in_head = jnp.zeros_like(best, bool)
+        local_a = jnp.zeros_like(best)
+        for li, am in enumerate(anchor_mask):
+            hit = best == am
+            in_head = in_head | hit
+            local_a = jnp.where(hit, li, local_a)
+        resp = valid & in_head
+        gi = jnp.clip((gbox[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gbox[..., 1] * H).astype(jnp.int32), 0, H - 1)
+
+        # scatter gt targets into [N, A, H, W] grids
+        obj_tgt = jnp.zeros((N, A, H, W), jnp.float32)
+        n_idx = jnp.repeat(jnp.arange(N)[:, None], B, 1)
+        score_for_obj = gscore if gscore is not None else jnp.ones_like(gw)
+        obj_tgt = obj_tgt.at[n_idx, local_a, gj, gi].max(
+            jnp.where(resp, score_for_obj, 0.0))
+
+        # ignore rule: predicted boxes with IoU > thresh vs ANY gt are not
+        # penalized as background
+        pb = jnp.stack([px, py, pw, ph], -1).reshape(N, -1, 4)
+        gb = gbox
+        x1 = jnp.maximum(pb[:, :, None, 0] - pb[:, :, None, 2] / 2,
+                         gb[:, None, :, 0] - gb[:, None, :, 2] / 2)
+        y1 = jnp.maximum(pb[:, :, None, 1] - pb[:, :, None, 3] / 2,
+                         gb[:, None, :, 1] - gb[:, None, :, 3] / 2)
+        x2 = jnp.minimum(pb[:, :, None, 0] + pb[:, :, None, 2] / 2,
+                         gb[:, None, :, 0] + gb[:, None, :, 2] / 2)
+        y2 = jnp.minimum(pb[:, :, None, 1] + pb[:, :, None, 3] / 2,
+                         gb[:, None, :, 1] + gb[:, None, :, 3] / 2)
+        iw = jnp.maximum(x2 - x1, 0.0)
+        ih = jnp.maximum(y2 - y1, 0.0)
+        inter2 = iw * ih
+        area_p = pb[:, :, None, 2] * pb[:, :, None, 3]
+        area_g = gb[:, None, :, 2] * gb[:, None, :, 3]
+        iou = inter2 / jnp.maximum(area_p + area_g - inter2, 1e-9)
+        iou = jnp.where(valid[:, None, :], iou, 0.0)
+        best_iou = iou.max(-1).reshape(N, A, H, W)
+        ignore = (best_iou > ignore_thresh) & (obj_tgt < 0.5)
+
+        def bce(logit, tgt):
+            return jnp.maximum(logit, 0) - logit * tgt \
+                + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        # per-gt regression targets scattered onto the grid
+        sx = gbox[..., 0] * W - gi
+        sy_ = gbox[..., 1] * H - gj
+        tw_t = jnp.log(jnp.maximum(
+            gw / jnp.maximum(anc[best][..., 0], 1e-9), 1e-9))
+        th_t = jnp.log(jnp.maximum(
+            gh / jnp.maximum(anc[best][..., 1], 1e-9), 1e-9))
+        box_scale = 2.0 - gbox[..., 2] * gbox[..., 3]  # small boxes weigh more
+
+        def gather_pred(t):
+            return t[n_idx, local_a, gj, gi]           # [N, B]
+
+        score = gscore if gscore is not None else jnp.ones_like(gw)
+        w_resp = jnp.where(resp, score, 0.0)
+        sc = jnp.where(resp, box_scale * score, 0.0)
+        loss_xy = (sc * (bce(gather_pred(tx), sx)
+                         + bce(gather_pred(ty), sy_))).sum((-1,))
+        loss_wh = (sc * (jnp.abs(gather_pred(tw) - tw_t)
+                         + jnp.abs(gather_pred(th) - th_t))).sum((-1,))
+        obj_w = jnp.where(ignore, 0.0, 1.0)
+        loss_obj = (obj_w * bce(tobj, obj_tgt)).sum((1, 2, 3))
+        smooth = 1.0 / class_num if use_label_smooth else 0.0
+        cls_onehot = jax.nn.one_hot(glab, class_num) * (1 - smooth) \
+            + smooth / class_num
+        cls_pred = tcls.transpose(0, 1, 3, 4, 2)[n_idx, local_a, gj, gi]
+        loss_cls = (w_resp[..., None]
+                    * bce(cls_pred, cls_onehot)).sum((-1, -2))
+        return loss_xy + loss_wh + loss_obj + loss_cls
+
+    args = [x, gt_box, gt_label]
+    if gt_score is not None:
+        args.append(gt_score)
+    return apply(fn, *args, op_name="yolo_loss")

@@ -77,10 +77,11 @@ def test_imikolov(tmp_path):
     (tmp_path / "ptb.train.txt").write_text(
         "the cat sat\nthe cat ran\nthe dog sat\n")
     (tmp_path / "ptb.valid.txt").write_text("the cat sat\n")
-    ds = Imikolov(data_file=str(tmp_path), data_type="NGRAM", window_size=2,
+    ds = Imikolov(data_file=str(tmp_path), data_type="NGRAM", window_size=3,
                   mode="train", min_word_freq=2)
     assert len(ds) > 0
     gram = ds[0]
+    # reference convention: window_size tokens TOTAL (context + target)
     assert gram.shape == (3,)
     seq = Imikolov(data_file=str(tmp_path), data_type="SEQ", mode="valid",
                    min_word_freq=2)

@@ -1,0 +1,152 @@
+"""Round-4 vision.ops long tail: deform_conv2d (v1/v2), psroi_pool,
+prior_box, distribute_fpn_proposals, yolo_loss, read_file/decode_jpeg, and
+the paddle.static inference-model/autodiff compat APIs."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.static as static
+import paddle_tpu.vision.ops as VO
+
+
+def test_deform_conv2d_zero_offsets_match_conv2d():
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(2, 4, 8, 8).astype("float32"))
+    w = paddle.to_tensor(rs.randn(6, 4, 3, 3).astype("float32") * 0.2)
+    off = paddle.to_tensor(np.zeros((2, 18, 8, 8), "float32"))
+    out = VO.deform_conv2d(x, off, w, padding=1)
+    ref = F.conv2d(x, w, padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-5)
+    # v2: a 0.5 mask scales sampled values
+    mask = paddle.to_tensor(np.full((2, 9, 8, 8), 0.5, "float32"))
+    out2 = VO.deform_conv2d(x, off, w, padding=1, mask=mask)
+    np.testing.assert_allclose(out2.numpy(), ref.numpy() * 0.5, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deform_conv2d_integer_offset_shifts_sampling():
+    rs = np.random.RandomState(1)
+    x = paddle.to_tensor(rs.randn(1, 1, 6, 6).astype("float32"))
+    w = paddle.to_tensor(np.ones((1, 1, 1, 1), "float32"))
+    # a +1 x-offset everywhere == shifting the image left by one
+    off = np.zeros((1, 2, 6, 6), "float32")
+    off[:, 1] = 1.0
+    out = VO.deform_conv2d(x, paddle.to_tensor(off), w)
+    np.testing.assert_allclose(out.numpy()[0, 0, :, :-1],
+                               x.numpy()[0, 0, :, 1:], rtol=1e-5)
+    assert np.allclose(out.numpy()[0, 0, :, -1], 0.0)  # out of bounds -> 0
+
+
+def test_deform_conv2d_layer_trains():
+    paddle.seed(0)
+    layer = VO.DeformConv2D(3, 8, 3, padding=1)
+    off_head = nn.Conv2D(3, 18, 3, padding=1)
+    rs = np.random.RandomState(2)
+    x = paddle.to_tensor(rs.randn(2, 3, 8, 8).astype("float32"))
+    import paddle_tpu.optimizer as opt
+
+    o = opt.Adam(learning_rate=1e-2,
+                 parameters=list(layer.parameters()) + list(off_head.parameters()))
+    for _ in range(3):
+        out = layer(x, off_head(x))
+        loss = (out ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    assert np.isfinite(float(loss))
+
+
+def test_psroi_pool_position_sensitivity():
+    # constant-per-channel-block input: output bin (i,j) equals block (i,j)'s value
+    blocks = np.arange(4, dtype="float32")
+    x = np.repeat(blocks, 1)[None, :, None, None] * np.ones((1, 4, 4, 4), "float32")
+    out = VO.psroi_pool(paddle.to_tensor(x),
+                        paddle.to_tensor(np.array([[0, 0, 4, 4]], "float32")),
+                        paddle.to_tensor(np.array([1], "int32")), 2)
+    np.testing.assert_allclose(out.numpy().reshape(2, 2),
+                               blocks.reshape(2, 2), rtol=1e-5)
+
+
+def test_prior_box_centers_and_sizes():
+    feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), "float32"))
+    img = paddle.to_tensor(np.zeros((1, 3, 16, 16), "float32"))
+    boxes, var = VO.prior_box(feat, img, min_sizes=[8.0], aspect_ratios=[1.0])
+    assert boxes.shape == [2, 2, 1, 4]
+    b = boxes.numpy()[0, 0, 0]
+    # first cell center at (4, 4)/16 with an 8x8 box
+    np.testing.assert_allclose(b, [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    assert var.shape == boxes.shape
+
+
+def test_distribute_fpn_proposals_routing_and_restore():
+    rois = paddle.to_tensor(np.array(
+        [[0, 0, 500, 500], [0, 0, 14, 14], [0, 0, 224, 224]], "float32"))
+    masks, restore = VO.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+    lvl = np.stack([m.numpy() for m in masks]).argmax(0)
+    assert lvl[0] == 3 and lvl[1] == 0 and lvl[2] == 2  # big->P5, small->P2
+    # restore maps sorted order back to input order
+    order = np.argsort(np.stack([m.numpy() for m in masks]).argmax(0), kind="stable")
+    np.testing.assert_array_equal(np.asarray(order)[restore.numpy()],
+                                  np.arange(3))
+
+
+def test_yolo_loss_trains_and_penalizes_background():
+    rs = np.random.RandomState(0)
+    N, A, ncls, H, W = 1, 3, 4, 4, 4
+    x = paddle.to_tensor(rs.randn(N, A * (5 + ncls), H, W).astype("float32") * 0.1,
+                         stop_gradient=False)
+    gt_box = paddle.to_tensor(np.array([[[0.5, 0.5, 0.4, 0.4]]], "float32"))
+    gt_label = paddle.to_tensor(np.array([[1]], "int64"))
+    anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119,
+               116, 90, 156, 198, 373, 326]
+    loss = VO.yolo_loss(x, gt_box, gt_label, anchors, [0, 1, 2], ncls,
+                        0.7, 8)
+    assert loss.shape == [1] and np.isfinite(loss.numpy()).all()
+    loss.sum().backward()
+    assert np.isfinite(x.grad.numpy()).all() and np.abs(x.grad.numpy()).sum() > 0
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    try:
+        from PIL import Image
+    except ImportError:
+        pytest.skip("no PIL")
+    # smooth gradient (noise doesn't survive jpeg quantization)
+    g = np.linspace(0, 255, 8, dtype="uint8")
+    arr = np.stack(np.broadcast_arrays(g[:, None], g[None, :],
+                                       np.full((8, 8), 128, "uint8")), -1)
+    p = str(tmp_path / "img.jpg")
+    Image.fromarray(arr).save(p, quality=95)
+    raw = VO.read_file(p)
+    assert raw.dtype == paddle.uint8 and len(raw.shape) == 1
+    img = VO.decode_jpeg(raw, mode="rgb")
+    assert img.shape == [3, 8, 8]
+    assert np.abs(img.numpy().transpose(1, 2, 0).astype(int)
+                  - arr.astype(int)).mean() < 15
+
+
+def test_static_inference_model_and_autodiff(tmp_path):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    prefix = str(tmp_path / "inf" / "model")
+    static.save_inference_model(
+        prefix, [static.InputSpec([None, 4], "float32", "x")], m)
+    prog, feeds, fetches = static.load_inference_model(prefix)
+    assert feeds == ["x"] and fetches
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 4).astype("float32"))
+    np.testing.assert_allclose(prog(x).numpy(), m(x).numpy(), rtol=1e-5)
+    with pytest.raises(NotImplementedError):
+        static.save_inference_model(prefix, [], fetch_vars=None)
+
+    xg = paddle.to_tensor(np.ones((2, 4), "float32"), stop_gradient=False)
+    (gx,) = static.gradients(m(xg).sum(), xg)
+    assert gx.shape == [2, 4]
+    pg = static.append_backward((m(xg) ** 2).mean())
+    assert len(pg) == 4 and all(g is not None for _, g in pg)
+    with static.scope_guard(static._GlobalScope()) as sc:
+        assert static.global_scope() is sc
