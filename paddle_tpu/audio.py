@@ -14,11 +14,13 @@ import math
 import jax.numpy as jnp
 
 from . import signal as _signal
+from .io import Dataset as _Dataset
 from .nn.layer import Layer
 from .tensor.dispatch import apply as _apply
 from .tensor.tensor import Tensor
 
-__all__ = ["functional", "features"]
+__all__ = ["functional", "features", "backends", "datasets",
+           "load", "save", "info"]
 
 
 class functional:
@@ -204,3 +206,192 @@ class features:
     MelSpectrogram = _MelSpectrogram
     LogMelSpectrogram = _LogMelSpectrogram
     MFCC = _MFCC
+
+
+class backends:
+    """paddle.audio.backends (reference: the soundfile-backed
+    load/save/info trio).  Here the codec is the stdlib ``wave`` module —
+    16/32-bit PCM WAV in and out, which is what the bundled datasets use —
+    so audio IO works with zero extra dependencies."""
+
+    class AudioInfo:
+        def __init__(self, sample_rate, num_samples, num_channels,
+                     bits_per_sample, encoding="PCM_S"):
+            self.sample_rate = sample_rate
+            self.num_samples = num_samples
+            self.num_channels = num_channels
+            self.bits_per_sample = bits_per_sample
+            self.encoding = encoding
+
+    @staticmethod
+    def info(filepath):
+        import wave
+
+        with wave.open(str(filepath), "rb") as w:
+            return backends.AudioInfo(
+                w.getframerate(), w.getnframes(), w.getnchannels(),
+                w.getsampwidth() * 8)
+
+    @staticmethod
+    def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+             channels_first=True):
+        """-> (Tensor [C, T] (or [T, C]), sample_rate); normalize=True
+        scales PCM to [-1, 1] float32 (reference contract)."""
+        import wave
+
+        import numpy as _np
+
+        with wave.open(str(filepath), "rb") as w:
+            sr = w.getframerate()
+            nch = w.getnchannels()
+            width = w.getsampwidth()
+            w.setpos(frame_offset)
+            n = w.getnframes() - frame_offset if num_frames < 0 else num_frames
+            raw = w.readframes(n)
+        if width not in (1, 2, 4):
+            raise ValueError(f"unsupported PCM sample width {width*8} bits "
+                             "(supported: 8, 16, 32)")
+        dt = {1: _np.uint8, 2: _np.int16, 4: _np.int32}[width]
+        arr = _np.frombuffer(raw, dt).reshape(-1, nch)
+        if width == 1:
+            arr = arr.astype(_np.int16) - 128
+        if normalize:
+            arr = arr.astype(_np.float32) / float(2 ** (8 * width - 1))
+        out = arr.T if channels_first else arr
+        return Tensor(jnp.asarray(out)), sr
+
+    @staticmethod
+    def save(filepath, src, sample_rate, channels_first=True,
+             encoding="PCM_S", bits_per_sample=16):
+        import wave
+
+        import numpy as _np
+
+        if encoding != "PCM_S":
+            raise NotImplementedError(
+                f"the wave backend writes signed PCM only; got {encoding!r}")
+        if bits_per_sample not in (16, 32):
+            raise ValueError(f"unsupported bits_per_sample {bits_per_sample} "
+                             "(supported: 16, 32)")
+        arr = _np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+        if channels_first:
+            arr = arr.T                                  # -> [T, C]
+        if arr.dtype.kind == "f":
+            scale = float(2 ** (bits_per_sample - 1) - 1)
+            arr = _np.clip(arr, -1.0, 1.0) * scale
+        width = bits_per_sample // 8
+        arr = arr.astype({2: _np.int16, 4: _np.int32}[width])
+        with wave.open(str(filepath), "wb") as w:
+            w.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
+            w.setsampwidth(width)
+            w.setframerate(int(sample_rate))
+            w.writeframes(arr.tobytes())
+
+    @staticmethod
+    def list_available_backends():
+        return ["wave"]
+
+    @staticmethod
+    def get_current_backend():
+        return "wave"
+
+    @staticmethod
+    def set_backend(backend_name):
+        if backend_name != "wave":
+            raise NotImplementedError(
+                f"only the stdlib 'wave' backend ships; got {backend_name!r}")
+
+
+load = backends.load
+save = backends.save
+info = backends.info
+
+
+class _AudioClassificationDataset(_Dataset):
+    """Shared base for the wav-folder datasets: builds the (optional)
+    feature extractor ONCE, mixes multi-channel down to mono, and serves
+    (waveform-or-feature, label) — paddle.io.Dataset-compatible."""
+
+    _FEATS = {"spectrogram": "Spectrogram", "melspectrogram": "MelSpectrogram",
+              "logmelspectrogram": "LogMelSpectrogram", "mfcc": "MFCC"}
+
+    def _init_features(self, feat_type, feat_kwargs):
+        self.feat_type = feat_type
+        if feat_type == "raw":
+            self.feature = None
+        elif feat_type in self._FEATS:
+            self.feature = getattr(features, self._FEATS[feat_type])(
+                **feat_kwargs)
+        else:
+            raise ValueError(f"unknown feat_type {feat_type!r} "
+                             f"(raw or one of {sorted(self._FEATS)})")
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        path, label = self.files[idx]
+        wav, _sr = backends.load(path)
+        x = wav[0] if wav.shape[0] == 1 else wav.mean(axis=0)
+        if self.feature is None:
+            return x, label
+        return self.feature(x.unsqueeze(0))[0], label
+
+
+class datasets:
+    """paddle.audio.datasets (reference: TESS, ESC50) over local extracted
+    archives — the no-egress convention of this repo's other datasets."""
+
+    class TESS(_AudioClassificationDataset):
+        """Toronto emotional speech set: WAV files named
+        *_<emotion>.wav under per-actor folders."""
+
+        EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                    "sad"]
+
+        def __init__(self, mode="train", n_folds=5, split=1, data_file=None,
+                     feat_type="raw", archive=None, **feat_kwargs):
+            if data_file is None:
+                raise RuntimeError("no network egress; pass data_file "
+                                   "(extracted TESS root)")
+            import os as _os
+
+            wavs = []
+            for root, _dirs, files in sorted(_os.walk(str(data_file))):
+                for f in sorted(files):
+                    if f.lower().endswith(".wav"):
+                        emotion = f.rsplit("_", 1)[-1][:-4].lower()
+                        if emotion in self.EMOTIONS:
+                            wavs.append((_os.path.join(root, f),
+                                         self.EMOTIONS.index(emotion)))
+            # reference split: every n_folds-th file is the held-out fold
+            self.files = [(p, y) for i, (p, y) in enumerate(wavs)
+                          if (i % n_folds == split - 1) == (mode != "train")]
+            self._init_features(feat_type, feat_kwargs)
+
+    class ESC50(_AudioClassificationDataset):
+        """ESC-50 environmental sounds: audio/ WAVs named
+        <fold>-<src>-<take>-<target>.wav (fold 1..5 = the official CV
+        split; ``split`` selects the held-out fold)."""
+
+        def __init__(self, mode="train", split=1, data_file=None,
+                     feat_type="raw", **feat_kwargs):
+            if data_file is None:
+                raise RuntimeError("no network egress; pass data_file "
+                                   "(extracted ESC-50 root)")
+            import os as _os
+
+            root = str(data_file)
+            audio_dir = _os.path.join(root, "audio")
+            if not _os.path.isdir(audio_dir):
+                audio_dir = root
+            self.files = []
+            for f in sorted(_os.listdir(audio_dir)):
+                if not f.endswith(".wav"):
+                    continue
+                parts = f[:-4].split("-")
+                fold, target = int(parts[0]), int(parts[-1])
+                held_out = fold == split
+                if (mode == "train") != held_out:
+                    self.files.append((_os.path.join(audio_dir, f), target))
+            self._init_features(feat_type, feat_kwargs)

@@ -1,0 +1,72 @@
+"""paddle.audio backends (wave IO) and datasets (TESS/ESC50 layouts)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+
+
+def _write_wav(path, sr=16000, n=1600, ch=1, freq=440.0):
+    t = np.arange(n) / sr
+    x = (0.5 * np.sin(2 * np.pi * freq * t)).astype("float32")
+    if ch > 1:
+        x = np.stack([x] * ch)
+    else:
+        x = x[None]
+    audio.save(str(path), paddle.to_tensor(x), sr)
+    return x
+
+
+def test_wav_save_load_roundtrip(tmp_path):
+    p = tmp_path / "tone.wav"
+    x = _write_wav(p, ch=2)
+    info = audio.info(str(p))
+    assert info.sample_rate == 16000 and info.num_channels == 2
+    assert info.bits_per_sample == 16
+    y, sr = audio.load(str(p))
+    assert sr == 16000 and y.shape == [2, 1600]
+    np.testing.assert_allclose(y.numpy(), x, atol=2e-4)  # 16-bit quantization
+    # offsets and frame counts
+    y2, _ = audio.load(str(p), frame_offset=100, num_frames=50)
+    np.testing.assert_allclose(y2.numpy(), x[:, 100:150], atol=2e-4)
+    with pytest.raises(NotImplementedError):
+        audio.backends.set_backend("soundfile")
+
+
+def test_tess_dataset(tmp_path):
+    d = tmp_path / "TESS" / "OAF_angry_set"
+    os.makedirs(d)
+    for i, emo in enumerate(["angry", "happy", "sad", "angry", "fear"]):
+        _write_wav(d / f"OAF_word{i}_{emo}.wav", n=400)
+    ds = audio.datasets.TESS(mode="train", n_folds=5, split=1,
+                             data_file=str(tmp_path / "TESS"))
+    held = audio.datasets.TESS(mode="dev", n_folds=5, split=1,
+                               data_file=str(tmp_path / "TESS"))
+    assert len(ds) + len(held) == 5 and len(held) == 1
+    wav, label = ds[0]
+    assert wav.shape == [400]
+    assert 0 <= label < len(audio.datasets.TESS.EMOTIONS)
+    # feature mode produces a spectrogram
+    fs = audio.datasets.TESS(mode="train", n_folds=5, split=1,
+                             data_file=str(tmp_path / "TESS"),
+                             feat_type="spectrogram", n_fft=64)
+    feat, _ = fs[0]
+    assert len(feat.shape) == 2 and feat.shape[0] == 33
+
+
+def test_esc50_dataset(tmp_path):
+    d = tmp_path / "ESC-50" / "audio"
+    os.makedirs(d)
+    for fold in (1, 2):
+        for take, target in ((0, 3), (1, 7)):
+            _write_wav(d / f"{fold}-1234{take}-A-{target}.wav", n=200)
+    tr = audio.datasets.ESC50(mode="train", split=1,
+                              data_file=str(tmp_path / "ESC-50"))
+    te = audio.datasets.ESC50(mode="test", split=1,
+                              data_file=str(tmp_path / "ESC-50"))
+    assert len(tr) == 2 and len(te) == 2  # fold 1 held out
+    wav, label = te[0]
+    assert wav.shape == [200] and label in (3, 7)
