@@ -228,9 +228,10 @@ class backends:
         import wave
 
         with wave.open(str(filepath), "rb") as w:
+            bits = w.getsampwidth() * 8
             return backends.AudioInfo(
                 w.getframerate(), w.getnframes(), w.getnchannels(),
-                w.getsampwidth() * 8)
+                bits, encoding="PCM_U" if bits == 8 else "PCM_S")
 
     @staticmethod
     def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
@@ -276,11 +277,22 @@ class backends:
         arr = _np.asarray(src.numpy() if hasattr(src, "numpy") else src)
         if channels_first:
             arr = arr.T                                  # -> [T, C]
+        width = bits_per_sample // 8
+        tgt = {2: _np.int16, 4: _np.int32}[width]
         if arr.dtype.kind == "f":
             scale = float(2 ** (bits_per_sample - 1) - 1)
-            arr = _np.clip(arr, -1.0, 1.0) * scale
-        width = bits_per_sample // 8
-        arr = arr.astype({2: _np.int16, 4: _np.int32}[width])
+            arr = (_np.clip(arr, -1.0, 1.0) * scale).astype(tgt)
+        elif arr.dtype == _np.int16 and width == 4:
+            arr = arr.astype(_np.int32) << 16            # re-scale, not pad
+        elif arr.dtype == _np.int32 and width == 2:
+            arr = (arr >> 16).astype(_np.int16)
+        elif arr.dtype == tgt:
+            pass
+        else:
+            raise ValueError(
+                f"integer input dtype {arr.dtype} cannot be written as "
+                f"{bits_per_sample}-bit PCM without silent wrap; pass "
+                "float [-1, 1] or a matching int dtype")
         with wave.open(str(filepath), "wb") as w:
             w.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
             w.setsampwidth(width)
@@ -387,9 +399,13 @@ class datasets:
                 audio_dir = root
             self.files = []
             for f in sorted(_os.listdir(audio_dir)):
-                if not f.endswith(".wav"):
+                if not f.lower().endswith(".wav"):
                     continue
                 parts = f[:-4].split("-")
+                # skip non-conforming names (e.g. AppleDouble '._*' files)
+                if len(parts) < 4 or not (parts[0].isdigit()
+                                          and parts[-1].isdigit()):
+                    continue
                 fold, target = int(parts[0]), int(parts[-1])
                 held_out = fold == split
                 if (mode == "train") != held_out:

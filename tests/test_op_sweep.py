@@ -872,6 +872,145 @@ def _expm_ref(x):
     return expm(x.astype("float64")).astype("float32")
 
 
+
+# ------------------------------------------------- round-4b sweep widening
+spec("add_n", lambda a, b, c: paddle.add_n([a, b, c]),
+     lambda a, b, c: a + b + c,
+     {"a": rnd(3, 4, seed=401), "b": rnd(3, 4, seed=402),
+      "c": rnd(3, 4, seed=403)})
+spec("amax", lambda x: paddle.amax(x, axis=1), lambda x: x.max(1),
+     {"x": rnd(3, 4, seed=404)})
+spec("amin", lambda x: paddle.amin(x, axis=0), lambda x: x.min(0),
+     {"x": rnd(3, 4, seed=405)})
+spec("logsumexp", lambda x: paddle.logsumexp(x, axis=-1),
+     lambda x: np.log(np.exp(x).sum(-1)), {"x": rnd(3, 4, seed=406)})
+spec("mean-axis", lambda x: paddle.mean(x, axis=1, keepdim=True),
+     lambda x: x.mean(1, keepdims=True), {"x": rnd(3, 4, seed=407)})
+spec("median-even", lambda x: paddle.median(x, axis=1),
+     lambda x: np.median(x, axis=1), {"x": rnd(3, 4, seed=408)})
+spec("prod-axis", lambda x: paddle.prod(x, axis=1),
+     lambda x: x.prod(1), {"x": pos(3, 4, seed=409)})
+spec("max-global", lambda x: paddle.max(x), lambda x: x.max(),
+     {"x": rnd(3, 4, seed=410)}, grad=False)
+spec("min-global", lambda x: paddle.min(x), lambda x: x.min(),
+     {"x": rnd(3, 4, seed=411)})
+spec("nanmean", lambda x: paddle.nanmean(paddle.where(
+         x > 0, x, paddle.full_like(x, float("nan")))),
+     lambda x: np.nanmean(np.where(x > 0, x, np.nan)),
+     {"x": rnd(3, 4, seed=412)}, grad=False)
+spec("nansum", lambda x: paddle.nansum(paddle.where(
+         x > 0, x, paddle.full_like(x, float("nan")))),
+     lambda x: np.nansum(np.where(x > 0, x, np.nan)),
+     {"x": rnd(3, 4, seed=413)}, grad=False)
+spec("erfc", lambda x: paddle.erfc(x),
+     lambda x: _scipy("erfc")(x), {"x": rnd(3, 4, seed=414)})
+spec("polygamma1", lambda x: paddle.polygamma(x + 1.5, 1),
+     lambda x: _scipy_polygamma(x + 1.5, 1), {"x": pos(3, 4, seed=415)},
+     grad=False)
+spec("floor_mod", lambda x, y: paddle.floor_mod(x, y), np.mod,
+     {"x": rnd(3, 4, seed=416), "y": pos(3, 4, seed=417)}, grad=False)
+spec("equal-r4", lambda x, y: paddle.equal(x, (y > 0).astype("float32")),
+     lambda x, y: x == (y > 0).astype("float32"),
+     {"x": _rs(418).randint(0, 2, (3, 4)).astype("float32"),
+      "y": rnd(3, 4, seed=419)}, grad=False)
+spec("not_equal-r4", lambda x, y: paddle.not_equal(x, y), np.not_equal,
+     {"x": rnd(3, 4, seed=420), "y": rnd(3, 4, seed=421)}, grad=False)
+spec("greater_equal-r4", lambda x, y: paddle.greater_equal(x, y),
+     np.greater_equal,
+     {"x": rnd(3, 4, seed=422), "y": rnd(3, 4, seed=423)}, grad=False)
+spec("less_than-r4", lambda x, y: paddle.less_than(x, y), np.less,
+     {"x": rnd(3, 4, seed=424), "y": rnd(3, 4, seed=425)}, grad=False)
+spec("logical_and-r4", lambda x, y: paddle.logical_and(x > 0, y > 0),
+     lambda x, y: (x > 0) & (y > 0),
+     {"x": rnd(3, 4, seed=426), "y": rnd(3, 4, seed=427)}, grad=False)
+spec("logical_xor-r4", lambda x, y: paddle.logical_xor(x > 0, y > 0),
+     lambda x, y: (x > 0) ^ (y > 0),
+     {"x": rnd(3, 4, seed=428), "y": rnd(3, 4, seed=429)}, grad=False)
+spec("bitwise_and-r4", lambda x, y: paddle.bitwise_and(x, y), np.bitwise_and,
+     {"x": _rs(430).randint(0, 16, (3, 4)).astype("int32"),
+      "y": _rs(431).randint(0, 16, (3, 4)).astype("int32")}, grad=False)
+spec("bitwise_invert", lambda x: paddle.bitwise_invert(x), np.invert,
+     {"x": _rs(432).randint(0, 16, (3, 4)).astype("int32")}, grad=False)
+spec("expand_as", lambda x, y: paddle.expand_as(x, y),
+     lambda x, y: np.broadcast_to(x, y.shape),
+     {"x": rnd(1, 4, seed=433), "y": rnd(3, 4, seed=434)}, grad=False)
+spec("increment", lambda x: paddle.increment(x, 2.5),
+     lambda x: x + 2.5, {"x": rnd(1, seed=435)}, grad=False)
+spec("eye-rect", lambda x: x[0, 0] * paddle.eye(3, 5),
+     lambda x: x[0, 0] * np.eye(3, 5, dtype="float32"),
+     {"x": rnd(1, 1, seed=436)}, grad=False)
+spec("linspace", lambda x: paddle.linspace(0, 1, 7) + 0 * x.sum(),
+     lambda x: np.linspace(0, 1, 7, dtype="float32"),
+     {"x": rnd(1, seed=437)}, grad=False)
+spec("logspace", lambda x: paddle.logspace(0, 2, 5) + 0 * x.sum(),
+     lambda x: np.logspace(0, 2, 5, dtype="float64").astype("float32"),
+     {"x": rnd(1, seed=438)}, grad=False, rtol=1e-4)
+spec("meshgrid0", lambda x, y: paddle.meshgrid(x, y)[0],
+     lambda x, y: np.meshgrid(x, y, indexing="ij")[0],
+     {"x": rnd(3, seed=439), "y": rnd(4, seed=440)}, grad=False)
+spec("masked_scatter",
+     lambda x, v: paddle.masked_scatter(
+         x, paddle.to_tensor(np.tile([True, False], 6).reshape(3, 4)), v),
+     lambda x, v: _masked_scatter_ref(x, v),
+     {"x": rnd(3, 4, seed=441), "v": rnd(6, seed=442)}, grad=False)
+spec("atleast_2d", lambda x: paddle.atleast_2d(x),
+     lambda x: np.atleast_2d(x), {"x": rnd(4, seed=443)})
+spec("block_diag2", lambda x, y: paddle.block_diag(x, y),
+     lambda x, y: _block_diag_ref(x, y),
+     {"x": rnd(2, 2, seed=444), "y": rnd(3, 1, seed=445)})
+spec("broadcast_tensors0",
+     lambda x, y: paddle.broadcast_tensors([x, y])[0],
+     lambda x, y: np.broadcast_arrays(x, y)[0],
+     {"x": rnd(1, 4, seed=446), "y": rnd(3, 1, seed=447)}, grad=False)
+spec("cartesian_prod2", lambda x, y: paddle.cartesian_prod(x, y),
+     lambda x, y: np.stack(
+         [np.repeat(x, len(y)), np.tile(y, len(x))], -1),
+     {"x": rnd(3, seed=448), "y": rnd(2, seed=449)})
+spec("combinations2", lambda x: paddle.combinations(x, 2),
+     lambda x: np.asarray(list(__import__("itertools").combinations(x, 2)),
+                          "float32"),
+     {"x": rnd(4, seed=450)}, grad=False)
+spec("diagonal_scatter",
+     lambda x, v: paddle.diagonal_scatter(x, v),
+     lambda x, v: _diag_scatter_ref(x, v),
+     {"x": rnd(3, 3, seed=451), "v": rnd(3, seed=452)})
+spec("polar", lambda r, t: paddle.real(paddle.polar(r, t)),
+     lambda r, t: r * np.cos(t),
+     {"r": pos(3, 4, seed=453), "t": rnd(3, 4, seed=454)}, grad=False)
+spec("is_floating_point",
+     lambda x: paddle.to_tensor(float(paddle.is_floating_point(x))),
+     lambda x: np.float32(1.0), {"x": rnd(2, seed=455)}, grad=False)
+spec("logical_not-bool", lambda x: paddle.logical_not(x > 0),
+     lambda x: ~(x > 0), {"x": rnd(3, 4, seed=456)}, grad=False)
+
+
+def _masked_scatter_ref(x, v):
+    out = x.copy().reshape(-1)
+    mask = np.tile([True, False], 6)
+    out[mask] = v[:mask.sum()]
+    return out.reshape(3, 4)
+
+
+def _block_diag_ref(x, y):
+    out = np.zeros((x.shape[0] + y.shape[0], x.shape[1] + y.shape[1]),
+                   "float32")
+    out[:x.shape[0], :x.shape[1]] = x
+    out[x.shape[0]:, x.shape[1]:] = y
+    return out
+
+
+def _diag_scatter_ref(x, v):
+    out = x.copy()
+    np.fill_diagonal(out, v)
+    return out
+
+
+def _scipy_polygamma(x, n):
+    from scipy.special import polygamma as pg
+
+    return pg(n, x).astype("float32")
+
+
 SPECS = [s for s in SPECS if s is not None]
 _IDS = [s["id"] for s in SPECS]
 assert len(set(_IDS)) == len(_IDS), "duplicate spec ids"
