@@ -45,16 +45,11 @@ def synthesize_tree(root, n_per_class=24):
     return root
 
 
-def npy_loader(path):
-    return np.load(path)
-
-
 def main():
     if len(sys.argv) > 1:
-        root, loader = sys.argv[1], None
+        root = sys.argv[1]
     else:
         root = synthesize_tree(tempfile.mkdtemp(prefix="imagefolder_"))
-        loader = npy_loader
         print(f"(no DATA_DIR given: synthesized 3-class tree at {root})")
 
     train_tf = T.Compose([
@@ -64,8 +59,7 @@ def main():
         T.Transpose(),                       # HWC -> CHW
         T.Normalize(mean=[127.5] * 3, std=[127.5] * 3),
     ])
-    ds = DatasetFolder(root, loader=loader, transform=train_tf,
-                       extensions=(".npy", ".jpg", ".jpeg", ".png"))
+    ds = DatasetFolder(root, transform=train_tf)
     print(f"{len(ds)} images, {len(ds.classes)} classes: {ds.classes}")
 
     loader_train = DataLoader(ds, batch_size=16, shuffle=True, num_workers=2,
@@ -86,8 +80,7 @@ def main():
         T.Resize(IMG), T.CenterCrop(IMG), T.Transpose(),
         T.Normalize(mean=[127.5] * 3, std=[127.5] * 3),
     ])
-    eval_ds = DatasetFolder(root, loader=loader, transform=eval_tf,
-                            extensions=(".npy", ".jpg", ".jpeg", ".png"))
+    eval_ds = DatasetFolder(root, transform=eval_tf)
     res = model.evaluate(DataLoader(eval_ds, batch_size=16), verbose=0)
     print("eval:", res)
 

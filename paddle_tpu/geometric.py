@@ -1,0 +1,133 @@
+"""paddle.geometric (reference: python/paddle/geometric/) — graph message
+passing and segment reductions.
+
+TPU-native: every primitive lowers to gather + ``jax.ops.segment_*`` /
+scatter-reduce, which XLA turns into vectorized dynamic-slice/scatter —
+no per-edge loops.  ``out_size``/num_segments must be static under jit
+(pass it explicitly inside traced code; eager infers from the data).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .tensor.dispatch import apply
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "send_uv"]
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    return int(jnp.max(ids)) + 1 if ids.size else 0
+
+
+def _segment(data, segment_ids, out_size, kind):
+    def fn(d, ids):
+        n = _num_segments(ids, out_size)
+        if kind == "sum":
+            return jax.ops.segment_sum(d, ids, num_segments=n)
+        if kind == "mean":
+            tot = jax.ops.segment_sum(d, ids, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(ids, d.dtype), ids,
+                                      num_segments=n)
+            shape = (n,) + (1,) * (d.ndim - 1)
+            return tot / jnp.maximum(cnt.reshape(shape), 1)
+        if kind == "max":
+            return jax.ops.segment_max(d, ids, num_segments=n)
+        return jax.ops.segment_min(d, ids, num_segments=n)
+
+    return apply(fn, data, segment_ids, op_name=f"segment_{kind}")
+
+
+def segment_sum(data, segment_ids, name=None, out_size=None):
+    """Sum rows of ``data`` per segment id (reference:
+    paddle.geometric.segment_sum; ids must be sorted there — here any
+    order works, matching ids still reduce together)."""
+    return _segment(data, segment_ids, out_size, "sum")
+
+
+def segment_mean(data, segment_ids, name=None, out_size=None):
+    return _segment(data, segment_ids, out_size, "mean")
+
+
+def segment_max(data, segment_ids, name=None, out_size=None):
+    """Per-segment max; empty segments give the dtype's -inf (the
+    reference leaves them 0 — use out_size + a finite fill if needed)."""
+    return _segment(data, segment_ids, out_size, "max")
+
+
+def segment_min(data, segment_ids, name=None, out_size=None):
+    return _segment(data, segment_ids, out_size, "min")
+
+
+_MSG = {
+    "add": lambda u, e: u + e,
+    "sub": lambda u, e: u - e,
+    "mul": lambda u, e: u * e,
+    "div": lambda u, e: u / e,
+}
+
+
+def _reduce_edges(msgs, dst, n, reduce_op):
+    if reduce_op in ("sum", "mean"):
+        out = jax.ops.segment_sum(msgs, dst, num_segments=n)
+        if reduce_op == "mean":
+            cnt = jax.ops.segment_sum(jnp.ones_like(dst, msgs.dtype), dst,
+                                      num_segments=n)
+            out = out / jnp.maximum(cnt.reshape((n,) + (1,) * (msgs.ndim - 1)),
+                                    1)
+        return out
+    if reduce_op == "max":
+        out = jax.ops.segment_max(msgs, dst, num_segments=n)
+    elif reduce_op == "min":
+        out = jax.ops.segment_min(msgs, dst, num_segments=n)
+    else:
+        raise ValueError(f"unknown reduce_op {reduce_op!r}")
+    # reference semantics: nodes with NO in-edges read 0 (detected by the
+    # in-degree, so integer dtypes work and legitimate +-inf values survive)
+    cnt = jax.ops.segment_sum(jnp.ones_like(dst), dst, num_segments=n)
+    empty = (cnt == 0).reshape((n,) + (1,) * (msgs.ndim - 1))
+    return jnp.where(empty, jnp.zeros_like(out), out)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather source-node features along edges and reduce at destinations
+    (reference: paddle.geometric.send_u_recv)."""
+    def fn(xv, src, dst):
+        n = _num_segments(dst, out_size) if out_size is not None \
+            else xv.shape[0]
+        return _reduce_edges(xv[src], dst, n, reduce_op)
+
+    return apply(fn, x, src_index, dst_index, op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine source-node features with edge features, reduce at
+    destinations (reference: paddle.geometric.send_ue_recv)."""
+    if message_op not in _MSG:
+        raise ValueError(f"unknown message_op {message_op!r}")
+
+    def fn(xv, yv, src, dst):
+        n = _num_segments(dst, out_size) if out_size is not None \
+            else xv.shape[0]
+        msgs = _MSG[message_op](xv[src], yv)
+        return _reduce_edges(msgs, dst, n, reduce_op)
+
+    return apply(fn, x, y, src_index, dst_index, op_name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge messages from source and destination node features
+    (reference: paddle.geometric.send_uv): out[e] = x[src[e]] op y[dst[e]]."""
+    if message_op not in _MSG:
+        raise ValueError(f"unknown message_op {message_op!r}")
+
+    def fn(xv, yv, src, dst):
+        return _MSG[message_op](xv[src], yv[dst])
+
+    return apply(fn, x, y, src_index, dst_index, op_name="send_uv")

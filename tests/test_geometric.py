@@ -1,0 +1,108 @@
+"""paddle.geometric: segment reductions + graph message passing
+(reference: python/paddle/geometric/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+
+def _graph():
+    # 4 nodes, 5 edges
+    src = np.array([0, 0, 1, 2, 3], "int64")
+    dst = np.array([1, 2, 2, 3, 0], "int64")
+    x = np.arange(8, dtype="float32").reshape(4, 2) + 1
+    return x, src, dst
+
+
+def test_segment_reductions():
+    data = paddle.to_tensor(np.array([[1., 2], [3, 4], [5, 6], [7, 8]], "float32"))
+    ids = paddle.to_tensor(np.array([0, 0, 1, 1], "int64"))
+    np.testing.assert_allclose(G.segment_sum(data, ids).numpy(),
+                               [[4, 6], [12, 14]])
+    np.testing.assert_allclose(G.segment_mean(data, ids).numpy(),
+                               [[2, 3], [6, 7]])
+    np.testing.assert_allclose(G.segment_max(data, ids).numpy(),
+                               [[3, 4], [7, 8]])
+    np.testing.assert_allclose(G.segment_min(data, ids).numpy(),
+                               [[1, 2], [5, 6]])
+    # out_size pads with empty segments
+    assert G.segment_sum(data, ids, out_size=4).shape == [4, 2]
+
+
+def test_segment_sum_grad():
+    data = paddle.to_tensor(np.ones((4, 2), "float32"), stop_gradient=False)
+    ids = paddle.to_tensor(np.array([0, 1, 0, 1], "int64"))
+    out = G.segment_sum(data, ids)
+    (out * paddle.to_tensor(np.array([[1., 2], [3, 4]], "float32"))).sum().backward()
+    np.testing.assert_allclose(data.grad.numpy(),
+                               [[1, 2], [3, 4], [1, 2], [3, 4]])
+
+
+def test_send_u_recv_sum_and_mean():
+    x, src, dst = _graph()
+    out = G.send_u_recv(paddle.to_tensor(x), paddle.to_tensor(src),
+                        paddle.to_tensor(dst), reduce_op="sum")
+    want = np.zeros_like(x)
+    for s, d in zip(src, dst):
+        want[d] += x[s]
+    np.testing.assert_allclose(out.numpy(), want)
+    mean = G.send_u_recv(paddle.to_tensor(x), paddle.to_tensor(src),
+                         paddle.to_tensor(dst), reduce_op="mean")
+    cnt = np.bincount(dst, minlength=4)[:, None]
+    np.testing.assert_allclose(mean.numpy(), want / np.maximum(cnt, 1))
+
+
+def test_send_u_recv_max_empty_nodes_zero():
+    x, src, dst = _graph()
+    out = G.send_u_recv(paddle.to_tensor(x), paddle.to_tensor(src[:1]),
+                        paddle.to_tensor(dst[:1]), reduce_op="max")
+    # only node 1 receives; everyone else must read 0, not -inf
+    assert np.isfinite(out.numpy()).all()
+    np.testing.assert_allclose(out.numpy()[1], x[0])
+    np.testing.assert_allclose(out.numpy()[0], 0.0)
+
+
+def test_send_ue_recv_and_send_uv():
+    x, src, dst = _graph()
+    e = np.linspace(0.1, 1.0, 10).astype("float32").reshape(5, 2)
+    out = G.send_ue_recv(paddle.to_tensor(x), paddle.to_tensor(e),
+                         paddle.to_tensor(src), paddle.to_tensor(dst),
+                         message_op="mul", reduce_op="sum")
+    want = np.zeros_like(x)
+    for i, (s, d) in enumerate(zip(src, dst)):
+        want[d] += x[s] * e[i]
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-6)
+
+    uv = G.send_uv(paddle.to_tensor(x), paddle.to_tensor(x),
+                   paddle.to_tensor(src), paddle.to_tensor(dst),
+                   message_op="add")
+    np.testing.assert_allclose(uv.numpy(), x[src] + x[dst])
+    with pytest.raises(ValueError):
+        G.send_uv(paddle.to_tensor(x), paddle.to_tensor(x),
+                  paddle.to_tensor(src), paddle.to_tensor(dst),
+                  message_op="pow")
+
+
+def test_gcn_layer_trains():
+    """A one-layer GCN over the toy graph trains through the tape."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+
+    x, src, dst = _graph()
+    y = paddle.to_tensor(np.array([0, 1, 0, 1], "int64"))
+    paddle.seed(0)
+    lin = nn.Linear(2, 2)
+    o = opt.Adam(learning_rate=5e-2, parameters=lin.parameters())
+    lossf = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(20):
+        h = G.send_u_recv(lin(paddle.to_tensor(x)), paddle.to_tensor(src),
+                          paddle.to_tensor(dst), reduce_op="mean")
+        l = lossf(h, y)
+        l.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.8, losses
