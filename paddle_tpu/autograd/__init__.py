@@ -139,3 +139,101 @@ class PyLayer(metaclass=PyLayerMeta):
                 t._grad_node = node
             return tuple(new_outs) if multi else new_outs[0]
         return out
+
+
+# ---------------------------------------------------- functional autodiff
+def _as_jax_fn(func):
+    """Wrap a Tensor-in/Tensor-out callable as a jax-array function."""
+    from ..tensor.tensor import Tensor
+
+    def fn(*arrays):
+        # jax does the differentiation; suppress the eager tape so the
+        # trace doesn't record (and immediately discard) a Node per op
+        with _state.no_grad_ctx():
+            outs = func(*[Tensor(a) for a in arrays])
+        if isinstance(outs, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in outs)
+        return outs._value if isinstance(outs, Tensor) else outs
+
+    return fn
+
+
+def _unwrap_all(xs):
+    from ..tensor.tensor import Tensor
+
+    single = not isinstance(xs, (tuple, list))
+    vals = [x._value if isinstance(x, Tensor) else x
+            for x in ([xs] if single else xs)]
+    return vals, single
+
+
+def _wrap_tree(tree):
+    import jax
+
+    from ..tensor.tensor import Tensor
+
+    return jax.tree_util.tree_map(Tensor, tree)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """d func(xs) / d xs (reference: paddle.autograd's functional jacobian;
+    func-based form — jax.jacrev does the work in one traced pass)."""
+    import jax
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: compose jax transforms instead (e.g. take "
+            "jacobian inside the outer loss function)")
+    vals, single = _unwrap_all(xs)
+    argnums = 0 if single else tuple(range(len(vals)))
+    out = jax.jacrev(_as_jax_fn(func), argnums=argnums)(*vals)
+    return _wrap_tree(out)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """d^2 func(xs) / d xs^2 for a scalar-output func (reference:
+    functional hessian) — forward-over-reverse, one compiled program."""
+    import jax
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: compose jax transforms instead")
+    vals, single = _unwrap_all(xs)
+    argnums = 0 if single else tuple(range(len(vals)))
+    out = jax.hessian(_as_jax_fn(func), argnums=argnums)(*vals)
+    return _wrap_tree(out)
+
+
+def vjp(func, xs, v=None):
+    """(outputs, vjp_result): pull ``v`` back through func at xs
+    (reference: paddle.autograd.functional.vjp)."""
+    import jax
+    import jax.numpy as jnp
+
+    vals, single = _unwrap_all(xs)
+    outs, pullback = jax.vjp(_as_jax_fn(func), *vals)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, outs)
+    else:
+        cot, _ = _unwrap_all(v)
+        cot = cot[0] if not isinstance(outs, tuple) else tuple(cot)
+    grads = pullback(cot)
+    grads = grads[0] if single else grads
+    return _wrap_tree(outs), _wrap_tree(grads)
+
+
+def jvp(func, xs, v=None):
+    """(outputs, jvp_result): push ``v`` forward through func at xs
+    (reference: paddle.autograd.functional.jvp)."""
+    import jax
+    import jax.numpy as jnp
+
+    vals, single = _unwrap_all(xs)
+    if v is None:
+        tangents = [jnp.ones_like(x) for x in vals]
+    else:
+        tangents, _ = _unwrap_all(v)
+    outs, tangent_out = jax.jvp(_as_jax_fn(func), tuple(vals),
+                                tuple(tangents))
+    return _wrap_tree(outs), _wrap_tree(tangent_out)

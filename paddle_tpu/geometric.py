@@ -36,8 +36,14 @@ def _segment(data, segment_ids, out_size, kind):
             shape = (n,) + (1,) * (d.ndim - 1)
             return tot / jnp.maximum(cnt.reshape(shape), 1)
         if kind == "max":
-            return jax.ops.segment_max(d, ids, num_segments=n)
-        return jax.ops.segment_min(d, ids, num_segments=n)
+            out = jax.ops.segment_max(d, ids, num_segments=n)
+        else:
+            out = jax.ops.segment_min(d, ids, num_segments=n)
+        # reference semantics: EMPTY segments read 0, not the identity
+        # sentinel (+-inf for floats, INT_MIN/INT_MAX for ints)
+        cnt = jax.ops.segment_sum(jnp.ones_like(ids), ids, num_segments=n)
+        empty = (cnt == 0).reshape((n,) + (1,) * (d.ndim - 1))
+        return jnp.where(empty, jnp.zeros_like(out), out)
 
     return apply(fn, data, segment_ids, op_name=f"segment_{kind}")
 
@@ -54,8 +60,7 @@ def segment_mean(data, segment_ids, name=None, out_size=None):
 
 
 def segment_max(data, segment_ids, name=None, out_size=None):
-    """Per-segment max; empty segments give the dtype's -inf (the
-    reference leaves them 0 — use out_size + a finite fill if needed)."""
+    """Per-segment max; empty segments read 0 (reference semantics)."""
     return _segment(data, segment_ids, out_size, "max")
 
 

@@ -1,0 +1,69 @@
+"""paddle.hub (reference: python/paddle/hapi/hub.py — hub.list/help/load
+over a repo's hubconf.py).
+
+Local source only (no network egress in this environment): ``repo_dir`` is
+a directory containing ``hubconf.py`` whose public callables are the hub
+entry points (the reference's github/gitee sources raise with a pointer to
+clone locally first).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir, source):
+    if source not in ("local",):
+        raise NotImplementedError(
+            f"hub source {source!r} needs network egress; clone the repo "
+            "and use source='local'")
+    path = os.path.join(str(repo_dir), _HUBCONF)
+    if not os.path.exists(path):
+        raise RuntimeError(f"no {_HUBCONF} under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    # multi-file hub repos import siblings — repo_dir joins sys.path for
+    # the duration of the hubconf exec (torch.hub/reference behavior)
+    import sys
+
+    sys.path.insert(0, str(repo_dir))
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        try:
+            sys.path.remove(str(repo_dir))
+        except ValueError:
+            pass
+    return mod
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entry-point names exported by the repo's hubconf."""
+    mod = _load_hubconf(repo_dir, source)
+    return [n for n in dir(mod)
+            if not n.startswith("_") and callable(getattr(mod, n))]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    """The entry point's docstring."""
+    mod = _load_hubconf(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no hub entry point {model!r}; available: "
+                           f"{list(repo_dir, source)}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Call the entry point (usually returns a constructed Layer)."""
+    mod = _load_hubconf(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no hub entry point {model!r}; available: "
+                           f"{list(repo_dir, source)}")
+    return fn(**kwargs)

@@ -117,3 +117,42 @@ class FusedLinear(Layer):
 
     def forward(self, x):
         return FF.fused_linear(x, self.weight, self.bias, self.transpose_weight)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """reference: paddle.incubate.nn.FusedTransformerEncoderLayer — one
+    encoder block over the fused attention/ffn front-ends (the fusion
+    itself is XLA's; this class keeps the reference's constructor and
+    state_dict shape)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=(attn_dropout_rate if attn_dropout_rate
+                               is not None else dropout_rate),
+            normalize_before=normalize_before,
+            qkv_weight_attr=weight_attr, qkv_bias_attr=bias_attr,
+            linear_weight_attr=weight_attr, linear_bias_attr=bias_attr)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation,
+            act_dropout_rate=(act_dropout_rate if act_dropout_rate
+                              is not None else dropout_rate),
+            normalize_before=normalize_before,
+            linear1_weight_attr=weight_attr, linear1_bias_attr=bias_attr,
+            linear2_weight_attr=weight_attr, linear2_bias_attr=bias_attr)
+
+    def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedTransformerEncoderLayer cache (incremental decoding) "
+                "is not supported; use nn.TransformerEncoderLayer's cache "
+                "path")
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)

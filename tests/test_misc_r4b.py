@@ -1,0 +1,71 @@
+"""Functional autodiff (jacobian/hessian/vjp/jvp), FusedTransformerEncoderLayer,
+paddle.hub local source."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.autograd as A
+import paddle_tpu.nn as nn
+
+
+def test_jacobian_and_hessian():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+    J = A.jacobian(lambda t: t * t, x)
+    np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0, 6.0]))
+    H = A.hessian(lambda t: (t ** 3).sum(), x)
+    np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0, 18.0]))
+    # multi-input jacobian returns a tuple
+    y = paddle.to_tensor(np.array([2.0], "float32"))
+    Jx, Jy = A.jacobian(lambda a, b: a * b, [x, y])
+    np.testing.assert_allclose(np.diag(Jx.numpy()), [2.0, 2.0, 2.0])
+
+
+def test_vjp_jvp_roundtrip():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    v = paddle.to_tensor(np.array([1.0, 0.5], "float32"))
+    outs, g = A.vjp(lambda t: t * t * t, x, v)
+    np.testing.assert_allclose(g.numpy(), 3 * x.numpy() ** 2 * v.numpy())
+    outs, tg = A.jvp(lambda t: t * t * t, x, v)
+    np.testing.assert_allclose(tg.numpy(), 3 * x.numpy() ** 2 * v.numpy())
+    # default cotangent/tangent = ones
+    _, g1 = A.vjp(lambda t: t.sum(), x)
+    np.testing.assert_allclose(g1.numpy(), [1.0, 1.0])
+
+
+def test_fused_transformer_encoder_layer_matches_unfused_shape():
+    from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+
+    paddle.seed(0)
+    layer = FusedTransformerEncoderLayer(16, 2, 32, dropout_rate=0.0)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 5, 16).astype("float32"))
+    out = layer(x)
+    assert out.shape == [2, 5, 16]
+    layer.eval()
+    a, b = layer(x).numpy(), layer(x).numpy()
+    np.testing.assert_allclose(a, b)  # deterministic in eval
+    # state dict has the fused qkv parameter layout
+    keys = dict(layer.state_dict()).keys()
+    assert any("qkv_weight" in k for k in keys)
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "import paddle_tpu.nn as nn\n"
+        "def tiny_mlp(width=8):\n"
+        "    '''A tiny MLP.'''\n"
+        "    return nn.Sequential(nn.Linear(4, width), nn.ReLU())\n"
+        "_private = lambda: None\n")
+    from paddle_tpu import hub
+
+    assert hub.list(str(tmp_path)) == ["tiny_mlp"]
+    assert "tiny MLP" in hub.help(str(tmp_path), "tiny_mlp")
+    m = hub.load(str(tmp_path), "tiny_mlp", width=6)
+    out = m(paddle.to_tensor(np.ones((2, 4), "float32")))
+    assert out.shape == [2, 6]
+    with pytest.raises(NotImplementedError):
+        hub.load("owner/repo", "x", source="github")
+    with pytest.raises(RuntimeError):
+        hub.load(str(tmp_path), "nope")
