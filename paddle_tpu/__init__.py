@@ -106,6 +106,11 @@ def __getattr__(name):
 
         globals()["flops"] = f
         return f
+    if name == "ParamAttr":
+        from .nn.param_attr import ParamAttr as PA
+
+        globals()["ParamAttr"] = PA
+        return PA
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 

@@ -3,5 +3,5 @@ re-export of the hapi callback classes)."""
 
 from .hapi.callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
-    VisualDL,
+    ReduceLROnPlateau, VisualDL, WandbCallback,
 )

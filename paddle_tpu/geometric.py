@@ -18,6 +18,14 @@ __all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
            "send_u_recv", "send_ue_recv", "send_uv"]
 
 
+def _mask_empty_segments(out, ids, n, ndim):
+    """Reference semantics: EMPTY segments read 0, not the reduce identity
+    (+-inf for floats, INT_MIN/INT_MAX for ints)."""
+    cnt = jax.ops.segment_sum(jnp.ones_like(ids), ids, num_segments=n)
+    empty = (cnt == 0).reshape((n,) + (1,) * (ndim - 1))
+    return jnp.where(empty, jnp.zeros_like(out), out)
+
+
 def _num_segments(ids, out_size):
     if out_size is not None:
         return int(out_size)
@@ -39,11 +47,7 @@ def _segment(data, segment_ids, out_size, kind):
             out = jax.ops.segment_max(d, ids, num_segments=n)
         else:
             out = jax.ops.segment_min(d, ids, num_segments=n)
-        # reference semantics: EMPTY segments read 0, not the identity
-        # sentinel (+-inf for floats, INT_MIN/INT_MAX for ints)
-        cnt = jax.ops.segment_sum(jnp.ones_like(ids), ids, num_segments=n)
-        empty = (cnt == 0).reshape((n,) + (1,) * (d.ndim - 1))
-        return jnp.where(empty, jnp.zeros_like(out), out)
+        return _mask_empty_segments(out, ids, n, d.ndim)
 
     return apply(fn, data, segment_ids, op_name=f"segment_{kind}")
 
@@ -91,11 +95,7 @@ def _reduce_edges(msgs, dst, n, reduce_op):
         out = jax.ops.segment_min(msgs, dst, num_segments=n)
     else:
         raise ValueError(f"unknown reduce_op {reduce_op!r}")
-    # reference semantics: nodes with NO in-edges read 0 (detected by the
-    # in-degree, so integer dtypes work and legitimate +-inf values survive)
-    cnt = jax.ops.segment_sum(jnp.ones_like(dst), dst, num_segments=n)
-    empty = (cnt == 0).reshape((n,) + (1,) * (msgs.ndim - 1))
-    return jnp.where(empty, jnp.zeros_like(out), out)
+    return _mask_empty_segments(out, dst, n, msgs.ndim)
 
 
 def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,

@@ -255,3 +255,99 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
     lst.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
                     "metrics": metrics or []})
     return lst
+
+
+class ReduceLROnPlateau(Callback):
+    """Callback spelling of the plateau schedule (reference:
+    paddle.callbacks.ReduceLROnPlateau): scales the optimizer LR when the
+    monitored metric stops improving."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self._best = None
+        self._wait = 0
+        self._cooling = 0
+        self._use_eval = False
+
+    def _better(self, cur):
+        if self._best is None:
+            return True
+        if self.mode == "min":
+            return cur < self._best - self.min_delta
+        return cur > self._best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        # once an eval stream exists it owns the monitor: train-epoch logs
+        # would otherwise double-count patience with mixed train/eval values
+        if not self._use_eval:
+            self._use_eval = True
+            self._best, self._wait, self._cooling = None, 0, 0
+        self._check(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self._use_eval:
+            self._check(logs)
+
+    def _check(self, logs):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._cooling > 0:
+            self._cooling -= 1
+            if self._better(cur):
+                self._best = cur
+                self._wait = 0
+            return
+        if self._better(cur):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                new_lr = max(float(opt.get_lr()) * self.factor, self.min_lr)
+                opt.set_lr(new_lr)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr -> {new_lr:.3e}")
+            self._wait = 0
+            self._cooling = self.cooldown
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logging (reference: paddle.callbacks.WandbCallback).
+    Requires the wandb package (not bundled here — no network egress);
+    constructing without it raises with that explanation."""
+
+    def __init__(self, project=None, name=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback needs the wandb package (unavailable in "
+                "this no-egress environment)") from e
+        self._wandb = wandb
+        self._run = wandb.init(project=project, name=name, **kwargs)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._wandb.log(dict(logs or {}))
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._wandb.log({"epoch": epoch, **(logs or {})})
+
+    def on_train_end(self, logs=None):
+        self._run.finish()

@@ -49,21 +49,20 @@ def list(repo_dir, source="local", force_reload=False):  # noqa: A001
             if not n.startswith("_") and callable(getattr(mod, n))]
 
 
-def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
-    """The entry point's docstring."""
-    mod = _load_hubconf(repo_dir, source)
+def _get_entry(mod, model):
     fn = getattr(mod, model, None)
     if fn is None or not callable(fn):
-        raise RuntimeError(f"no hub entry point {model!r}; available: "
-                           f"{list(repo_dir, source)}")
-    return fn.__doc__
+        avail = [n for n in dir(mod)
+                 if not n.startswith("_") and callable(getattr(mod, n))]
+        raise RuntimeError(f"no hub entry point {model!r}; available: {avail}")
+    return fn
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    """The entry point's docstring."""
+    return _get_entry(_load_hubconf(repo_dir, source), model).__doc__
 
 
 def load(repo_dir, model, source="local", force_reload=False, **kwargs):
     """Call the entry point (usually returns a constructed Layer)."""
-    mod = _load_hubconf(repo_dir, source)
-    fn = getattr(mod, model, None)
-    if fn is None or not callable(fn):
-        raise RuntimeError(f"no hub entry point {model!r}; available: "
-                           f"{list(repo_dir, source)}")
-    return fn(**kwargs)
+    return _get_entry(_load_hubconf(repo_dir, source), model)(**kwargs)

@@ -166,3 +166,39 @@ def calculate_gain(nonlinearity, param=None):
     if nonlinearity == "selu":
         return 3.0 / 4
     return 1.0
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsample kernel init for transposed convs (reference:
+    paddle.nn.initializer.Bilinear): weight [Cout, Cin, K, K] becomes the
+    classic bilinear interpolation stencil per channel pair."""
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as np
+
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D conv weight")
+        co, ci, kh, kw = shape
+        f_h, f_w = math.ceil(kh / 2), math.ceil(kw / 2)
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        og_h = np.arange(kh).reshape(-1, 1)
+        og_w = np.arange(kw).reshape(1, -1)
+        filt = ((1 - np.abs(og_h / f_h - c_h))
+                * (1 - np.abs(og_w / f_w - c_w))).astype("float32")
+        w = np.broadcast_to(filt, shape)
+        return jnp.asarray(w, _dt.to_jax(dtype))
+
+
+_GLOBAL_INIT = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """reference: paddle.nn.initializer.set_global_initializer — default
+    initializers for subsequently created parameters (None resets)."""
+    _GLOBAL_INIT["weight"] = weight_init
+    _GLOBAL_INIT["bias"] = bias_init
+
+
+def _global_default(is_bias):
+    return _GLOBAL_INIT["bias" if is_bias else "weight"]

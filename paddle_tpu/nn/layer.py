@@ -77,6 +77,8 @@ class Layer:
             elif isinstance(attr, str):
                 name = attr
         if init is None:
+            init = I._global_default(is_bias)  # set_global_initializer
+        if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         p = Parameter(init(tuple(shape), dtype), name=name, trainable=trainable)
         return p

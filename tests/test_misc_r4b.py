@@ -69,3 +69,58 @@ def test_hub_local(tmp_path):
         hub.load("owner/repo", "x", source="github")
     with pytest.raises(RuntimeError):
         hub.load(str(tmp_path), "nope")
+
+
+def test_bilinear_initializer_and_global_default():
+    import paddle_tpu.nn.initializer as I
+
+    w = I.Bilinear()((2, 2, 4, 4), "float32")
+    # center rows/cols carry the largest interpolation weight, corners least
+    arr = np.asarray(w)
+    assert arr.shape == (2, 2, 4, 4)
+    assert arr[0, 0].max() == arr[0, 0, 1:3, 1:3].max()
+    assert arr[0, 0, 0, 0] == arr[0, 0].min()
+    with pytest.raises(ValueError):
+        I.Bilinear()((4, 4), "float32")
+
+    I.set_global_initializer(I.Constant(0.5), I.Constant(0.25))
+    try:
+        lin = nn.Linear(3, 3)
+        assert np.allclose(lin.weight.numpy(), 0.5)
+        assert np.allclose(lin.bias.numpy(), 0.25)
+    finally:
+        I.set_global_initializer(None, None)
+    lin2 = nn.Linear(3, 3)
+    assert not np.allclose(lin2.weight.numpy(), 0.5)
+
+
+def test_reduce_lr_on_plateau_callback():
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.callbacks import ReduceLROnPlateau
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    o = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+    model = paddle.Model(net)
+    model.prepare(optimizer=o, loss=nn.CrossEntropyLoss())
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2, verbose=0)
+    cb.set_model(model)
+    # flat losses -> after `patience` checks the lr halves
+    cb.on_epoch_end(0, {"loss": 1.0})
+    cb.on_epoch_end(1, {"loss": 1.0})
+    cb.on_epoch_end(2, {"loss": 1.0})
+    assert abs(float(o.get_lr()) - 0.05) < 1e-9
+    # improvement resets the counter
+    cb.on_epoch_end(3, {"loss": 0.5})
+    cb.on_epoch_end(4, {"loss": 0.5})
+    assert abs(float(o.get_lr()) - 0.05) < 1e-9
+
+
+def test_wandb_callback_raises_without_wandb(monkeypatch):
+    import sys
+
+    from paddle_tpu.callbacks import WandbCallback
+
+    monkeypatch.setitem(sys.modules, "wandb", None)  # force import failure
+    with pytest.raises(ImportError):
+        WandbCallback(project="x")
