@@ -1,5 +1,5 @@
 from . import functional  # noqa: F401
 from .layer import (  # noqa: F401
     FusedFeedForward, FusedLinear, FusedMultiHeadAttention,
-    FusedTransformerEncoderLayer,
+    FusedMultiTransformer, FusedTransformerEncoderLayer,
 )

@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import math
 
-from ...nn.layer import Layer
+from ...nn.layer import Layer, LayerList
 from ...nn import initializer as I
 from ...tensor.tensor import Parameter
 from . import functional as FF
@@ -156,3 +156,150 @@ class FusedTransformerEncoderLayer(Layer):
                 "path")
         out = self.fused_attn(src, attn_mask=src_mask)
         return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """reference: paddle.incubate.nn.FusedMultiTransformer — the serving
+    decoder stack (pre-LN self-attention + FFN per layer) with static
+    KV caches written at ``time_step`` for incremental decoding.
+
+    TPU-native: caches are fixed-shape [B, max_len, H, D] buffers updated
+    with dynamic_update_slice (one compiled decode step serves every
+    position), and the whole stack is one traced program — the reference's
+    single-CUDA-kernel fusion is XLA's fusion here.
+    """
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, num_layers=1,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if not normalize_before:
+            raise NotImplementedError(
+                "FusedMultiTransformer is pre-LN in the reference serving "
+                "path; normalize_before=False is not supported")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        self.num_layers = num_layers
+        self.layers = LayerList([
+            _FusedMTBlock(embed_dim, num_heads, dim_feedforward,
+                          dropout_rate, activation)
+            for _ in range(num_layers)])
+
+    def gen_cache(self, batch_size, max_length):
+        """Fixed-shape per-layer (k, v) cache buffers."""
+        import jax.numpy as jnp
+
+        from ...tensor.tensor import Tensor
+
+        shape = (batch_size, max_length, self.num_heads, self.head_dim)
+        return [(Tensor(jnp.zeros(shape, jnp.float32)),
+                 Tensor(jnp.zeros(shape, jnp.float32)))
+                for _ in range(self.num_layers)]
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        new_caches = []
+        out = src
+        for i, blk in enumerate(self.layers):
+            cache = caches[i] if caches is not None else None
+            out, new_cache = blk(out, attn_mask, cache, time_step)
+            new_caches.append(new_cache)
+        if caches is not None:
+            return out, new_caches
+        return out
+
+
+class _FusedMTBlock(Layer):
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate,
+                 activation):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        from ...nn import LayerNorm
+
+        self.ln1 = LayerNorm(embed_dim)
+        self.qkv = FusedLinear(embed_dim, 3 * embed_dim)
+        self.out_proj = FusedLinear(embed_dim, embed_dim)
+        self.ln2 = LayerNorm(embed_dim)
+        self.fc1 = FusedLinear(embed_dim, dim_feedforward)
+        self.fc2 = FusedLinear(dim_feedforward, embed_dim)
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+
+    def forward(self, src, attn_mask, cache, time_step):
+        from ...nn import functional as F
+        from ...tensor.dispatch import apply
+        import jax
+        import jax.numpy as jnp
+
+        h = self.ln1(src)
+        B, T = h.shape[0], h.shape[1]
+        qkv = self.qkv(h).reshape([B, T, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        new_cache = None
+        if cache is not None:
+            ck, cv = cache
+            if time_step is None:
+                raise ValueError("caches need time_step (decode position)")
+            ts_val = getattr(time_step, "_value", time_step)
+            if not hasattr(ts_val, "aval") or not hasattr(
+                    ts_val.aval, "weak_type") or hasattr(ts_val, "item"):
+                try:  # eager: catch silent overwrite past the cache end
+                    if int(ts_val) + T > ck.shape[1]:
+                        raise ValueError(
+                            f"decode position {int(ts_val)}+{T} exceeds "
+                            f"cache max_length {ck.shape[1]}")
+                except TypeError:
+                    pass  # traced value: bounds are the caller's contract
+
+            def upd(buf, new):
+                def fn(b_, n_, t_):
+                    t_ = t_.astype(jnp.int32).reshape(())
+                    zero = jnp.zeros((), jnp.int32)
+                    return jax.lax.dynamic_update_slice(
+                        b_, n_.astype(b_.dtype), (zero, t_, zero, zero))
+
+                return apply(fn, buf, new, time_step, op_name="cache_update")
+
+            ck = upd(ck, k)
+            cv = upd(cv, v)
+            new_cache = (ck, cv)
+            # attend over the cache prefix [0, time_step + T)
+            k_all, v_all = ck, cv
+            L = k_all.shape[1]
+
+            def masked_attn(qq, kk, vv, ts, *mask):
+                # [B, T, H, D] x [B, L, H, D]; causal WITHIN the new-token
+                # window too (prefill with T>1 must not see its own future)
+                s = jnp.einsum("bthd,blhd->bhtl", qq, kk) \
+                    / jnp.sqrt(jnp.float32(qq.shape[-1]))
+                pos = jnp.arange(L)[None, None, None, :]
+                tq = jnp.arange(T)[None, None, :, None]
+                limit = ts.astype(jnp.int32) + 1 + tq
+                s = jnp.where(pos < limit, s, -1e30)
+                if mask:
+                    s = s + mask[0].astype(jnp.float32)
+                p = jax.nn.softmax(s.astype(jnp.float32), -1).astype(qq.dtype)
+                return jnp.einsum("bhtl,blhd->bthd", p, vv)
+
+            attn_args = (q, k_all, v_all, time_step) \
+                if attn_mask is None else (q, k_all, v_all, time_step,
+                                           attn_mask)
+            o = apply(masked_attn, *attn_args,
+                      op_name="fused_mt_cached_attn")
+        else:
+            o = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                               is_causal=attn_mask is None,
+                                               training=self.training)
+        o = self.out_proj(o.reshape([B, T, -1]))
+        if self.dropout_rate and self.training:
+            o = F.dropout(o, p=self.dropout_rate, training=True)
+        src = src + o
+        h2 = getattr(F, self.activation)(self.fc1(self.ln2(src)))
+        h2 = self.fc2(h2)
+        if self.dropout_rate and self.training:
+            h2 = F.dropout(h2, p=self.dropout_rate, training=True)
+        return src + h2, new_cache
+

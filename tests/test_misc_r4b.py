@@ -124,3 +124,30 @@ def test_wandb_callback_raises_without_wandb(monkeypatch):
     monkeypatch.setitem(sys.modules, "wandb", None)  # force import failure
     with pytest.raises(ImportError):
         WandbCallback(project="x")
+
+
+def test_fused_multi_transformer_incremental_decode_matches_full():
+    """The serving-decoder oracle: feeding tokens one at a time through the
+    static KV caches reproduces the full causal forward exactly."""
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    paddle.seed(0)
+    mt = FusedMultiTransformer(16, 2, 32, num_layers=2).eval()
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(2, 6, 16).astype("float32"))
+    full = mt(x).numpy()
+
+    caches = mt.gen_cache(2, 8)
+    outs = []
+    for t in range(6):
+        tok = paddle.to_tensor(x.numpy()[:, t:t + 1])
+        o, caches = mt(tok, caches=caches,
+                       time_step=paddle.to_tensor(np.int64(t)))
+        outs.append(o.numpy())
+    inc = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(inc, full, atol=2e-5)
+    # cache misuse raises
+    with pytest.raises(ValueError):
+        mt(x, caches=mt.gen_cache(2, 8))
+    with pytest.raises(NotImplementedError):
+        FusedMultiTransformer(8, 2, 16, normalize_before=False)
