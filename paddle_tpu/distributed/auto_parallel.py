@@ -430,3 +430,61 @@ def load_state_dict(state_dict, path, process_group=None,
         if isinstance(dst, Tensor):
             dst._value = src
     return state_dict
+
+
+class ShardDataloader:
+    """reference: paddle.distributed.shard_dataloader — wraps a DataLoader
+    so each produced batch lands sharded over the mesh's data axis (the
+    reference shards per-rank reads; single-controller shards the global
+    batch with a NamedSharding device_put)."""
+
+    def __init__(self, dataloader, meshes, input_keys=None, shard_dims=0,
+                 is_dataset_splitted=False):
+        self._loader = dataloader
+        self._mesh = meshes[0] if isinstance(meshes, (tuple, list)) else meshes
+        self._shard_dims = shard_dims
+        self._input_keys = set(input_keys) if input_keys is not None else None
+        # the DATA axis: 'dp' when the mesh has one, else the first dim —
+        # never silently shard the batch over a model-parallel axis
+        names = self._mesh.dim_names
+        self._axis = "dp" if "dp" in names else names[0]
+        self._jmesh = self._mesh.jax_mesh
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _dim_for(self, key):
+        if isinstance(self._shard_dims, dict):
+            return self._shard_dims.get(key, 0)
+        return int(self._shard_dims)
+
+    def _shard(self, t, key=None):
+        if not isinstance(t, Tensor):
+            return t
+        if self._input_keys is not None and key is not None                 and key not in self._input_keys:
+            return t
+        dim = self._dim_for(key)
+        entries = [None] * t._value.ndim
+        entries[dim] = self._axis
+        sharding = NamedSharding(self._jmesh, PartitionSpec(*entries))
+        out = Tensor(jax.device_put(t._value, sharding),
+                     stop_gradient=t.stop_gradient)
+        placements = [Shard(dim) if n == self._axis else Replicate()
+                      for n in self._mesh.dim_names]
+        out._dist_attr = (self._mesh, tuple(placements))
+        return out
+
+    def __iter__(self):
+        for batch in self._loader:
+            if isinstance(batch, dict):
+                yield {k: self._shard(v, k) for k, v in batch.items()}
+            elif isinstance(batch, (tuple, list)):
+                yield [self._shard(v) for v in batch]
+            else:
+                yield self._shard(batch)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=0,
+                     is_dataset_splitted=False):
+    return ShardDataloader(dataloader, meshes, input_keys, shard_dims,
+                           is_dataset_splitted)

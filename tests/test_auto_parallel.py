@@ -125,3 +125,47 @@ def test_save_load_state_dict_reshards_on_load(tmp_path):
     np.testing.assert_allclose(dst["w"].numpy(), w, rtol=1e-6)
     # layout of the DESTINATION prevails (re-shard on load)
     assert dst["w"]._value.addressable_shards[0].data.shape == (8, 4)
+
+
+def test_shard_dataloader_batches_land_on_dp_axis():
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    mesh = ProcessMesh(np.arange(8), ["dp"])
+    rs = np.random.RandomState(0)
+    ds = TensorDataset([paddle.to_tensor(rs.randn(32, 4).astype("float32")),
+                        paddle.to_tensor(rs.randint(0, 3, (32, 1)).astype("int64"))])
+    loader = dist.shard_dataloader(DataLoader(ds, batch_size=16), [mesh])
+    assert len(loader) == 2
+    for x, y in loader:
+        assert "dp" in str(x._value.sharding.spec)
+        assert x._value.addressable_shards[0].data.shape == (2, 4)
+        pm, pl = dist.get_dist_attr(x)
+        assert pl == (dist.Shard(0),)
+
+
+def test_fused_allreduce_gradients_dp_mean():
+    import paddle_tpu.distributed.fleet as fleet
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import topology as topo
+    from paddle_tpu.distributed.fleet.utils.hybrid_parallel_util import (
+        broadcast_dp_parameters, fused_allreduce_gradients,
+    )
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        broadcast_dp_parameters(m)
+        x = paddle.to_tensor(np.ones((8, 4), "float32"))
+        (m(x).sum()).backward()
+        g_before = m.weight.grad.numpy().copy()
+        fused_allreduce_gradients(list(m.parameters()))
+        # replicated grads: pmean over dp leaves the value unchanged
+        np.testing.assert_allclose(m.weight.grad.numpy(), g_before, rtol=1e-6)
+        # no-grad params and dp_degree==1 paths are no-ops
+        m.clear_gradients() if hasattr(m, "clear_gradients") else None
+    finally:
+        topo.set_hybrid_communicate_group(None)
+    fused_allreduce_gradients(list(m.parameters()))  # hcg=None -> no-op
