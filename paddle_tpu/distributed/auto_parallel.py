@@ -488,3 +488,131 @@ def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=0,
                      is_dataset_splitted=False):
     return ShardDataloader(dataloader, meshes, input_keys, shard_dims,
                            is_dataset_splitted)
+
+
+
+def _spec_from_placements_loose(mesh, placements):
+    """PartitionSpec sized by the largest Shard dim (trailing dims
+    replicate; two mesh axes on one dim merge to a tuple) — for outputs
+    whose rank isn't known before tracing."""
+    max_dim = -1
+    for p in placements:
+        if isinstance(p, Shard):
+            max_dim = max(max_dim, p.dim)
+    entries = [None] * (max_dim + 1)
+    for axis_name, p in zip(mesh.dim_names, placements):
+        if isinstance(p, Shard):
+            if entries[p.dim] is None:
+                entries[p.dim] = axis_name
+            elif isinstance(entries[p.dim], tuple):
+                entries[p.dim] = entries[p.dim] + (axis_name,)
+            else:
+                entries[p.dim] = (entries[p.dim], axis_name)
+    return PartitionSpec(*entries)
+
+
+def _local_layer_base():
+    from ..nn.layer import Layer as _Layer
+
+    return _Layer
+
+
+class LocalLayer(_local_layer_base()):
+    """reference: paddle.distributed.LocalLayer — a Layer whose forward
+    runs PER SHARD (each device computes on its local piece — the
+    rank-local custom-loss escape hatch), with ``out_dist_attrs``
+    [(mesh, placements)] describing how each output re-assembles.
+
+    Both reference spellings work: subclass it and define ``forward`` (the
+    canonical pattern), or wrap an existing layer via ``layer=``.  The
+    local body runs inside a differentiable ``shard_map``; parameters ride
+    along replicated; inputs keep their dist_attr (or XLA-propagated)
+    layouts.  Buffer MUTATIONS inside the local body (e.g. BN running
+    stats) do not persist.
+    """
+
+    def __init__(self, layer=None, process_mesh=None, out_dist_attrs=None,
+                 grad_dist_attrs=None):
+        super().__init__()
+        self._mesh = process_mesh
+        self._out_attrs = out_dist_attrs
+        if layer is not None:
+            self.inner = layer
+        self._sm_cache = {}
+
+    def forward(self, *args, **kwargs):
+        if hasattr(self, "inner"):
+            return self.inner(*args, **kwargs)
+        raise NotImplementedError(
+            "subclass LocalLayer and define forward, or pass layer=")
+
+    def __call__(self, *args, **kwargs):
+        from ..tensor.dispatch import apply
+        from .communication import shard_map
+
+        if self._mesh is None or self._out_attrs is None:
+            raise ValueError(
+                "LocalLayer needs process_mesh and out_dist_attrs")
+        mesh = self._mesh
+        kw_keys = tuple(sorted(kwargs))
+        flat_args = list(args) + [kwargs[k] for k in kw_keys]
+        pnames = [k for k, _ in self.named_parameters()]
+        bnames = [k for k, _ in self.named_buffers()]
+        n_p, n_b = len(pnames), len(bnames)
+
+        def spec_of(t):
+            da = get_dist_attr(t)
+            if da is not None:
+                return _spec_from_placements(t.ndim, da[0], da[1])
+            # intermediate values (e.g. model outputs) carry the
+            # XLA-propagated layout on the array itself even when no
+            # dist_attr was recorded — honor it, else each device would
+            # wrongly treat the FULL value as its "local" shard
+            v = t._value if isinstance(t, Tensor) else t
+            sh = getattr(v, "sharding", None)
+            spec = getattr(sh, "spec", None)
+            if spec is not None and getattr(sh, "mesh", None) is not None:
+                try:
+                    if sh.mesh.shape == mesh.jax_mesh.shape:
+                        return PartitionSpec(*spec)
+                except Exception:
+                    pass
+            return PartitionSpec()
+
+        in_specs = (tuple(PartitionSpec() for _ in range(n_p + n_b))
+                    + tuple(spec_of(a) for a in flat_args))
+        key = (kw_keys, tuple(str(sp) for sp in in_specs),
+               tuple((tuple(getattr(a, "shape", ())),
+                      str(getattr(a, "dtype", ""))) for a in flat_args))
+        sm = self._sm_cache.get(key)
+        if sm is None:
+            out_specs = tuple(_spec_from_placements_loose(m, pl)
+                              for (m, pl) in self._out_attrs)
+            n_pos = len(args)
+            this = self
+
+            def body(*flat):
+                pvals = dict(zip(pnames, flat[:n_p]))
+                bvals = dict(zip(bnames, flat[n_p:n_p + n_b]))
+                rest = flat[n_p + n_b:]
+                pos = [Tensor(a) for a in rest[:n_pos]]
+                kws = {k: Tensor(a) for k, a in zip(kw_keys, rest[n_pos:])}
+                with this.bind(pvals, bvals):
+                    out = this.forward(*pos, **kws)
+                this._captured_buffers = None  # no lingering local tracers
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                return tuple(o._value if isinstance(o, Tensor) else o
+                             for o in outs)
+
+            sm = shard_map(body, mesh.jax_mesh, in_specs,
+                           out_specs if len(out_specs) > 1 else out_specs[0])
+            self._sm_cache[key] = sm
+
+        outs = apply(sm, *[p for _, p in self.named_parameters()],
+                     *[b for _, b in self.named_buffers()], *flat_args,
+                     op_name="local_layer",
+                     n_outs=None if len(self._out_attrs) > 1 else 1)
+        res = list(outs) if isinstance(outs, tuple) else [outs]
+        for o, (m, pl) in zip(res, self._out_attrs):
+            o._dist_attr = (m, tuple(pl))
+        return res[0] if len(res) == 1 else tuple(res)

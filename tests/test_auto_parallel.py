@@ -169,3 +169,88 @@ def test_fused_allreduce_gradients_dp_mean():
     finally:
         topo.set_hybrid_communicate_group(None)
     fused_allreduce_gradients(list(m.parameters()))  # hcg=None -> no-op
+
+
+def test_local_layer_per_shard_loss():
+    """LocalLayer: each device computes a loss on its LOCAL batch shard;
+    outputs re-assemble per out_dist_attrs, and gradients flow."""
+    mesh = ProcessMesh(np.arange(8), ["dp"])
+    rs = np.random.RandomState(0)
+
+    class LocalMSE(nn.Layer):
+        def forward(self, pred, tgt):
+            # runs per shard: pred/tgt are this device's rows
+            return ((pred - tgt) ** 2).mean(axis=-1)
+
+    wrapped = dist.LocalLayer(LocalMSE(), mesh, [(mesh, [dist.Shard(0)])])
+    pred = shard_tensor(paddle.to_tensor(rs.randn(16, 4).astype("float32"),
+                                         stop_gradient=False),
+                        mesh, [dist.Shard(0)])
+    tgt = shard_tensor(paddle.to_tensor(rs.randn(16, 4).astype("float32")),
+                       mesh, [dist.Shard(0)])
+    out = wrapped(pred, tgt)
+    assert out.shape == [16]
+    np.testing.assert_allclose(
+        out.numpy(), ((pred.numpy() - tgt.numpy()) ** 2).mean(-1), rtol=1e-5)
+    # output carries the declared layout and gradients flow through
+    pm, pl = dist.get_dist_attr(out)
+    assert pl == (dist.Shard(0),)
+    out.sum().backward()
+    np.testing.assert_allclose(
+        pred.grad.numpy(), 2 * (pred.numpy() - tgt.numpy()) / 4, rtol=1e-5)
+
+
+def test_local_layer_with_parameters():
+    mesh = ProcessMesh(np.arange(8), ["dp"])
+    paddle.seed(0)
+
+    class Scaled(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.scale = self.create_parameter([1])
+
+        def forward(self, x):
+            return x * self.scale
+
+    inner = Scaled()
+    wrapped = dist.LocalLayer(inner, mesh, [(mesh, [dist.Shard(0)])])
+    x = shard_tensor(paddle.to_tensor(np.ones((8, 2), "float32"),
+                                      stop_gradient=False),
+                     mesh, [dist.Shard(0)])
+    out = wrapped(x)
+    out.sum().backward()
+    assert inner.scale.grad is not None
+    np.testing.assert_allclose(float(inner.scale.grad.numpy()[0]),
+                               float(x.numpy().sum() * 1.0) / 1.0, rtol=1e-5)
+
+
+def test_local_layer_subclass_pattern_with_kwargs():
+    """The canonical reference spelling: subclass LocalLayer, define
+    forward; kwargs pass through; the shard_map is cached across calls."""
+
+    class CustomLoss(dist.LocalLayer):
+        def __init__(self, mesh):
+            super().__init__(process_mesh=mesh,
+                             out_dist_attrs=[(mesh, [dist.Shard(0)])])
+
+        def forward(self, pred, tgt):
+            return ((pred - tgt) ** 2).sum(axis=-1)
+
+    mesh = ProcessMesh(np.arange(8), ["dp"])
+    rs = np.random.RandomState(3)
+    cl = CustomLoss(mesh)
+    pred = shard_tensor(paddle.to_tensor(rs.randn(8, 3).astype("float32"),
+                                         stop_gradient=False),
+                        mesh, [dist.Shard(0)])
+    tgt = shard_tensor(paddle.to_tensor(rs.randn(8, 3).astype("float32")),
+                       mesh, [dist.Shard(0)])
+    out = cl(pred, tgt=tgt)
+    np.testing.assert_allclose(
+        out.numpy(), ((pred.numpy() - tgt.numpy()) ** 2).sum(-1), rtol=1e-5)
+    out.sum().backward()
+    np.testing.assert_allclose(pred.grad.numpy(),
+                               2 * (pred.numpy() - tgt.numpy()), rtol=1e-5)
+    cl(pred, tgt=tgt)
+    assert len(cl._sm_cache) == 1  # retrace-free steady state
+    with pytest.raises(ValueError):
+        dist.LocalLayer(layer=None)(pred)
