@@ -28,7 +28,8 @@ from .auto_parallel import (  # noqa: F401
     ProcessMesh, shard_tensor, shard_layer, shard_op, Shard, Replicate, Partial,
     reshard, dtensor_from_fn, dtensor_from_local, unshard_dtensor,
     get_dist_attr, DistModel, to_static, save_state_dict, load_state_dict,
-    LocalLayer, ShardDataloader, shard_dataloader,
+    ColWiseParallel, LocalLayer, RowWiseParallel, ShardDataloader,
+    parallelize, shard_dataloader,
 )
 
 import importlib as _importlib

@@ -616,3 +616,120 @@ class LocalLayer(_local_layer_base()):
         for o, (m, pl) in zip(res, self._out_attrs):
             o._dist_attr = (m, tuple(pl))
         return res[0] if len(res) == 1 else tuple(res)
+
+
+def _mp_axis(mesh):
+    return "mp" if "mp" in mesh.dim_names else mesh.dim_names[-1]
+
+
+def _require_weight(layer):
+    w = getattr(layer, "weight", None)
+    if w is None:
+        raise ValueError(f"{type(layer).__name__} has no weight to shard")
+    return w
+
+
+class ColWiseParallel:
+    """Plan marker: shard a Linear/Embedding weight column-wise on 'mp'
+    (reference: dist.ColWiseParallel)."""
+
+    def apply(self, layer, mesh):
+        axis = _mp_axis(mesh)
+        w = _require_weight(layer)
+        shard_tensor(w, mesh, [Shard(1) if n == axis else Replicate()
+                               for n in mesh.dim_names])
+        b = getattr(layer, "bias", None)
+        if b is not None:
+            shard_tensor(b, mesh, [Shard(0) if n == axis else Replicate()
+                                   for n in mesh.dim_names])
+
+
+class RowWiseParallel:
+    """Plan marker: shard a Linear weight row-wise on 'mp' (reference:
+    dist.RowWiseParallel); bias stays replicated (it adds after the
+    partial-sum reduction)."""
+
+    def apply(self, layer, mesh):
+        axis = _mp_axis(mesh)
+        w = _require_weight(layer)
+        shard_tensor(w, mesh, [Shard(0) if n == axis else Replicate()
+                               for n in mesh.dim_names])
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """reference: paddle.distributed.parallelize(model, optimizer, mesh,
+    config) — the one-call semi-auto parallel API.
+
+    Supported config keys:
+      - mp_config: {"parallelize_plan": {name_pattern: ColWiseParallel() |
+        RowWiseParallel()}} — patterns match sublayer names (fnmatch, so
+        "layers.*.fc1" works); each matched layer's weights re-shard on
+        the mesh's 'mp' axis.
+      - dp_config: {"sharding_level": 0|1|2|3} — levels 1-3 apply the
+        ZeRO-style parameter/grad/opt-state sharding via
+        group_sharded_parallel; level 0 records the data axis only (batch
+        sharding happens at the input, e.g. shard_dataloader).  Combining
+        sharding_level>0 WITH an mp plan in one call raises (the ZeRO
+        re-layout would clobber the TP placements).
+      - pp_config: NOT supported here — use GPTForCausalLMPipe /
+        pipeline_schedule (raises with that pointer).
+
+    Returns (model, optimizer).
+    """
+    import fnmatch
+
+    config = config or {}
+    if "pp_config" in config and config["pp_config"]:
+        raise NotImplementedError(
+            "pp_config: pipeline parallelism is the scan-tick engine — "
+            "wrap the model with text.models.GPTForCausalLMPipe or "
+            "fleet.meta_parallel.pipeline_schedule instead")
+    if mesh is None:
+        from .topology import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        if hcg is None:
+            raise ValueError("parallelize needs a mesh (or fleet.init first)")
+        mesh = ProcessMesh(
+            np.arange(hcg.mesh.devices.size).reshape(hcg.mesh.devices.shape),
+            list(hcg.mesh.axis_names))
+
+    mp_cfg = config.get("mp_config") or {}
+    plan = mp_cfg.get("parallelize_plan") or {}
+    if plan:
+        named = dict(model.named_sublayers())
+        for pattern, marker in plan.items():
+            hits = [n for n in named
+                    if fnmatch.fnmatch(n, pattern) or n == pattern]
+            if not hits:
+                raise ValueError(
+                    f"parallelize_plan pattern {pattern!r} matched no "
+                    f"sublayer; available: {sorted(named)[:20]}...")
+            for n in hits:
+                marker.apply(named[n], mesh)
+
+    dp_cfg = config.get("dp_config") or {}
+    level = int(dp_cfg.get("sharding_level", 0) or 0)
+    if level not in (0, 1, 2, 3):
+        raise ValueError(f"sharding_level must be 0-3, got {level}")
+    if level > 0:
+        if plan:
+            # group_sharded_parallel re-lays every parameter out over its
+            # own sharding mesh, which would silently DESTROY the TP plan
+            # applied above — refuse rather than run without model
+            # parallelism (combine TP with ZeRO via fleet hybrid_configs +
+            # meta_parallel instead)
+            raise NotImplementedError(
+                "mp_config + sharding_level>0 in one parallelize call is "
+                "not supported: the ZeRO re-sharding would overwrite the "
+                "TP placements. Use fleet hybrid_configs (mp axis) with "
+                "group_sharded_parallel, or apply only one of the two "
+                "here.")
+        if optimizer is None:
+            raise ValueError("sharding_level>0 needs the optimizer")
+        from .fleet.meta_parallel import group_sharded_parallel
+
+        level_name = {1: "os", 2: "os_g", 3: "p_g_os"}[level]
+        model, optimizer, _ = group_sharded_parallel(model, optimizer,
+                                                     level=level_name)
+    return model, optimizer

@@ -254,3 +254,69 @@ def test_local_layer_subclass_pattern_with_kwargs():
     assert len(cl._sm_cache) == 1  # retrace-free steady state
     with pytest.raises(ValueError):
         dist.LocalLayer(layer=None)(pred)
+
+
+def test_parallelize_one_call_api():
+    """dist.parallelize applies a col/row TP plan + ZeRO sharding level in
+    one call, and the parallelized model trains to parity with the
+    unsharded one."""
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(16, 32).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 8, (16,)).astype("int64"))
+    lossf = nn.CrossEntropyLoss()
+
+    def build():
+        paddle.seed(5)
+        return nn.Sequential(nn.Linear(32, 64), nn.GELU(), nn.Linear(64, 8))
+
+    ref = build()
+    o_ref = opt.AdamW(learning_rate=1e-3, parameters=ref.parameters())
+    s_ref = paddle.jit.TrainStep(ref, o_ref, loss_fn=lossf)
+    ref_losses = [float(s_ref(x, y)) for _ in range(3)]
+
+    m = build()
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    m, o = dist.parallelize(
+        m, o, mesh=mesh,
+        config={"mp_config": {"parallelize_plan": {
+            "0": dist.ColWiseParallel(), "2": dist.RowWiseParallel()}}})
+    # col-wise: weight dim 1 carries mp; row-wise: dim 0
+    assert "mp" in str(m[0].weight._value.sharding.spec)
+    assert str(m[0].weight._value.sharding.spec).index("mp") > 0
+    assert m[0].weight._value.addressable_shards[0].data.shape == (32, 16)
+    assert m[2].weight._value.addressable_shards[0].data.shape == (16, 8)
+    s_tp = paddle.jit.TrainStep(m, o, loss_fn=lossf)
+    tp_losses = [float(s_tp(x, y)) for _ in range(3)]
+    np.testing.assert_allclose(ref_losses, tp_losses, rtol=2e-4, atol=2e-5)
+
+    # sharding_level applies ZeRO through the same call
+    m2 = build()
+    o2 = opt.AdamW(learning_rate=1e-3, parameters=m2.parameters())
+    m2, o2 = dist.parallelize(m2, o2, mesh=mesh,
+                              config={"dp_config": {"sharding_level": 3}})
+    z_losses = [float(paddle.jit.TrainStep(m2, o2, loss_fn=lossf)(x, y))
+                for _ in range(3)]
+    np.testing.assert_allclose(ref_losses, z_losses, rtol=2e-4, atol=2e-5)
+
+    # bad pattern and pp_config raise loudly
+    with pytest.raises(ValueError):
+        dist.parallelize(build(), mesh=mesh, config={
+            "mp_config": {"parallelize_plan": {"nope.*": dist.ColWiseParallel()}}})
+    with pytest.raises(NotImplementedError):
+        dist.parallelize(build(), mesh=mesh,
+                         config={"pp_config": {"split_spec": "x"}})
+
+
+def test_parallelize_rejects_mp_plus_zero_combo_and_bad_level():
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.Linear(16, 4))
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    with pytest.raises(NotImplementedError):
+        dist.parallelize(m, o, mesh=mesh, config={
+            "mp_config": {"parallelize_plan": {"0": dist.ColWiseParallel()}},
+            "dp_config": {"sharding_level": 2}})
+    with pytest.raises(ValueError):
+        dist.parallelize(m, o, mesh=mesh,
+                         config={"dp_config": {"sharding_level": 4}})
