@@ -30,7 +30,13 @@ import time
 import numpy as np
 
 
-def _measure_framework_resnet(B=128, iters=15):
+def _cost_fields(compiled):
+    from benchmarks.micro import cost_fields
+
+    return cost_fields(compiled)
+
+
+def _measure_framework_resnet(B=128, iters=15, cost=False):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     import paddle_tpu.optimizer as opt
@@ -52,10 +58,17 @@ def _measure_framework_resnet(B=128, iters=15):
         loss = step(x, y)
     float(loss)  # host sync
     dt = (time.time() - t0) / iters
-    return B / dt
+    ips = B / dt
+    if not cost:
+        return ips
+    fn = next(iter(step._compiled.values()))
+    comp = fn._jitted.lower(step._diff_params, step._opt_state, step._buffers,
+                            step._frozen_params, step._lr_dev, step._rng_carry,
+                            x._value, y._value).compile()
+    return ips, _cost_fields(comp)
 
 
-def _measure_framework_bert(B=64, S=128, iters=15):
+def _measure_framework_bert(B=64, S=128, iters=15, cost=False):
     """BERT-base fine-tune through the fused TrainStep (to_static path)."""
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
@@ -78,7 +91,45 @@ def _measure_framework_bert(B=64, S=128, iters=15):
         loss = step(ids, y)
     float(loss)
     dt = (time.time() - t0) / iters
-    return B / dt
+    ips = B / dt
+    if not cost:
+        return ips
+    fn = next(iter(step._compiled.values()))
+    comp = fn._jitted.lower(step._diff_params, step._opt_state, step._buffers,
+                            step._frozen_params, step._lr_dev, step._rng_carry,
+                            ids._value, y._value).compile()
+    return ips, _cost_fields(comp)
+
+
+def _measure_decode(cache_impl, B=8, S0=32, lo=64, hi=320):
+    """Decode tokens/sec on GPT-base via generate(), dense or paged cache.
+
+    Every run pins the cache to ONE max_len (= S0 + hi), so all three calls
+    compile identical prefill/step programs and the lo/hi DELTA cancels
+    compile + prefill exactly, leaving pure per-token step time.  (Without
+    the pin, each call sized its cache to its own token count and the
+    delta was dominated by differential compile — r5 review.)  Tokens
+    pipeline on device (decode_loop syncs once at the end), so the counts
+    must be large enough that step time dominates the remaining delta."""
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import GPTForCausalLM
+
+    paddle.seed(0)
+    m = GPTForCausalLM()  # GPT-base: 12 x 768
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 50000, (B, S0)).astype("int64"))
+
+    def run(n):
+        t0 = time.time()
+        m.generate(ids, max_new_tokens=n, temperature=0.0,
+                   cache_impl=cache_impl, page_size=32, max_len=S0 + hi)
+        return time.time() - t0
+
+    run(4)  # warm: compiles the SAME prefill/step programs as lo/hi
+    t_lo, t_hi = run(lo), run(hi)
+    return B * (hi - lo) / max(t_hi - t_lo, 1e-9)
 
 
 def _mfu_fields(flops_per_sec, peak, matmul_tflops):
@@ -117,19 +168,27 @@ def _run_section(name):
                 "matmul_tflops": micro.matmul_tflops(),
                 "hbm_gbs": micro.hbm_bandwidth_gbs()}
     if name == "resnet":
-        return {"fw128": _measure_framework_resnet(128),
-                "fw256": _measure_framework_resnet(256)}
+        ips, c = _measure_framework_resnet(128, cost=True)
+        return {"fw128": ips, "fw256": _measure_framework_resnet(256),
+                "cost": c}
     if name == "resnet_raw":
         from benchmarks.raw_resnet50 import measure as measure_raw_resnet
 
-        return {"raw128": measure_raw_resnet(128),
-                "raw256": measure_raw_resnet(256)}
+        ips, c = measure_raw_resnet(128, cost=True)
+        return {"raw128": ips, "raw256": measure_raw_resnet(256),
+                "cost": c}
     if name == "bert":
-        return {"fw": _measure_framework_bert(64, 128)}
+        ips, c = _measure_framework_bert(64, 128, cost=True)
+        return {"fw": ips, "cost": c}
     if name == "bert_raw":
         from benchmarks.raw_bert import measure as measure_raw_bert
 
-        return {"raw": measure_raw_bert(64, 128)}
+        ips, c = measure_raw_bert(64, 128, cost=True)
+        return {"raw": ips, "cost": c}
+    if name == "decode_dense":
+        return {"tps": _measure_decode("dense")}
+    if name == "decode_paged":
+        return {"tps": _measure_decode("paged")}
     if name == "allreduce":
         bw, n = micro.allreduce_bus_bw()
         return {"bw": bw, "n": n}
@@ -163,13 +222,20 @@ def main():
 
     # --- BASELINE #2: BERT/ERNIE-base fine-tune ---
     BB, S = 64, 128
-    bert_fw = _section("bert")["fw"]
-    bert_raw = _section("bert_raw")["raw"]
+    _bert_sec = _section("bert")
+    _bert_raw_sec = _section("bert_raw")
+    bert_fw = _bert_sec["fw"]
+    bert_raw = _bert_raw_sec["raw"]
     bert_flops = train_flops_per_token(S) * S  # per sample
 
     # --- BASELINE #3: allreduce bus bandwidth ---
     ar = _section("allreduce")
     ar_bw, n_dev = ar["bw"], ar["n"]
+
+    # --- serving decode: dense vs paged KV cache (separate processes —
+    # device state from one measurement poisons the next, see _section) ---
+    dec = {"dense": _section("decode_dense")["tps"],
+           "paged": _section("decode_paged")["tps"]}
 
     # --- attention kernel sweep ---
     attn = _section("attention")["sweep"]
@@ -189,6 +255,13 @@ def main():
             "matmul_frac_of_peak": round(mm_tflops * 1e12 / peak, 3) if peak else None,
         },
         "resnet50_mfu": _mfu_fields(fw_ips * rn_train_flops, peak, mm_tflops),
+        # compiled-HLO step cost, framework vs raw: if fw gflops/gbytes drift
+        # above raw's, the framework step started computing more than the
+        # expert program — catch it here, not via throughput archaeology
+        "step_cost_fw_vs_raw": {"resnet_fw": rn.get("cost"),
+                                "resnet_raw": rn_raw.get("cost"),
+                                "bert_fw": _bert_sec.get("cost"),
+                                "bert_raw": _bert_raw_sec.get("cost")},
         "batch_sweep": {
             "b256_imgs_per_sec": round(fw_ips_256, 1),
             "b256_vs_baseline": round(fw_ips_256 / raw_ips_256, 3),
@@ -213,6 +286,15 @@ def main():
                      if n_dev < 2 else "psum over 1-axis mesh, ring bus-bw convention"),
         },
         "attention_pallas_vs_xla": attn,
+        "decode_gpt_base": {
+            "unit": "decode tokens/sec (B=8, greedy, compile cancelled)",
+            "dense_cache": round(dec["dense"], 1),
+            "paged_cache": round(dec["paged"], 1),
+            "paged_vs_dense": round(dec["paged"] / dec["dense"], 3),
+            "note": ("paged = Pallas scalar-prefetch kernel over page pools; "
+                     "HBM bound by ceil(T/page_size) pages, not max_len "
+                     "(tests/test_paged_attention.py parity + memory)"),
+        },
     }
     print(json.dumps(out))
 

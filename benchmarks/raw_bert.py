@@ -153,7 +153,7 @@ def train_step(p, m, v, t, ids, type_ids, y, key):
     return loss, new_p, new_m, new_v, t
 
 
-def measure(batch_size=64, seq_len=128, iters=15):
+def measure(batch_size=64, seq_len=128, iters=15, cost=False):
     """samples/sec of the raw fine-tune step (same timing as bench.py)."""
     import time
 
@@ -166,6 +166,7 @@ def measure(batch_size=64, seq_len=128, iters=15):
     typ = jnp.zeros((batch_size, seq_len), jnp.int32)
     y = jnp.asarray(rs.randint(0, 2, (batch_size,)).astype("int32"))
     key = jax.random.key(0)
+    comp = train_step.lower(p, m, v, t, ids, typ, y, key).compile() if cost else None
     loss, p, m, v, t = train_step(p, m, v, t, ids, typ, y, key)
     float(loss)
     t0 = time.time()
@@ -173,4 +174,9 @@ def measure(batch_size=64, seq_len=128, iters=15):
         loss, p, m, v, t = train_step(p, m, v, t, ids, typ, y, key)
     float(loss)
     dt = (time.time() - t0) / iters
-    return batch_size / dt
+    ips = batch_size / dt
+    if not cost:
+        return ips
+    from benchmarks.micro import cost_fields
+
+    return ips, cost_fields(comp)

@@ -26,7 +26,13 @@ from ....tensor.tensor import Tensor
 from ...topology import get_hybrid_communicate_group
 
 
-def _axis_mesh(axis=None):
+def _axis_mesh(axis=None, mesh=None):
+    if mesh is not None:
+        names = mesh.axis_names
+        for cand in ([axis] if axis else []) + ["sharding", "dp"]:
+            if cand in names and mesh.shape[cand] > 1:
+                return mesh, cand
+        raise ValueError(f"mesh {names} has no sharding/dp axis > 1")
     hcg = get_hybrid_communicate_group()
     if hcg is not None:
         names = hcg.mesh.axis_names
@@ -40,20 +46,36 @@ def _axis_mesh(axis=None):
 
 
 def _shard_spec_for(v, axis_name, n):
-    """Shard the largest dim divisible by n; replicate when none fits."""
-    dims = sorted(range(v.ndim), key=lambda d: -v.shape[d])
-    for d in dims:
+    """Shard the largest dim divisible by n; replicate when none fits.
+
+    COMPOSES with an existing NamedSharding (r4 weak #7: TP+ZeRO): dims a
+    tensor-parallel plan already shards stay sharded; the ZeRO axis takes
+    the largest still-replicated dim.  E.g. a ColWise [K, out] weight
+    sharded P(None, 'mp') becomes P('dp', 'mp') under stage 3."""
+    entries = [None] * v.ndim
+    sh = getattr(v, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        spec = list(sh.spec) + [None] * (v.ndim - len(sh.spec))
+        entries = spec[:v.ndim]
+    used = set()
+    for e in entries:
+        for a in (e if isinstance(e, tuple) else (e,)):
+            if a is not None:
+                used.add(a)
+    if axis_name in used:  # already sharded over this axis (idempotent)
+        return P(*entries)
+    free = [d for d in range(v.ndim) if entries[d] is None]
+    for d in sorted(free, key=lambda d: -v.shape[d]):
         if v.shape[d] % n == 0 and v.shape[d] >= n:
-            entries = [None] * v.ndim
             entries[d] = axis_name
             return P(*entries)
-    return P()
+    return P(*entries) if any(e is not None for e in entries) else P()
 
 
-def shard_optimizer_states(train_step, axis=None):
+def shard_optimizer_states(train_step, axis=None, mesh=None):
     """ZeRO-1: lay the fused TrainStep's optimizer-state arrays out over the
     sharding axis.  Donation keeps the layout across steps."""
-    mesh, ax = _axis_mesh(axis)
+    mesh, ax = _axis_mesh(axis, mesh)
     n = mesh.shape[ax]
 
     def put(v):
@@ -65,9 +87,9 @@ def shard_optimizer_states(train_step, axis=None):
     return train_step
 
 
-def shard_parameters(model, axis=None):
+def shard_parameters(model, axis=None, mesh=None):
     """ZeRO-3: shard each parameter itself; XLA all-gathers per use site."""
-    mesh, ax = _axis_mesh(axis)
+    mesh, ax = _axis_mesh(axis, mesh)
     n = mesh.shape[ax]
     for p in model.parameters():
         spec = _shard_spec_for(p._value, ax, n)
@@ -80,12 +102,17 @@ def shard_parameters(model, axis=None):
 def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=None,
                            offload=False, sync_buffers=False, buffer_max_size=None,
                            segment_size=None, sync_comm=False, dp_group=None,
-                           exclude_layer=None):
+                           exclude_layer=None, mesh=None, axis=None):
     """reference: paddle.distributed.sharding.group_sharded_parallel.
 
     level: 'os' (stage1: optimizer states), 'os_g' (stage2: + grads via
     reduce-scatter — implied by state shardings under XLA), 'p_g_os'
     (stage3: + params).  Returns (model, optimizer, scaler).
+
+    mesh/axis (extension): shard over that axis of an explicit hybrid mesh
+    instead of the fleet topology — how ``dist.parallelize`` composes ZeRO
+    with a tensor-parallel plan (existing TP placements are preserved, see
+    ``_shard_spec_for``).
     """
     if offload:
         import warnings
@@ -95,9 +122,11 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=No
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError(f"bad group_sharded level {level!r}")
     if level == "p_g_os":
-        shard_parameters(model)
+        shard_parameters(model, axis=axis, mesh=mesh)
     # stage-1/2 state sharding happens lazily: the optimizer's functional
     # state doesn't exist until a TrainStep is built, so mark the optimizer
     # and let TrainStep consult it (or the user calls shard_optimizer_states).
-    optimizer._sharded_states_axis = "sharding"
+    optimizer._sharded_states_axis = axis or "sharding"
+    if mesh is not None:
+        optimizer._sharded_states_mesh = mesh
     return model, optimizer, scaler

@@ -20,12 +20,27 @@ TPU-native design:
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 
 import jax
 
+# PRNG implementation: 'rbg' by default — it lowers to the XLA
+# RngBitGenerator op, which TPUs execute natively.  Measured on the r5
+# BERT-base train step (B=64, S=128, bf16 O2, TPU v5e): with the default
+# threefry2x32 impl, dropout-mask generation alone was ~40% of device time
+# (counter-based threefry is 13 rounds of VPU bit-ops per element, and XLA
+# materialized the masks in standalone kLoop fusions); switching the key
+# impl to 'rbg' took the fused step from 852 to 1108 samples/s — from
+# 0.93x to 1.16x the hand-written raw-JAX baseline.  Reference parity:
+# paddle guarantees seeded determinism, not a specific bit stream, and rbg
+# keys are deterministic for a given seed.  Override with
+# PADDLE_TPU_PRNG_IMPL=threefry2x32 if bit-identical masks across
+# non-TPU backends matter more than speed.
+_IMPL = os.environ.get("PADDLE_TPU_PRNG_IMPL", "rbg")
+
 _lock = threading.Lock()
-_global_key = jax.random.key(0)
+_global_key = jax.random.key(0, impl=_IMPL)
 _seed_value = 0
 
 _scope = threading.local()
@@ -36,7 +51,7 @@ def seed(s: int):
     global _global_key, _seed_value
     with _lock:
         _seed_value = int(s)
-        _global_key = jax.random.key(int(s))
+        _global_key = jax.random.key(int(s), impl=_IMPL)
 
 
 def get_seed() -> int:

@@ -20,31 +20,58 @@ import numpy as np
 _EXCLUDED: set[int] = set()  # id(Layer) excluded from pruning
 
 
+def _reduction_groups(shape, m):
+    """How this weight groups along its REDUCTION axis, or None.
+
+    Linear weights are [K, out] (paddle layout): K is axis 0.  Conv weights
+    are [Co, Ci, kh, kw]: the reduction dim is the flattened Ci*kh*kw TAIL —
+    axis 0 is the OUTPUT channel, and grouping along it would not be
+    n:m-along-K (ADVICE r4: the old code always grouped axis 0, breaking the
+    documented sparse-hardware export convention for convs)."""
+    if len(shape) < 2:
+        return None
+    if len(shape) == 2:
+        return ("axis0", shape[0]) if shape[0] % m == 0 else None
+    k = int(np.prod(shape[1:]))
+    return ("tail", k) if k % m == 0 else None
+
+
 def calculate_mask(w, n=2, m=4):
     """n:m mask over groups of ``m`` along the reduction axis (axis 0 for
-    [in, out] linear weights; flattened tail for conv)."""
+    [in, out] linear weights; flattened Ci*kh*kw tail for conv)."""
     arr = jnp.asarray(w if not hasattr(w, "_value") else w._value)
-    if arr.ndim < 2 or arr.shape[0] % m:
+    grouping = _reduction_groups(arr.shape, m)
+    if grouping is None:
         return None
-    # bring axis 0 (K) last, group into m
-    moved = jnp.moveaxis(arr, 0, -1)
-    lead = moved.shape[:-1]
-    grp = moved.reshape(*lead, arr.shape[0] // m, m)
+    kind, k = grouping
+    if kind == "axis0":
+        flat = jnp.moveaxis(arr, 0, -1)  # [out, K]
+    else:
+        flat = arr.reshape(arr.shape[0], k)  # [Co, Ci*kh*kw]
+    lead = flat.shape[:-1]
+    grp = flat.reshape(*lead, k // m, m)
     # rank positions by |w| within each group; keep the top n
     order = jnp.argsort(jnp.abs(grp), axis=-1)  # ascending
     ranks = jnp.argsort(order, axis=-1)
-    mask = (ranks >= m - n).astype(arr.dtype)
-    mask = mask.reshape(*lead, arr.shape[0])
-    return jnp.moveaxis(mask, -1, 0)
+    mask = (ranks >= m - n).astype(arr.dtype).reshape(*lead, k)
+    if kind == "axis0":
+        return jnp.moveaxis(mask, -1, 0)
+    return mask.reshape(arr.shape)
 
 
 def check_sparsity(w, n=2, m=4):
     """True iff every m-group along the reduction axis has <= n nonzeros."""
-    arr = np.asarray(w if not hasattr(w, "_value") else w.numpy())
-    if arr.ndim < 2 or arr.shape[0] % m:
+    # paddle Tensors expose .numpy(); raw jax arrays ALSO have a private
+    # ``_value`` (their numpy view), so dispatch on the method, not on it
+    arr = np.asarray(w.numpy() if hasattr(w, "numpy") and hasattr(w, "_value")
+                     else w)
+    grouping = _reduction_groups(arr.shape, m)
+    if grouping is None:
         return False
-    k = np.moveaxis(arr, 0, -1)
-    g = k.reshape(*k.shape[:-1], arr.shape[0] // m, m)
+    kind, k = grouping
+    flat = np.moveaxis(arr, 0, -1) if kind == "axis0" \
+        else arr.reshape(arr.shape[0], k)
+    g = flat.reshape(*flat.shape[:-1], k // m, m)
     return bool(((g != 0).sum(-1) <= n).all())
 
 

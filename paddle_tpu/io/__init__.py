@@ -396,13 +396,26 @@ class DataLoader:
                     break
             reorder = {}
             timeout = self.timeout or None
+            # Bounded waits even with timeout=0 (blocking): a worker killed
+            # without enqueuing (SIGKILL/OOM) must surface as an error, not a
+            # forever-hang on result_q.get (ADVICE r4).  Poll in 1s slices
+            # and check liveness between slices.
             while recvd < sent:
+                waited = 0.0
                 while recvd not in reorder:
                     try:
-                        bidx, data, err = result_q.get(timeout=timeout)
+                        bidx, data, err = result_q.get(timeout=1.0)
                     except _q.Empty:
-                        raise RuntimeError(
-                            f"DataLoader worker timed out after {timeout}s")
+                        dead = [w for w, p in enumerate(procs) if not p.is_alive()]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker(s) {dead} died without "
+                                "returning a result (killed/OOM?)")
+                        waited += 1.0
+                        if timeout is not None and waited >= timeout:
+                            raise RuntimeError(
+                                f"DataLoader worker timed out after {timeout}s")
+                        continue
                     if err is not None:
                         raise err
                     reorder[bidx] = data

@@ -113,7 +113,9 @@ class TrainStep:
         if getattr(optimizer, "_sharded_states_axis", None):
             from ..distributed.fleet.meta_parallel.sharding import shard_optimizer_states
 
-            shard_optimizer_states(self, optimizer._sharded_states_axis)
+            shard_optimizer_states(self, optimizer._sharded_states_axis,
+                                   mesh=getattr(optimizer,
+                                                "_sharded_states_mesh", None))
 
     # ------------------------------------------------------------------ call
     def __call__(self, *batch):
@@ -135,6 +137,10 @@ class TrainStep:
         if fn is None:
             fn = self._build(treedef, bool(self.model.training))
             self._compiled[avals] = fn
+        # avals only, for dist_main_program re-lowering: holding the real
+        # arrays would pin a full batch of HBM for the TrainStep's lifetime
+        self._last_batch_vals = [jax.ShapeDtypeStruct(v.shape, v.dtype)
+                                 for v in vals]
         if self._scaler_state is not None:
             out = fn(self._diff_params, self._opt_state, self._buffers,
                      self._frozen_params, self._lr_dev, self._rng_carry,

@@ -57,39 +57,25 @@ class MultiHeadAttention(Layer):
         return self.Cache(k, v)
 
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
-        is_self = key is None and value is None
+        # NOTE(r5): an earlier revision fused q/k/v into one [E,3E] matmul by
+        # concatenating the three weights inside the traced step.  Measured on
+        # TPU v5e (BERT-base train step, B=64 S=128, rbg PRNG): the fused
+        # spelling is ~6% SLOWER than three separate dots — the params change
+        # every step so XLA cannot hoist the concat, and the per-step [E,3E]
+        # write plus the qkv re-slice outweigh the larger GEMM.  Separate
+        # projections are the right shape for the MXU here; keep them.
         key = query if key is None else key
         value = key if value is None else value
-        if is_self and cache is None and self.q_proj.bias is not None:
-            # fused qkv for self-attention: ONE [E, 3E] matmul instead of
-            # three [E, E] — the MXU sees a 3x bigger GEMM (the concat of
-            # the weight views is hoisted/fused by XLA; measured ~3% on the
-            # BERT-base train step).  Numerics identical to the split path.
-            from ...tensor.dispatch import apply as _apply
-
-            def fused(x, wq, wk, wv, bq, bk, bv):
-                w = jnp.concatenate([wq, wk, wv], axis=1)
-                b = jnp.concatenate([bq, bk, bv], axis=0)
-                return x @ w + b
-
-            qkv = _apply(fused, query, self.q_proj.weight, self.k_proj.weight,
-                         self.v_proj.weight, self.q_proj.bias,
-                         self.k_proj.bias, self.v_proj.bias,
-                         op_name="fused_qkv")
-            bsz, slen = qkv.shape[0], qkv.shape[1]
-            qkv = qkv.reshape([bsz, slen, 3, self.num_heads, self.head_dim])
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = self._shape(self.q_proj(query))
+        if isinstance(cache, self.StaticCache):
+            k, v = cache.k, cache.v
         else:
-            q = self._shape(self.q_proj(query))
-            if isinstance(cache, self.StaticCache):
-                k, v = cache.k, cache.v
-            else:
-                k = self._shape(self.k_proj(key))
-                v = self._shape(self.v_proj(value))
-                if isinstance(cache, self.Cache):
-                    k = M.concat([cache.k, k], axis=1)
-                    v = M.concat([cache.v, v], axis=1)
-                    cache = self.Cache(k, v)
+            k = self._shape(self.k_proj(key))
+            v = self._shape(self.v_proj(value))
+            if isinstance(cache, self.Cache):
+                k = M.concat([cache.k, k], axis=1)
+                v = M.concat([cache.v, v], axis=1)
+                cache = self.Cache(k, v)
 
         if self.need_weights:
             out, weights = self._attn_with_weights(q, k, v, attn_mask)

@@ -32,7 +32,7 @@ NEG_INF = -1e30
 
 
 def _paged_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, page_size, scale):
+                  m_scr, l_scr, acc_scr, *, page_size, scale, num_kv_heads):
     from jax.experimental import pallas as pl
 
     b = pl.program_id(0)
@@ -40,36 +40,51 @@ def _paged_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(i == 0)
     def _init():
-        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        m_scr[...] = jnp.full_like(m_scr, jnp.float32(NEG_INF))
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     seq_len = lens_ref[b]
+    num_q = q_ref.shape[1]
+    g = num_q // num_kv_heads  # query heads per kv head (GQA group; MHA=1)
 
     @pl.when(i * page_size < seq_len)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)                  # [H, D]
-        k = k_ref[0].astype(jnp.float32)                  # [page, H, D]
-        v = v_ref[0].astype(jnp.float32)
-        # scores [H, page]: contract D, batch H
-        s = jax.lax.dot_general(
-            q, k, (((1,), (2,)), ((0,), (1,)))) * scale
+        # Mosaic discipline (mirrors ops/flash_attention.py, which compiles
+        # on this backend): strictly 2-D tiles, keepdims reductions, f32
+        # constants, plain-contracting dot_generals only (the H-batched
+        # spelling fails to parse here — r5).  KV heads run as a STATIC
+        # unrolled loop; each page streams HBM->VMEM ONCE and serves all g
+        # grouped query heads via two small MXU dots — GQA's bandwidth
+        # saving holds inside the kernel (no repeated-KV reads).
         pos = i * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1)
-        s = jnp.where(pos < seq_len, s, NEG_INF)
-        m_new = jnp.maximum(m_scr[...], s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_scr[...] - m_new)
-        l_scr[...] = l_scr[...] * alpha + p.sum(axis=-1)
-        pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((0,), (1,))))          # [H, D]
-        acc_scr[...] = acc_scr[...] * alpha[:, None] + pv
-        m_scr[...] = m_new
+        valid = pos < seq_len                              # [1, page]
+        for j in range(num_kv_heads):
+            r = slice(j * g, (j + 1) * g)
+            q = q_ref[0, r, :].astype(jnp.float32)         # [g, D]
+            k = k_ref[0, :, j, :].astype(jnp.float32)      # [page, D]
+            v = v_ref[0, :, j, :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * jnp.float32(scale)
+            s = jnp.where(valid, s, jnp.float32(NEG_INF))  # [g, page]
+            m_prev = m_scr[r, :]                           # [g, 1]
+            m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)                         # [g, page]
+            alpha = jnp.exp(m_prev - m_new)                # [g, 1]
+            l_scr[r, :] = l_scr[r, :] * alpha + p.sum(axis=1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [g, D]
+            acc_scr[r, :] = acc_scr[r, :] * alpha + pv
+            m_scr[r, :] = m_new
 
     @pl.when(i == pl.num_programs(1) - 1)
     def _fin():
-        o_ref[0] = (acc_scr[...]
-                    / jnp.maximum(l_scr[...], 1e-30)[:, None]).astype(o_ref.dtype)
+        # output stays f32 — the f32->bf16 truncf fails to legalize in this
+        # Mosaic backend; the public entry downcasts outside the kernel
+        o_ref[0] = acc_scr[...] / jnp.maximum(l_scr[...], jnp.float32(1e-30))
 
 
 def _paged_pallas(q, k_pages, v_pages, page_table, seq_lens, scale,
@@ -78,6 +93,7 @@ def _paged_pallas(q, k_pages, v_pages, page_table, seq_lens, scale,
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, D = q.shape
+    HKV = k_pages.shape[2]
     page_size = k_pages.shape[1]
     NP = page_table.shape[1]
 
@@ -86,36 +102,52 @@ def _paged_pallas(q, k_pages, v_pages, page_table, seq_lens, scale,
         grid=(B, NP),
         in_specs=[
             pl.BlockSpec((1, H, D), lambda b, i, pt, ln: (b, 0, 0)),
-            pl.BlockSpec((1, page_size, H, D),
+            pl.BlockSpec((1, page_size, HKV, D),
                          lambda b, i, pt, ln: (pt[b, i], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, H, D),
+            pl.BlockSpec((1, page_size, HKV, D),
                          lambda b, i, pt, ln: (pt[b, i], 0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda b, i, pt, ln: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((H,), jnp.float32),
-            pltpu.VMEM((H,), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
             pltpu.VMEM((H, D), jnp.float32),
         ],
     )
-    return pl.pallas_call(
-        functools.partial(_paged_kernel, page_size=page_size, scale=scale),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        interpret=interpret,
-    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
-      q, k_pages, v_pages)
+    # x64 OFF around the call: the framework enables jax_enable_x64 globally
+    # (paddle int64 tensor parity), and under it the scalar-prefetch grid
+    # machinery emits i64 index arithmetic that this Mosaic backend cannot
+    # legalize (r5: compile failed from inside paddle_tpu but succeeded in a
+    # bare-jax process; bisected to exactly this flag).  Every dtype in the
+    # kernel is pinned, so x32 promotion rules change nothing numerically.
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_paged_kernel, page_size=page_size, scale=scale,
+                              num_kv_heads=HKV),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(q.shape, jnp.float32),
+            interpret=interpret,
+        )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+          q, k_pages, v_pages)
+    return out.astype(q.dtype)
 
 
 def paged_attention_ref(q, k_pages, v_pages, page_table, seq_lens,
                         scale=None):
-    """Dense-gather reference with identical semantics (oracle + fallback)."""
+    """Dense-gather reference with identical semantics (oracle + fallback).
+
+    GQA: q may carry g*HKV heads against HKV-head pools (q head h attends
+    kv head h//g, matching jnp.repeat(kv, g, axis=heads))."""
     B, H, D = q.shape
+    HKV = k_pages.shape[2]
     page_size = k_pages.shape[1]
     NP = page_table.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
-    k = k_pages[page_table].reshape(B, NP * page_size, H, D)
-    v = v_pages[page_table].reshape(B, NP * page_size, H, D)
+    k = k_pages[page_table].reshape(B, NP * page_size, HKV, D)
+    v = v_pages[page_table].reshape(B, NP * page_size, HKV, D)
+    if HKV != H:
+        k = jnp.repeat(k, H // HKV, axis=2)
+        v = jnp.repeat(v, H // HKV, axis=2)
     s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     pos = jnp.arange(NP * page_size)[None, None, :]
@@ -132,8 +164,13 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
     Uses the Pallas scalar-prefetch kernel on TPU; dense reference
     elsewhere.  All rows of ``page_table`` must index valid pages (pad rows
     with any in-range id — padded pages are masked by ``seq_lens``).
+    GQA: q with g*HKV heads against HKV-head pools is grouped inside the
+    kernel — each page streams once for all g query heads.
     """
     B, H, D = q.shape
+    if H % k_pages.shape[2]:
+        raise ValueError(f"q heads {H} not a multiple of kv heads "
+                         f"{k_pages.shape[2]}")
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     if interpret is None:
         if jax.default_backend() != "tpu":
@@ -142,6 +179,58 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
         interpret = False
     return _paged_pallas(q, k_pages, v_pages, page_table, seq_lens, scale,
                          interpret)
+
+
+# --------------------------------------------------------- decode-loop utils
+# Pure-jax helpers for the generate() paged path (one pool per layer, pages
+# laid out per sequence: row b*PP+i is page i of sequence b).  All shapes
+# static; `pos` may be traced, so decode writes use dynamic_update_slice.
+
+
+def paged_prefill_write(pages, kv):
+    """Write a whole prompt's K or V into the page pool at position 0.
+
+    pages: [B, PP, ps, h, d]; kv: [B, S, h, d] -> updated pages.  Static: S
+    is a trace-time constant, so this is a reshape + slice-assign, no
+    scatter."""
+    B, S, h, d = kv.shape
+    ps = pages.shape[2]
+    pad = (ps - S % ps) % ps
+    if pad:
+        kv = jnp.pad(kv, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    chunks = kv.reshape(B, -1, ps, h, d)
+    return pages.at[:, :chunks.shape[1]].set(chunks.astype(pages.dtype))
+
+
+def paged_token_write(pages, tok, pos):
+    """Write one token per sequence at (traced) position ``pos``.
+
+    pages: [B, PP, ps, h, d]; tok: [B, h, d]; pos: scalar int32."""
+    ps = pages.shape[2]
+    page_idx = (pos // ps).astype(jnp.int32)
+    slot = (pos % ps).astype(jnp.int32)
+    zero = jnp.zeros((), jnp.int32)
+    return jax.lax.dynamic_update_slice(
+        pages, tok[:, None, None].astype(pages.dtype),
+        (zero, page_idx, slot, zero, zero))
+
+
+def paged_decode_attend(q, k_pages, v_pages, pos, scale=None):
+    """One decode step of attention over per-seq paged K/V.
+
+    q: [B, hq, d]; k_pages/v_pages: [B, PP, ps, hkv, d]; pos: traced scalar
+    (tokens 0..pos are valid).  GQA (hq = g*hkv) is grouped INSIDE the
+    kernel — every page streams HBM->VMEM once for all g query heads, so
+    the cache bandwidth saving GQA exists for survives the kernel.  NOTE:
+    q head h must map to kv head h//g (jnp.repeat convention — what the
+    dense paths in gpt.py/llama.py use)."""
+    B, PP, ps, hkv, d = k_pages.shape
+    pool_k = k_pages.reshape(B * PP, ps, hkv, d)
+    pool_v = v_pages.reshape(B * PP, ps, hkv, d)
+    table = (jnp.arange(B, dtype=jnp.int32)[:, None] * PP
+             + jnp.arange(PP, dtype=jnp.int32)[None, :])
+    lens = jnp.full((B,), pos + 1, jnp.int32)
+    return paged_attention(q, pool_k, pool_v, table, lens, scale)
 
 
 class PagedKVCache:
@@ -158,6 +247,7 @@ class PagedKVCache:
     def __init__(self, num_seqs, max_pages_per_seq, page_size, num_heads,
                  head_dim, dtype=jnp.bfloat16):
         self.page_size = page_size
+        self.capacity = max_pages_per_seq * page_size
         total = num_seqs * max_pages_per_seq
         self.k_pages = jnp.zeros((total, page_size, num_heads, head_dim), dtype)
         self.v_pages = jnp.zeros_like(self.k_pages)
@@ -168,7 +258,23 @@ class PagedKVCache:
 
     def append(self, k_tok, v_tok):
         """Write one token's K/V per sequence ([B, H, D]) at each seq's
-        current length; returns self (rebound arrays)."""
+        current length; returns self (rebound arrays).
+
+        Raises when any sequence is already at capacity (eager path; under
+        jit the lengths are traced, so the guard is best-effort — JAX index
+        clamping would otherwise silently overwrite the LAST page, ADVICE
+        r4).  Size ``max_pages_per_seq`` for the longest decode up front,
+        exactly like the dense cache's max_len.
+        """
+        import jax.core as _core
+
+        if not isinstance(self.seq_lens, _core.Tracer):
+            full = int(jnp.max(self.seq_lens))
+            if full >= self.capacity:
+                raise RuntimeError(
+                    f"PagedKVCache overflow: a sequence is at capacity "
+                    f"{self.capacity} tokens ({self.capacity // self.page_size}"
+                    " pages); grow max_pages_per_seq")
         B = k_tok.shape[0]
         page_idx = self.seq_lens // self.page_size
         offset = self.seq_lens % self.page_size

@@ -38,14 +38,14 @@ def make_sampler(temperature, top_k, top_p):
     return sample
 
 
-def jitted_decode(model, fwd, ids0, max_new_tokens, cache_shape, cache_dtype,
-                  temperature=1.0, top_k=0, top_p=1.0, seed=None):
-    """Run prefill + per-token decode; returns the full id matrix.
+def decode_loop(model, fwd, ids0, max_new_tokens, init_cache,
+                temperature=1.0, top_k=0, top_p=1.0, seed=None):
+    """Generic prefill + per-token decode over an arbitrary cache PYTREE.
 
-    model: Layer (eval'd recursively for the duration).
-    fwd: closure as in the module docstring.
-    ids0: np.int64 [B, S0] prompt.
-    cache_shape: [L, B, T, h, d] for the zero-initialized K/V buffers.
+    fwd(params, bufs, ids, cache, pos) -> (last-token logits f32, cache).
+    The cache (dense [L,B,T,h,d] buffers, paged pools, anything jax) is
+    DONATED into each compiled step, so decode state updates in-place in
+    HBM.  Returns the full id matrix.
     """
     import numpy as np
 
@@ -57,33 +57,60 @@ def jitted_decode(model, fwd, ids0, max_new_tokens, cache_shape, cache_dtype,
     sample = make_sampler(temperature, top_k, top_p)
 
     @jax.jit
-    def prefill(params, bufs, ids, ks, vs, key):
-        logits, ks, vs = fwd(params, bufs, ids, ks, vs, jnp.int32(0))
-        return sample(logits, key), ks, vs
+    def prefill(params, bufs, ids, cache, key):
+        logits, cache = fwd(params, bufs, ids, cache, jnp.int32(0))
+        return sample(logits, key), cache
 
-    @functools.partial(jax.jit, donate_argnums=(3, 4))
-    def step(params, bufs, last, ks, vs, pos, key):
-        logits, ks, vs = fwd(params, bufs, last, ks, vs, pos)
-        return sample(logits, key), ks, vs
+    @functools.partial(jax.jit, donate_argnums=(3,))
+    def step(params, bufs, last, cache, pos, key):
+        logits, cache = fwd(params, bufs, last, cache, pos)
+        return sample(logits, key), cache
 
     try:
-        ks = jnp.zeros(tuple(cache_shape), cache_dtype)
-        vs = jnp.zeros_like(ks)
+        cache = init_cache()
         base = jax.random.key(seed if seed is not None else 0)
-        nxt, ks, vs = prefill(params, bufs, jnp.asarray(ids0), ks, vs,
-                              jax.random.fold_in(base, 0))
-        out = [np.asarray(nxt)[:, None]]
+        nxt, cache = prefill(params, bufs, jnp.asarray(ids0), cache,
+                             jax.random.fold_in(base, 0))
+        # tokens stay ON DEVICE across the loop: async dispatch queues every
+        # step without a host round-trip (through a tunneled TPU, a per-token
+        # np.asarray sync made RTT — not step time — the decode bottleneck),
+        # and ONE transfer at the end collects the whole id matrix.
+        out = [nxt[:, None]]
         for t in range(1, max_new_tokens):
-            nxt, ks, vs = step(params, bufs,
-                               jnp.asarray(nxt)[:, None].astype(jnp.int64),
-                               ks, vs, jnp.int32(S0 + t - 1),
-                               jax.random.fold_in(base, t))
-            out.append(np.asarray(nxt)[:, None])
+            nxt, cache = step(params, bufs, nxt[:, None].astype(jnp.int64),
+                              cache, jnp.int32(S0 + t - 1),
+                              jax.random.fold_in(base, t))
+            out.append(nxt[:, None])
+        new = np.asarray(jnp.concatenate(out, axis=1))
     finally:
         for m, tr in modes:
             m.training = tr
-    new = np.concatenate(out, axis=1)
     return Tensor(jnp.asarray(np.concatenate([ids0, new], axis=1)))
+
+
+def jitted_decode(model, fwd, ids0, max_new_tokens, cache_shape, cache_dtype,
+                  temperature=1.0, top_k=0, top_p=1.0, seed=None):
+    """Dense-cache decode (the original API): zero-initialized K/V buffers
+    [L, B, T, h, d]; fwd takes (params, bufs, ids, ks, vs, pos)."""
+
+    def fwd_cache(params, bufs, ids, cache, pos):
+        ks, vs = cache
+        logits, ks, vs = fwd(params, bufs, ids, ks, vs, pos)
+        return logits, (ks, vs)
+
+    def init_cache():
+        ks = jnp.zeros(tuple(cache_shape), cache_dtype)
+        return ks, jnp.zeros_like(ks)
+
+    return decode_loop(model, fwd_cache, ids0, max_new_tokens, init_cache,
+                       temperature=temperature, top_k=top_k, top_p=top_p,
+                       seed=seed)
+
+
+def paged_pool_shape(batch, max_len, num_kv_heads, head_dim, page_size=16):
+    """[B, PP, ps, h, d] pool shape covering max_len tokens."""
+    pp = -(-max_len // page_size)
+    return (batch, pp, page_size, num_kv_heads, head_dim)
 
 
 def beam_search(model, input_ids, max_new_tokens, num_beams=4,
@@ -126,6 +153,16 @@ def beam_search(model, input_ids, max_new_tokens, num_beams=4,
         return index_select(out, pos - 1, axis=1)[:, 0]  # [N, V]
 
     _fallback = [False]  # model does host logic / can't trace -> eager path
+    # ONLY trace-incompatibility flips to the eager path (r4 weak #5: a bare
+    # `except Exception` turned shape bugs in user models into a silent 100x
+    # slower decode).  Real model errors propagate; the fallback itself is
+    # announced with a warning.
+    _TRACE_ERRS = (jax.errors.ConcretizationTypeError,
+                   jax.errors.TracerArrayConversionError,
+                   jax.errors.TracerBoolConversionError,
+                   jax.errors.TracerIntegerConversionError,
+                   jax.errors.UnexpectedTracerError,
+                   NotImplementedError)
 
     def last_logits(arr, cur_len):
         if not _fallback[0]:
@@ -137,7 +174,14 @@ def beam_search(model, input_ids, max_new_tokens, num_beams=4,
                 out = _score(Tensor(jnp.asarray(padded)), pos)
                 # only [N, V] crosses to host, not [N, S, V]
                 return np.asarray(out._value).astype(np.float64)
-            except Exception:
+            except _TRACE_ERRS as e:
+                import warnings
+
+                warnings.warn(
+                    "beam_search: model is not jax-traceable "
+                    f"({type(e).__name__}); falling back to the EAGER "
+                    "per-step decode path, which is much slower",
+                    RuntimeWarning, stacklevel=2)
                 _fallback[0] = True
         out = model(Tensor(jnp.asarray(arr[:, :cur_len])))
         return np.asarray(out._value[:, -1]).astype(np.float64)

@@ -96,6 +96,39 @@ class GPTDecoderLayer(Layer):
         heads_here = qkv.shape[-1] // (3 * self.head_dim)
         qkv = qkv.reshape([B, S, heads_here, 3, self.head_dim])
         q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        if cache is not None and len(cache) == 4 and cache[0] == "paged":
+            # PAGED cache (serving decode): per-layer page pools
+            # [B, PP, ps, h, d] — HBM bound by pages allocated, not a dense
+            # [B, max_len] rectangle.  Prefill attends densely (flash/sdpa
+            # over the prompt) and writes the prompt's K/V into pages;
+            # each decode step writes one token and runs the Pallas
+            # scalar-prefetch paged-attention kernel (ops/paged_attention).
+            from ...ops.paged_attention import (paged_decode_attend,
+                                                paged_prefill_write,
+                                                paged_token_write)
+
+            _, kp, vp, pos = cache
+            if S > 1:  # prefill
+                attn = F.scaled_dot_product_attention(
+                    q, k, v, is_causal=True, dropout_p=0.0, training=False)
+                kp = _apply(paged_prefill_write, kp, k, op_name="paged_write")
+                vp = _apply(paged_prefill_write, vp, v, op_name="paged_write")
+            else:
+                kp = _apply(lambda pgs, kk, p: paged_token_write(pgs, kk[:, 0], p),
+                            kp, k, pos, op_name="paged_write")
+                vp = _apply(lambda pgs, vv, p: paged_token_write(pgs, vv[:, 0], p),
+                            vp, v, pos, op_name="paged_write")
+                attn = _apply(
+                    lambda qq, kps, vps, p:
+                        paged_decode_attend(qq[:, 0], kps, vps, p)[:, None],
+                    q, kp, vp, pos, op_name="paged_attention")
+            attn = attn.reshape([B, S, heads_here * self.head_dim])
+            x = residual + self.dropout(self.out_proj(attn))
+            residual = x
+            h = self.ln2(x)
+            h = self.ffn2(self.act(self.ffn1(h)))
+            x = residual + self.dropout(h)
+            return x, ("paged", kp, vp, pos)
         if cache is not None and len(cache) == 3:
             # STATIC cache (jitted decode): fixed [B, T, h, d] buffers written
             # in place at ``pos`` — shapes never change, so every decode step
@@ -211,7 +244,8 @@ class GPTForCausalLM(Layer):
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
                  top_p=1.0, seed=None, use_cache=True,
                  decode_strategy="sampling", num_beams=4, length_penalty=0.0,
-                 eos_token_id=None):
+                 eos_token_id=None, cache_impl="dense", page_size=16,
+                 max_len=None):
         """Autoregressive generation.
 
         ``use_cache=True`` (default): jitted two-phase decode via the shared
@@ -221,7 +255,14 @@ class GPTForCausalLM(Layer):
         new token.  Greedy (temperature=0) output is identical to the eager
         loop; sampling supports temperature/top-k/top-p via jax PRNG.
         ``use_cache=False``: the eager full-prefix loop (reference parity /
-        debug path)."""
+        debug path).
+
+        ``cache_impl="paged"``: block-paged KV cache — per-layer page pools
+        instead of dense [B, T] rectangles, decode attention through the
+        Pallas scalar-prefetch paged kernel (ops/paged_attention).  Same
+        tokens as the dense path (tests/test_paged_attention.py); KV HBM is
+        bounded by pages allocated (ceil(T/page_size) per sequence), the
+        serving property the reference's paged engine exists for."""
         if decode_strategy == "beam_search":
             from ._decode import beam_search
 
@@ -243,17 +284,53 @@ class GPTForCausalLM(Layer):
 
         ids0 = np.asarray(input_ids.numpy()).astype("int64")
         B, S0 = ids0.shape
-        T = S0 + max_new_tokens
+        # max_len pre-sizes the KV cache/page pool independently of this
+        # call's max_new_tokens (serving: one compiled step serves requests
+        # of any length up to it; bench: pins compiled shapes across runs)
+        T = max(S0 + max_new_tokens, max_len or 0)
         max_pos = self.gpt.position_embeddings.weight.shape[0]
         if T > max_pos:
             raise ValueError(
                 f"generate: prompt {S0} + max_new_tokens {max_new_tokens} "
-                f"exceeds max_position_embeddings {max_pos}")
+                f"(cache {T}) exceeds max_position_embeddings {max_pos}")
         gpt = self.gpt
         L = len(gpt.layers)
         blk = gpt.layers[0]
         h_heads = blk.qkv.weight.shape[-1] // (3 * blk.head_dim)
         dt = gpt.word_embeddings.weight._value.dtype
+
+        if cache_impl == "paged":
+            from ._decode import decode_loop, paged_pool_shape
+
+            pool = paged_pool_shape(B, T, h_heads, blk.head_dim, page_size)
+
+            def fwd_paged(params, bufs, ids, cache, pos):
+                kps, vps = cache
+                with no_grad_ctx(), _rng.rng_scope(jax.random.key(0)), \
+                        self.bind(params, bufs):
+                    S = ids.shape[1]
+                    pos_ids = pos + jnp.arange(S, dtype=jnp.int32)[None, :]
+                    lc = [("paged", Tensor(kps[i]), Tensor(vps[i]),
+                           Tensor(pos)) for i in range(L)]
+                    x, new_cache = gpt(Tensor(ids),
+                                       position_ids=Tensor(pos_ids), cache=lc)
+                    w = gpt.word_embeddings.weight._value
+                    logits = (x._value[:, -1].astype(jnp.float32)
+                              @ w.T.astype(jnp.float32))
+                    kps = jnp.stack([c[1]._value for c in new_cache])
+                    vps = jnp.stack([c[2]._value for c in new_cache])
+                return logits, (kps, vps)
+
+            def init_cache():
+                kp = jnp.zeros((L,) + pool, dt)
+                return kp, jnp.zeros_like(kp)
+
+            return decode_loop(self, fwd_paged, ids0, max_new_tokens,
+                               init_cache, temperature=temperature,
+                               top_k=top_k, top_p=top_p, seed=seed)
+        if cache_impl != "dense":
+            raise ValueError(f"cache_impl must be 'dense' or 'paged', "
+                             f"got {cache_impl!r}")
 
         def fwd(params, bufs, ids, ks, vs, pos):
             with no_grad_ctx(), _rng.rng_scope(jax.random.key(0)), \

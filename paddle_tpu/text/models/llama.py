@@ -139,6 +139,44 @@ class LlamaAttention(Layer):
 
         qh, kh, vh = _apply(attend, q, k, v, rope[0], rope[1],
                             op_name="llama_rope", n_outs=3)
+        if cache is not None and len(cache) == 4 and cache[0] == "paged":
+            # PAGED cache: per-layer [B, PP, ps, hkv, hd] pools, keys stored
+            # pre-rotated like the dense path; GQA attends grouped against
+            # the pools (no repeated-KV materialization in HBM) via
+            # ops.paged_attention's scalar-prefetch kernel.
+            from ...ops.paged_attention import (paged_decode_attend,
+                                                paged_prefill_write,
+                                                paged_token_write)
+
+            _, kp, vp, pos = cache
+            if attn_bias is not None:
+                raise NotImplementedError(
+                    "paged cache + attention_mask: per-sequence padding "
+                    "masks belong in seq_lens (PagedKVCache) — the uniform "
+                    "generate() paged path does not take a mask")
+            if S > 1:  # prefill: dense causal attention + page write
+                kf, vf = kh, vh
+                if rep > 1:
+                    kf = _apply(lambda t: jnp.repeat(t, rep, axis=2), kh,
+                                op_name="gqa_repeat")
+                    vf = _apply(lambda t: jnp.repeat(t, rep, axis=2), vh,
+                                op_name="gqa_repeat")
+                att = F.scaled_dot_product_attention(qh, kf, vf,
+                                                     is_causal=True,
+                                                     training=False)
+                kp = _apply(paged_prefill_write, kp, kh, op_name="paged_write")
+                vp = _apply(paged_prefill_write, vp, vh, op_name="paged_write")
+            else:
+                kp = _apply(lambda pgs, kk, p: paged_token_write(pgs, kk[:, 0], p),
+                            kp, kh, pos, op_name="paged_write")
+                vp = _apply(lambda pgs, vv, p: paged_token_write(pgs, vv[:, 0], p),
+                            vp, vh, pos, op_name="paged_write")
+                att = _apply(
+                    lambda qq, kps, vps, p:
+                        paged_decode_attend(qq[:, 0], kps, vps, p)[:, None],
+                    qh, kp, vp, pos, op_name="paged_attention")
+            att = att.reshape([B, S, hq * hd])
+            return self.o_proj(att), ("paged", kp, vp, pos)
         if cache is not None:
             # STATIC cache decode (GPT pattern): fixed [B, T, hkv, hd]
             # buffers updated in place at ``pos``; keys stored PRE-ROTATED
@@ -310,7 +348,8 @@ class LlamaForCausalLM(Layer):
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=0, top_p=1.0, seed=None, use_cache=True,
                  decode_strategy="sampling", num_beams=4, length_penalty=0.0,
-                 eos_token_id=None):
+                 eos_token_id=None, cache_impl="dense", page_size=16,
+                 max_len=None):
         """Autoregressive decode.
 
         ``use_cache=True`` (default): jitted two-phase decode — compiled
@@ -318,7 +357,11 @@ class LlamaForCausalLM(Layer):
         (keys stored pre-rotated), then ONE compiled single-token step
         (donated cache, static shapes) runs per new token.  Greedy output
         is identical to the eager loop.  ``use_cache=False``: eager
-        full-prefix loop (debug/reference path)."""
+        full-prefix loop (debug/reference path).
+
+        ``cache_impl="paged"``: block-paged KV pools + the Pallas
+        paged-attention kernel; GQA attends grouped against the pools, so
+        the kv cache stays at hkv heads in HBM (see GPT.generate)."""
         if decode_strategy == "beam_search":
             from ._decode import beam_search
 
@@ -335,12 +378,14 @@ class LlamaForCausalLM(Layer):
 
         ids0 = np.asarray(input_ids.numpy()).astype("int64")
         B, S0 = ids0.shape
-        T = S0 + max_new_tokens
+        # max_len pre-sizes the cache independently of max_new_tokens (see
+        # GPT.generate)
+        T = max(S0 + max_new_tokens, max_len or 0)
         cfg = self.llama.config
         if T > cfg.max_position_embeddings:
             raise ValueError(
                 f"generate: prompt {S0} + max_new_tokens {max_new_tokens} "
-                f"exceeds max_position_embeddings "
+                f"(cache {T}) exceeds max_position_embeddings "
                 f"{cfg.max_position_embeddings}")
         L = cfg.num_hidden_layers
         hkv = cfg.num_key_value_heads
@@ -349,6 +394,44 @@ class LlamaForCausalLM(Layer):
         from ...framework import random as _rng
         from ...framework.state import no_grad_ctx
         from ._decode import jitted_decode
+
+        dt0 = self.llama.embed_tokens.weight._value.dtype
+        if cache_impl == "paged":
+            from ._decode import decode_loop, paged_pool_shape
+
+            pool = paged_pool_shape(B, T, hkv, hd, page_size)
+
+            def fwd_paged(params, bufs, ids, cache, pos):
+                kps, vps = cache
+                with no_grad_ctx(), _rng.rng_scope(jax.random.key(0)), \
+                        self.bind(params, bufs):
+                    S = ids.shape[1]
+                    pos_ids = Tensor(pos + jnp.arange(S, dtype=jnp.int32))
+                    lc = [("paged", Tensor(kps[i]), Tensor(vps[i]),
+                           Tensor(pos)) for i in range(L)]
+                    hidden, new_cache = self.llama(Tensor(ids),
+                                                   position_ids=pos_ids,
+                                                   cache=lc)
+                    h = hidden._value[:, -1].astype(jnp.float32)
+                    if self.tie:
+                        w = self.llama.embed_tokens.weight._value
+                        logits = h @ w.T.astype(jnp.float32)
+                    else:
+                        logits = h @ self.lm_head.weight._value.astype(jnp.float32)
+                    kps = jnp.stack([c[1]._value for c in new_cache])
+                    vps = jnp.stack([c[2]._value for c in new_cache])
+                return logits, (kps, vps)
+
+            def init_cache():
+                kp = jnp.zeros((L,) + pool, dt0)
+                return kp, jnp.zeros_like(kp)
+
+            return decode_loop(self, fwd_paged, ids0, max_new_tokens,
+                               init_cache, temperature=temperature,
+                               top_k=top_k, top_p=top_p, seed=seed)
+        if cache_impl != "dense":
+            raise ValueError(f"cache_impl must be 'dense' or 'paged', "
+                             f"got {cache_impl!r}")
 
         def fwd(params, bufs, ids, ks, vs, pos):
             with no_grad_ctx(), _rng.rng_scope(jax.random.key(0)), \

@@ -308,15 +308,54 @@ def test_parallelize_one_call_api():
                          config={"pp_config": {"split_spec": "x"}})
 
 
-def test_parallelize_rejects_mp_plus_zero_combo_and_bad_level():
+def test_parallelize_composes_mp_plus_zero(recwarn):
+    """TP+ZeRO in ONE parallelize call (r4 weak #7: used to refuse): the
+    mp placements survive, the ZeRO axis takes a replicated dim, and the
+    composed model trains to parity with the unsharded reference."""
     mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+    rs = np.random.RandomState(1)
+    x = paddle.to_tensor(rs.randn(16, 32).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 8, (16,)).astype("int64"))
+    lossf = nn.CrossEntropyLoss()
+
+    def build():
+        paddle.seed(7)
+        return nn.Sequential(nn.Linear(32, 64), nn.GELU(), nn.Linear(64, 8))
+
+    ref = build()
+    o_ref = opt.AdamW(learning_rate=1e-3, parameters=ref.parameters())
+    s_ref = paddle.jit.TrainStep(ref, o_ref, loss_fn=lossf)
+    ref_losses = [float(s_ref(x, y)) for _ in range(3)]
+
+    m = build()
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    m, o = dist.parallelize(m, o, mesh=mesh, config={
+        "mp_config": {"parallelize_plan": {"0": dist.ColWiseParallel(),
+                                           "2": dist.RowWiseParallel()}},
+        "dp_config": {"sharding_level": 3}})
+    # ColWise [32, 64]: mp on dim 1 kept; ZeRO dp takes dim 0
+    spec0 = m[0].weight._value.sharding.spec
+    assert "mp" in str(spec0) and "dp" in str(spec0), spec0
+    assert m[0].weight._value.addressable_shards[0].data.shape == (16, 16)
+    step = paddle.jit.TrainStep(m, o, loss_fn=lossf)
+    losses = [float(step(x, y)) for _ in range(3)]
+    np.testing.assert_allclose(ref_losses, losses, rtol=2e-4, atol=2e-5)
+    # opt states sharded over dp too (stage-3 state layout follows params)
+    any_state = next(iter(jax.tree_util.tree_leaves(step._opt_state)))
+    assert any_state.sharding.num_devices > 1
+
+
+def test_parallelize_rejects_bad_level_and_mp_only_mesh_with_zero():
     paddle.seed(0)
     m = nn.Sequential(nn.Linear(8, 16), nn.Linear(16, 4))
     o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
-    with pytest.raises(NotImplementedError):
-        dist.parallelize(m, o, mesh=mesh, config={
-            "mp_config": {"parallelize_plan": {"0": dist.ColWiseParallel()}},
-            "dp_config": {"sharding_level": 2}})
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
     with pytest.raises(ValueError):
         dist.parallelize(m, o, mesh=mesh,
                          config={"dp_config": {"sharding_level": 4}})
+    # a pure-mp mesh cannot also ZeRO-shard alongside a TP plan
+    mesh_mp = ProcessMesh(np.arange(8).reshape(1, 8), ["dp", "mp"])
+    with pytest.raises(ValueError):
+        dist.parallelize(m, o, mesh=mesh_mp, config={
+            "mp_config": {"parallelize_plan": {"0": dist.ColWiseParallel()}},
+            "dp_config": {"sharding_level": 2}})

@@ -149,5 +149,20 @@ def test_fused_multi_transformer_incremental_decode_matches_full():
     # cache misuse raises
     with pytest.raises(ValueError):
         mt(x, caches=mt.gen_cache(2, 8))
-    with pytest.raises(NotImplementedError):
-        FusedMultiTransformer(8, 2, 16, normalize_before=False)
+
+    # post-LN (r4 weak #8: used to be refused) passes the same incremental
+    # oracle, and gen_cache honors the model dtype by default
+    paddle.seed(1)
+    mt2 = FusedMultiTransformer(16, 2, 32, num_layers=2,
+                                normalize_before=False).eval()
+    full2 = mt2(x).numpy()
+    caches2 = mt2.gen_cache(2, 8)
+    assert caches2[0][0].numpy().dtype == np.float32  # model dtype, not hard f32
+    outs2 = []
+    for t in range(6):
+        tok = paddle.to_tensor(x.numpy()[:, t:t + 1])
+        o, caches2 = mt2(tok, caches=caches2,
+                         time_step=paddle.to_tensor(np.int64(t)))
+        outs2.append(o.numpy())
+    np.testing.assert_allclose(np.concatenate(outs2, axis=1), full2,
+                               atol=2e-5)

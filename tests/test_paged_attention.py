@@ -98,3 +98,59 @@ def test_padded_pages_are_masked():
     a = np.asarray(paged_attention_ref(q, kp, vp, table, lens))
     b = np.asarray(paged_attention_ref(q, kp, vp2, table, lens))
     np.testing.assert_allclose(a[0], b[0], rtol=1e-6)
+
+
+# ------------------------------------------------- serving-loop integration
+
+
+def test_gpt_generate_paged_matches_dense():
+    """generate(cache_impl='paged') produces IDENTICAL tokens to the dense
+    static-cache decode (greedy), including prompts that straddle page
+    boundaries (r4 missing #2: the kernel existed but nothing decoded
+    through it)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import GPTForCausalLM
+
+    paddle.seed(0)
+    m = GPTForCausalLM(vocab_size=160, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, max_position_embeddings=128)
+    rs = np.random.RandomState(42)
+    for s0 in (3, 8):  # below / at a page_size=8 boundary
+        ids = paddle.to_tensor(rs.randint(0, 160, (2, s0)).astype("int64"))
+        dense = m.generate(ids, max_new_tokens=18, temperature=0.0)
+        paged = m.generate(ids, max_new_tokens=18, temperature=0.0,
+                           cache_impl="paged", page_size=8)
+        np.testing.assert_array_equal(dense.numpy(), paged.numpy())
+
+
+def test_llama_generate_paged_matches_dense_gqa():
+    """Llama GQA: paged pools stay at hkv heads; grouped attention against
+    the pools matches the dense repeated-KV decode token-for-token."""
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import LlamaForCausalLM
+
+    paddle.seed(1)
+    m = LlamaForCausalLM(vocab_size=160, hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         intermediate_size=128, max_position_embeddings=128)
+    rs = np.random.RandomState(7)
+    ids = paddle.to_tensor(rs.randint(0, 160, (2, 5)).astype("int64"))
+    dense = m.generate(ids, max_new_tokens=16, temperature=0.0)
+    paged = m.generate(ids, max_new_tokens=16, temperature=0.0,
+                       cache_impl="paged", page_size=4)
+    np.testing.assert_array_equal(dense.numpy(), paged.numpy())
+
+
+def test_paged_pool_hbm_bound_by_pages():
+    """The paged cache allocates ceil(T/ps) pages — for short decodes with
+    a large model max length, orders less HBM than the dense rectangle."""
+    from paddle_tpu.text.models._decode import paged_pool_shape
+
+    B, hkv, hd, ps = 4, 8, 64, 16
+    T_actual = 96
+    shape = paged_pool_shape(B, T_actual, hkv, hd, ps)
+    paged_elems = int(np.prod(shape))
+    dense_max_len = 2048  # a server sized for the model's max context
+    dense_elems = B * dense_max_len * hkv * hd
+    assert paged_elems == B * 6 * ps * hkv * hd
+    assert paged_elems * 20 < dense_elems
