@@ -30,12 +30,6 @@ import time
 import numpy as np
 
 
-def _cost_fields(compiled):
-    from benchmarks.micro import cost_fields
-
-    return cost_fields(compiled)
-
-
 def _measure_framework_resnet(B=128, iters=15, cost=False):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
@@ -61,11 +55,13 @@ def _measure_framework_resnet(B=128, iters=15, cost=False):
     ips = B / dt
     if not cost:
         return ips
+    from benchmarks.micro import cost_fields
+
     fn = next(iter(step._compiled.values()))
     comp = fn._jitted.lower(step._diff_params, step._opt_state, step._buffers,
                             step._frozen_params, step._lr_dev, step._rng_carry,
                             x._value, y._value).compile()
-    return ips, _cost_fields(comp)
+    return ips, cost_fields(comp)
 
 
 def _measure_framework_bert(B=64, S=128, iters=15, cost=False):
@@ -94,11 +90,13 @@ def _measure_framework_bert(B=64, S=128, iters=15, cost=False):
     ips = B / dt
     if not cost:
         return ips
+    from benchmarks.micro import cost_fields
+
     fn = next(iter(step._compiled.values()))
     comp = fn._jitted.lower(step._diff_params, step._opt_state, step._buffers,
                             step._frozen_params, step._lr_dev, step._rng_carry,
                             ids._value, y._value).compile()
-    return ips, _cost_fields(comp)
+    return ips, cost_fields(comp)
 
 
 def _measure_decode(cache_impl, B=8, S0=32, lo=64, hi=320):

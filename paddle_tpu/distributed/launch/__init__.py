@@ -51,9 +51,25 @@ def main(argv=None):
                         help="accepted for reference-CLI parity; chip "
                              "visibility is controlled by the TPU runtime")
     parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("--run_all_nodes", action="store_true",
+                        help="spawn EVERY node's worker from this one "
+                             "launcher (single-box multi-host simulation / "
+                             "CPU validation; on a real pod each host runs "
+                             "its own launcher)")
+    parser.add_argument("--elastic_max_restarts", type=int, default=0,
+                        help="with --run_all_nodes: supervise the pod and, "
+                             "when ANY node dies, kill the rest, "
+                             "re-rendezvous on a FRESH master port, and "
+                             "relaunch up to this many times (reference "
+                             "elastic 'kill pod -> re-rendezvous -> "
+                             "restart'; workers resume from their "
+                             "checkpoints)")
     parser.add_argument("script", help="training script to run")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
+
+    if args.run_all_nodes and args.nnodes > 1:
+        return _run_all_nodes(args)
 
     env = dict(os.environ)
     env.update(build_env(args.nnodes, args.node_rank, args.master))
@@ -75,3 +91,42 @@ def main(argv=None):
     sys.argv = [args.script] + list(args.script_args)
     runpy.run_path(args.script, run_name="__main__")
     return 0
+
+
+def _fresh_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_all_nodes(args):
+    """Single-box multi-host: spawn one worker per node rank, optionally
+    under elastic supervision (PodSupervisor semantics: any death kills the
+    pod, the rendezvous is rebuilt on a fresh coordinator port — the dead
+    job's coordination service must never be rejoined — and the pod
+    relaunches; workers resume from their latest checkpoint)."""
+    from ..elastic import PodSupervisor
+
+    host, _, _ = args.master.partition(":")
+
+    def make_workers(attempt):
+        # fresh master port per attempt = the re-rendezvous
+        master = f"{host or '127.0.0.1'}:{_fresh_port()}"
+        specs = []
+        for r in range(args.nnodes):
+            env = dict(os.environ)
+            env.update(build_env(args.nnodes, r, master))
+            env["PADDLE_RESTART_ATTEMPT"] = str(attempt)
+            if args.log_dir:
+                os.makedirs(args.log_dir, exist_ok=True)
+                env["PADDLE_LOG_DIR"] = args.log_dir
+            specs.append(([sys.executable, args.script]
+                          + list(args.script_args), env))
+        return specs
+
+    return PodSupervisor(make_workers,
+                         max_restarts=args.elastic_max_restarts).run()

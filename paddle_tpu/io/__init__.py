@@ -398,23 +398,31 @@ class DataLoader:
             timeout = self.timeout or None
             # Bounded waits even with timeout=0 (blocking): a worker killed
             # without enqueuing (SIGKILL/OOM) must surface as an error, not a
-            # forever-hang on result_q.get (ADVICE r4).  Poll in 1s slices
-            # and check liveness between slices.
+            # forever-hang on result_q.get (ADVICE r4).  Poll in short slices
+            # (liveness checks between them); the user timeout is WALL time
+            # waited for the batch currently due, so sub-second timeouts and
+            # out-of-order arrivals both honor it.
+            import time as _time
+
             while recvd < sent:
-                waited = 0.0
+                t_wait0 = _time.monotonic()
                 while recvd not in reorder:
+                    if timeout is not None:
+                        left = timeout - (_time.monotonic() - t_wait0)
+                        if left <= 0:
+                            raise RuntimeError(
+                                f"DataLoader worker timed out after {timeout}s")
+                        slice_t = min(1.0, left)
+                    else:
+                        slice_t = 1.0
                     try:
-                        bidx, data, err = result_q.get(timeout=1.0)
+                        bidx, data, err = result_q.get(timeout=slice_t)
                     except _q.Empty:
                         dead = [w for w, p in enumerate(procs) if not p.is_alive()]
                         if dead:
                             raise RuntimeError(
                                 f"DataLoader worker(s) {dead} died without "
                                 "returning a result (killed/OOM?)")
-                        waited += 1.0
-                        if timeout is not None and waited >= timeout:
-                            raise RuntimeError(
-                                f"DataLoader worker timed out after {timeout}s")
                         continue
                     if err is not None:
                         raise err

@@ -122,7 +122,12 @@ class TrainStep:
         lr_f = self._lr_value()
         if lr_f != self._lr_float:  # upload the lr scalar only when it changes
             self._lr_float = lr_f
-            self._lr_dev = jnp.asarray(lr_f, jnp.float32)
+            # np scalar, not jnp: a jnp scalar is COMMITTED to one local
+            # device, which a multi-process (multi-host) jit rejects; numpy
+            # inputs are uncommitted/replicated in both modes
+            import numpy as _np
+
+            self._lr_dev = _np.float32(lr_f)
         if self._rng_carry is None:
             # per-step keys are fold_in(base, t) computed INSIDE the program;
             # the (base, counter) carry lives on device and is donated, so a
@@ -329,6 +334,56 @@ class TrainStep:
         runner._tree_box = tree_box
         runner._jitted = jitted  # exposed for lowering/inspection (profiler, tests)
         return runner
+
+    # ------------------------------------------------------- multi-host SPMD
+    def globalize(self, mesh=None):
+        """Make every carried array a GLOBAL ``jax.Array`` so this fused
+        step is valid in a multi-process (multi-host) job.
+
+        In multi-process jax, a jit over a mesh spanning processes rejects
+        inputs committed to one process's local devices.  Model parameters
+        and optimizer state are per-process identical after seeded
+        construction, so they become fully-REPLICATED global arrays here
+        (already-global sharded leaves — e.g. tensor-parallel weights —
+        pass through untouched).  Batch inputs are the caller's job: build
+        them with ``jax.make_array_from_process_local_data`` (each process
+        feeds its shard of the global batch — what DistributedBatchSampler
+        loads).  Single-process: no-op.  Returns self.
+        """
+        if jax.process_count() == 1:
+            return self
+        import numpy as _np
+        from jax.experimental import multihost_utils as mh
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = mesh or Mesh(_np.asarray(jax.devices()), ("_g",))
+
+        def conv(v):
+            if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                return v  # already global (sharded or replicated)
+            dt = getattr(v, "dtype", None)
+            if dt is None or not hasattr(v, "shape"):
+                return v
+            if jax.dtypes.issubdtype(dt, jax.dtypes.prng_key):
+                data = mh.host_local_array_to_global_array(
+                    _np.asarray(jax.random.key_data(v)), mesh, P())
+                return jax.random.wrap_key_data(data,
+                                                impl=jax.random.key_impl(v))
+            return mh.host_local_array_to_global_array(
+                _np.asarray(v), mesh, P())
+
+        tmap = jax.tree_util.tree_map
+        self._diff_params = tmap(conv, self._diff_params)
+        self._frozen_params = tmap(conv, self._frozen_params)
+        self._buffers = tmap(conv, self._buffers)
+        self._opt_state = tmap(conv, self._opt_state)
+        if self._scaler_state is not None:
+            self._scaler_state = tuple(conv(v) for v in self._scaler_state)
+        if self._rng_carry is None:
+            self._rng_carry = (_rng.next_key(), jnp.zeros((), jnp.uint32))
+        self._rng_carry = (conv(self._rng_carry[0]), conv(self._rng_carry[1]))
+        self._rebind()
+        return self
 
     # ------------------------------------------------------------ state sync
     @property

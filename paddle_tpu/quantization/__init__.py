@@ -259,3 +259,87 @@ def extract_scales(model):
         elif isinstance(sub, AbsmaxObserver):
             out[name] = sub.scale()
     return out
+
+
+# ------------------------------------------------------------- int8 deploy
+class Int8Linear(Layer):
+    """Deploy-time int8 linear: weight stored AS int8, matmul runs
+    int8 x int8 -> int32 on the MXU (jnp.matmul with
+    preferred_element_type=int32 — XLA's native int8 dot path), dequantized
+    by the product of the two per-tensor scales.
+
+    This is the execution half the reference's quant deploy stack provides
+    (r4 missing #3: QAT/PTQ numerics existed but everything still ran at
+    full precision).  act_scale=None quantizes activations dynamically
+    (per-call absmax), the PTQ-free fallback.
+    """
+
+    def __init__(self, linear, w_scale, act_scale=None, bits=8):
+        super().__init__()
+        qmax = 2.0 ** (bits - 1) - 1
+        self._qmax = qmax
+        self.w_scale = float(max(w_scale, 1e-8))
+        self.act_scale = float(act_scale) if act_scale else None
+        w = linear.weight._value
+        q = jnp.clip(jnp.round(w / self.w_scale), -qmax, qmax)
+        self.register_buffer("weight_int8", Tensor(q.astype(jnp.int8)))
+        self.bias = getattr(linear, "bias", None)
+
+    def forward(self, x):
+        qmax = self._qmax
+        w_scale, act_scale = self.w_scale, self.act_scale
+        bias = self.bias
+
+        def fn(v, wq, *b):
+            if act_scale is not None:
+                s_a = jnp.float32(act_scale)
+            else:
+                s_a = jnp.maximum(jnp.max(jnp.abs(v)), 1e-8) / qmax
+            xq = jnp.clip(jnp.round(v / s_a), -qmax, qmax).astype(jnp.int8)
+            y = jnp.matmul(xq, wq, preferred_element_type=jnp.int32)
+            out = y.astype(jnp.float32) * (s_a * jnp.float32(w_scale))
+            if b:
+                out = out + b[0].astype(jnp.float32)
+            return out.astype(v.dtype)
+
+        args = (x, self.weight_int8) if bias is None \
+            else (x, self.weight_int8, bias)
+        return apply(fn, *args, op_name="int8_linear")
+
+
+def convert_to_int8(model, scales=None):
+    """Replace every quantized Linear with an :class:`Int8Linear` consuming
+    the ``extract_scales`` dict — the deploy conversion.
+
+    Call on a model whose quantable layers are ``_QuantedWrapper``s (after
+    QAT training or PTQ calibrate+convert); ``scales`` defaults to
+    ``extract_scales(model)``.  Weights requantize from the CURRENT values
+    using each wrapper's weight-quanter scale; activations use the observed
+    act-quanter scale (static quantization).  Conv layers keep fake-quant
+    numerics (int8 conv deploy: not yet).  Export the converted model with
+    jit.save and serve it via paddle.inference as usual — the int8 weights
+    and dots ride the StableHLO artifact.
+    """
+    from ..nn import Linear
+
+    if scales is None:
+        scales = extract_scales(model)
+    for name, sub in list(model.named_sublayers(include_self=False)):
+        if not isinstance(sub, _QuantedWrapper) or not isinstance(sub.inner,
+                                                                  Linear):
+            continue
+        w_scale = scales.get(f"{name}.weight_quanter")
+        act_scale = scales.get(f"{name}.act_quanter")
+        if w_scale is None or w_scale <= 1e-7:
+            # un-calibrated wrapper (missing scale, or an observer that
+            # never saw data and reports its epsilon floor): converting
+            # would saturate every weight to +/-qmax — leave fake-quant
+            continue
+        parent = model
+        parts = name.split(".")
+        for p in parts[:-1]:
+            parent = getattr(parent, p)
+        bits = getattr(sub.weight_quanter, "bits", 8)
+        setattr(parent, parts[-1],
+                Int8Linear(sub.inner, w_scale, act_scale, bits=bits))
+    return model

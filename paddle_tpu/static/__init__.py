@@ -100,6 +100,34 @@ class Executor:
 
         feed = feed or {}
         prog = program or default_main_program()
+        loaded = getattr(prog, "_loaded", None)
+        if loaded is not None:
+            # a static.load'ed program: execute the deserialized StableHLO
+            # module (weights baked at save time) on the named feeds.
+            # Fetch mapping is POSITIONAL in the save-time order; where the
+            # save recorded fetch names, a reordered fetch_list is caught
+            # instead of silently returning mismapped values.
+            exported, feed_names, fetch_names = loaded
+            vals = [jnp.asarray(feed[n]) for n in feed_names]
+            outs = exported.call(*vals)
+            outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+            if fetch_list:
+                if len(fetch_list) != len(outs):
+                    raise ValueError(
+                        f"loaded program returns {len(outs)} fetches "
+                        "(positional, save-time order), fetch_list has "
+                        f"{len(fetch_list)}")
+                for i, (f, saved) in enumerate(zip(fetch_list,
+                                                   fetch_names or [])):
+                    got = getattr(f, "name", None)
+                    if saved and got and got != saved:
+                        raise ValueError(
+                            f"loaded program fetch {i} was saved as "
+                            f"{saved!r} but fetch_list has {got!r}: "
+                            "fetches map positionally to the save-time "
+                            "order")
+            return [np.asarray(o) if return_numpy else Tensor(o)
+                    for o in outs]
         for name, value in feed.items():
             var = prog.data_vars.get(name)
             if var is not None:
@@ -108,6 +136,8 @@ class Executor:
         for f in fetch_list or []:
             t = _replay(f)
             results.append(np.asarray(t._value) if return_numpy else t)
+        if fetch_list:
+            prog._last_fetches = list(fetch_list)  # static.save's default
         return results
 
 
@@ -165,12 +195,71 @@ def name_scope(prefix=None):
 
 
 # re-export the nn free functions users reach via paddle.static in old code
-def save(program, model_path, protocol=4):
-    raise NotImplementedError("static.save: use paddle.jit.save (StableHLO export)")
+def save(program, model_path, protocol=4, fetch_vars=None):
+    """Serialize the Program's feed->fetch computation (r4 missing #5: this
+    used to raise).
+
+    Reference static.save persists a Program's parameters; here the whole
+    feed->fetch computation — tape-recorded ops with current parameter
+    values baked in — exports to the SAME StableHLO artifact format as
+    jit.save ({path}.stablehlo + {path}.spec.json), loadable by
+    ``static.load`` into an Executor-runnable program.  The fetch targets
+    are ``fetch_vars`` or the last ``Executor.run(fetch_list=...)``.
+    """
+    import json
+
+    fetches = fetch_vars or getattr(program, "_last_fetches", None)
+    if not fetches:
+        raise ValueError(
+            "static.save: no fetch targets — run Executor.run(..., "
+            "fetch_list=[...]) once first, or pass fetch_vars=[...]")
+    feed_names = list(program.data_vars)
+    for n in feed_names:
+        if getattr(program.data_vars[n], "_value", None) is None:
+            raise ValueError(
+                f"static.save: placeholder {n!r} was never fed; run the "
+                "program once so every feed has a concrete shape")
+
+    def fn(*feed_vals):
+        saved = {n: program.data_vars[n]._value for n in feed_names}
+        try:
+            for n, v in zip(feed_names, feed_vals):
+                program.data_vars[n]._value = v
+            outs = [_replay(f) for f in fetches]
+            return tuple(o._value for o in outs)
+        finally:
+            for n, v in saved.items():
+                program.data_vars[n]._value = v
+
+    structs = [jax.ShapeDtypeStruct(tuple(program.data_vars[n]._value.shape),
+                                    program.data_vars[n]._value.dtype)
+               for n in feed_names]
+    exported = jax.export.export(jax.jit(fn))(*structs)
+    with open(str(model_path) + ".stablehlo", "wb") as f:
+        f.write(exported.serialize())
+    meta = {"kind": "static_program", "feed_names": feed_names,
+            "n_fetch": len(fetches),
+            # fetch identities (names where the user set them) so run() on
+            # the loaded program can catch a reordered fetch_list instead of
+            # silently mismapping outputs
+            "fetch_names": [getattr(f, "name", None) for f in fetches]}
+    with open(str(model_path) + ".spec.json", "w") as f:
+        json.dump(meta, f)
 
 
 def load(program, model_path, executor=None, var_list=None):
-    raise NotImplementedError("static.load: use paddle.jit.load")
+    """Inverse of ``static.save``: attach the deserialized StableHLO module
+    to ``program`` so ``Executor.run(program, feed, fetch_list)`` executes
+    it (weights are the values baked at save time)."""
+    import json
+
+    with open(str(model_path) + ".stablehlo", "rb") as f:
+        exported = jax.export.deserialize(bytearray(f.read()))
+    with open(str(model_path) + ".spec.json") as f:
+        meta = json.load(f)
+    program._loaded = (exported, meta["feed_names"],
+                       meta.get("fetch_names"))
+    return program
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,

@@ -306,3 +306,38 @@ def test_inference_predictor_api():
         import pytest as _pytest
         with _pytest.raises(RuntimeError):
             p2.run()
+
+
+def test_static_save_load_roundtrip(tmp_path):
+    """static.save exports the Program's feed->fetch computation to the
+    StableHLO artifact; static.load gives an Executor-runnable program with
+    identical feed/fetch behavior (r4 missing #5: both used to raise)."""
+    import numpy as np
+
+    from paddle_tpu import static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+        paddle.seed(11)
+        lin = paddle.nn.Linear(8, 3)
+        y = paddle.nn.functional.relu(lin(x))
+        z = y.sum()
+
+    exe = static.Executor()
+    rs = np.random.RandomState(0)
+    feed1 = rs.randn(4, 8).astype("float32")
+    out1, s1 = exe.run(prog, feed={"x": feed1}, fetch_list=[y, z])
+
+    path = str(tmp_path / "prog")
+    static.save(prog, path)
+
+    prog2 = static.load(static.Program(), path)
+    out2, s2 = exe.run(prog2, feed={"x": feed1}, fetch_list=[y, z])
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+    np.testing.assert_allclose(s1, s2, rtol=1e-6)
+    # fresh feeds run through the loaded module too
+    feed2 = rs.randn(4, 8).astype("float32")
+    ref, _ = exe.run(prog, feed={"x": feed2}, fetch_list=[y, z])
+    got, _ = exe.run(prog2, feed={"x": feed2}, fetch_list=[y, z])
+    np.testing.assert_allclose(ref, got, rtol=1e-6)

@@ -108,3 +108,53 @@ def test_qat_trains_through_train_step():
     y = paddle.to_tensor(rs.randint(0, 2, (32,)).astype("int64"))
     losses = [float(step(x, y)) for _ in range(8)]
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_int8_deploy_bert_classify_head():
+    """The int8 DEPLOY path (r4 missing #3): PTQ-calibrate a small BERT
+    classifier, convert_to_int8, and serve — weights live as int8, matmuls
+    run int8 x int8 -> int32, and the accuracy cost vs fp32 is bounded:
+    measured here, >= 95% of predicted labels agree and max logit deviation
+    stays under 0.15 of the fp32 logit range on held-out batches."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.quantization import PTQ, QuantConfig, convert_to_int8
+    from paddle_tpu.text.models import BertForSequenceClassification
+
+    paddle.seed(0)
+    m = BertForSequenceClassification(
+        num_classes=4, vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=64)
+    m.eval()
+    rs = np.random.RandomState(0)
+
+    def batch(n=8):
+        return paddle.to_tensor(rs.randint(1, 128, (n, 16)).astype("int64"))
+
+    calib = [batch() for _ in range(4)]
+    held = [batch() for _ in range(3)]
+    fp32_logits = [m(b).numpy() for b in held]
+
+    ptq = PTQ(QuantConfig())
+    q = ptq.quantize(m)
+    for b in calib:
+        q(b)
+    q = ptq.convert(q)
+    q = convert_to_int8(q)
+
+    from paddle_tpu.quantization import Int8Linear
+
+    int8_layers = [s for _, s in q.named_sublayers() if isinstance(s, Int8Linear)]
+    assert len(int8_layers) >= 8  # qkv/out/ffn per layer + pooler + classifier
+    assert all(l.weight_int8._value.dtype == jnp.int8 for l in int8_layers)
+
+    agree = tot = 0
+    for b, ref in zip(held, fp32_logits):
+        got = q(b).numpy()
+        scale = np.abs(ref).max() + 1e-9
+        assert np.abs(got - ref).max() / scale < 0.15, \
+            (np.abs(got - ref).max(), scale)
+        agree += (got.argmax(-1) == ref.argmax(-1)).sum()
+        tot += ref.shape[0]
+    assert agree / tot >= 0.95, (agree, tot)
