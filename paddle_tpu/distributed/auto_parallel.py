@@ -461,10 +461,18 @@ class ShardDataloader:
         self._mesh = meshes[0] if isinstance(meshes, (tuple, list)) else meshes
         self._shard_dims = shard_dims
         self._input_keys = set(input_keys) if input_keys is not None else None
-        # the DATA axis: 'dp' when the mesh has one, else the first dim —
-        # never silently shard the batch over a model-parallel axis
+        # the DATA axis: shard_dims may NAME the mesh dim directly
+        # (reference spelling shard_dims="dp"); otherwise 'dp' when the
+        # mesh has one, else the first dim — never silently shard the
+        # batch over a model-parallel axis
         names = self._mesh.dim_names
-        self._axis = "dp" if "dp" in names else names[0]
+        if isinstance(shard_dims, str):
+            if shard_dims not in names:
+                raise ValueError(f"shard_dims {shard_dims!r} is not a mesh "
+                                 f"dim ({names})")
+            self._axis = shard_dims
+        else:
+            self._axis = "dp" if "dp" in names else names[0]
         self._jmesh = self._mesh.jax_mesh
 
     def __len__(self):
@@ -473,6 +481,8 @@ class ShardDataloader:
     def _dim_for(self, key):
         if isinstance(self._shard_dims, dict):
             return self._shard_dims.get(key, 0)
+        if isinstance(self._shard_dims, str):
+            return 0  # mesh-dim name: batch dim 0 shards over that axis
         return int(self._shard_dims)
 
     def _shard(self, t, key=None):

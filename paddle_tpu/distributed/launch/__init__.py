@@ -68,8 +68,14 @@ def main(argv=None):
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
-    if args.run_all_nodes and args.nnodes > 1:
+    if args.run_all_nodes:
+        # nnodes == 1 included: a single supervised worker still gets the
+        # elastic kill-pod -> fresh-port -> relaunch treatment
         return _run_all_nodes(args)
+    if args.elastic_max_restarts:
+        raise SystemExit(
+            "--elastic_max_restarts needs --run_all_nodes (per-host "
+            "launchers are supervised by the cluster manager, not here)")
 
     env = dict(os.environ)
     env.update(build_env(args.nnodes, args.node_rank, args.master))

@@ -23,7 +23,9 @@ from ..tensor.tensor import Tensor
 __all__ = [
     "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli", "Beta",
     "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel", "Laplace",
-    "LogNormal", "Multinomial", "kl_divergence", "register_kl",
+    "LogNormal", "Multinomial", "StudentT", "Cauchy", "Poisson", "Chi2",
+    "ChiSquare", "MultivariateNormal", "Independent",
+    "TransformedDistribution", "kl_divergence", "register_kl", "transform",
 ]
 
 _LOG_2PI = math.log(2 * math.pi)
@@ -474,6 +476,326 @@ class Multinomial(Distribution):
             _t(value), self.probs_, op_name="multinomial_log_prob")
 
 
+class StudentT(Distribution):
+    """Student's t (reference: paddle.distribution.StudentT(df, loc, scale))."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(tuple(self.df.shape),
+                                              tuple(self.loc.shape),
+                                              tuple(self.scale.shape)))
+
+    @property
+    def mean(self):
+        return _apply(lambda d, l: jnp.where(d > 1, l, jnp.nan),
+                      self.df, self.loc, op_name="studentt_mean")
+
+    @property
+    def variance(self):
+        return _apply(
+            lambda d, s: jnp.where(d > 2, s * s * d / (d - 2),
+                                   jnp.where(d > 1, jnp.inf, jnp.nan)),
+            self.df, self.scale, op_name="studentt_variance")
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        key = self._key()
+        return _apply(
+            lambda d, l, s: l + s * jax.random.t(
+                key, jnp.broadcast_to(d, shp), shp),
+            self.df, self.loc, self.scale, op_name="studentt_rsample")
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        def fn(v, d, l, s):
+            z = (v - l) / s
+            return (gammaln((d + 1) / 2) - gammaln(d / 2)
+                    - 0.5 * jnp.log(d * jnp.pi) - jnp.log(s)
+                    - (d + 1) / 2 * jnp.log1p(z * z / d))
+
+        return _apply(fn, _t(value), self.df, self.loc, self.scale,
+                      op_name="studentt_log_prob")
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+
+        def fn(d, s):
+            return ((d + 1) / 2 * (digamma((d + 1) / 2) - digamma(d / 2))
+                    + 0.5 * jnp.log(d) + betaln(d / 2, 0.5) + jnp.log(s))
+
+        return _apply(fn, self.df, self.scale, op_name="studentt_entropy")
+
+
+class Cauchy(Distribution):
+    """reference: paddle.distribution.Cauchy(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(tuple(self.loc.shape),
+                                              tuple(self.scale.shape)))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        key = self._key()
+        return _apply(lambda l, s: l + s * jax.random.cauchy(key, shp),
+                      self.loc, self.scale, op_name="cauchy_rsample")
+
+    def log_prob(self, value):
+        return _apply(
+            lambda v, l, s: -jnp.log(jnp.pi) - jnp.log(s)
+            - jnp.log1p(((v - l) / s) ** 2),
+            _t(value), self.loc, self.scale, op_name="cauchy_log_prob")
+
+    def cdf(self, value):
+        return _apply(
+            lambda v, l, s: jnp.arctan((v - l) / s) / jnp.pi + 0.5,
+            _t(value), self.loc, self.scale, op_name="cauchy_cdf")
+
+    def entropy(self):
+        return _apply(
+            lambda l, s: jnp.broadcast_to(jnp.log(4 * jnp.pi * s),
+                                          jnp.broadcast_shapes(l.shape,
+                                                               s.shape)),
+            self.loc, self.scale, op_name="cauchy_entropy")
+
+
+class Poisson(Distribution):
+    """reference: paddle.distribution.Poisson(rate).  Discrete: ``sample``
+    draws via the native Knuth/transformed-rejection kernel; there is no
+    reparameterized path (rsample raises, matching the reference)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        lam = jnp.broadcast_to(self.rate._value, shp)
+        # jax.random.poisson supports only threefry keys; the framework
+        # default is rbg (see framework/random.py) — derive a deterministic
+        # threefry key from the drawn key's raw words
+        key = self._key()
+        if jax.random.key_impl(key) is not jax.random.key_impl(
+                jax.random.wrap_key_data(jnp.zeros((2,), jnp.uint32),
+                                         impl="threefry2x32")):
+            data = jax.random.key_data(key).reshape(-1)[:2]
+            key = jax.random.wrap_key_data(data.astype(jnp.uint32),
+                                           impl="threefry2x32")
+        return Tensor(jax.random.poisson(key, lam, shp).astype(jnp.float32))
+
+    def rsample(self, shape=()):
+        raise NotImplementedError(
+            "Poisson has no reparameterized sampler; use sample()")
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln, xlogy
+
+        return _apply(lambda v, r: xlogy(v, r) - r - gammaln(v + 1),
+                      _t(value), self.rate, op_name="poisson_log_prob")
+
+    def entropy(self):
+        """No closed form: enumerate the truncated support (mass beyond
+        rate + 10*sqrt(rate) + 20 is negligible for any practical rate)."""
+        from jax.scipy.special import gammaln, xlogy
+
+        r = self.rate._value
+        kmax = int(jnp.max(jnp.ceil(r + 10 * jnp.sqrt(r) + 20)))
+
+        def fn(rate):
+            k = jnp.arange(kmax + 1, dtype=jnp.float32)
+            shp = (1,) * rate.ndim + (-1,)
+            k = k.reshape(shp)
+            lp = xlogy(k, rate[..., None]) - rate[..., None] - gammaln(k + 1)
+            return -(jnp.exp(lp) * lp).sum(-1)
+
+        return _apply(fn, self.rate, op_name="poisson_entropy")
+
+
+class Chi2(Gamma):
+    """Chi-squared with ``df`` degrees of freedom = Gamma(df/2, 1/2)
+    (reference: paddle.distribution.Chi2)."""
+
+    def __init__(self, df, name=None):
+        self.df = _t(df)
+        super().__init__(_apply(lambda d: d / 2.0, self.df, op_name="div"),
+                         0.5)
+
+
+ChiSquare = Chi2  # alias
+
+
+class MultivariateNormal(Distribution):
+    """reference: paddle.distribution.MultivariateNormal(loc,
+    covariance_matrix= | precision_matrix= | scale_tril=).  Internally
+    everything runs off the Cholesky factor (one triangular solve per
+    log_prob — no explicit inverse)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _t(loc)
+        given = [a is not None
+                 for a in (covariance_matrix, precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError("pass exactly one of covariance_matrix / "
+                             "precision_matrix / scale_tril")
+        if scale_tril is not None:
+            self.scale_tril = _t(scale_tril)
+        elif covariance_matrix is not None:
+            self.scale_tril = _apply(jnp.linalg.cholesky, _t(covariance_matrix),
+                                     op_name="cholesky")
+        else:
+            def prec_to_tril(p):
+                lp = jnp.linalg.cholesky(p)
+                eye = jnp.eye(p.shape[-1], dtype=p.dtype)
+                inv = jax.scipy.linalg.solve_triangular(lp, eye, lower=True)
+                return jnp.linalg.cholesky(
+                    jnp.swapaxes(inv, -1, -2) @ inv)
+
+            self.scale_tril = _apply(prec_to_tril, _t(precision_matrix),
+                                     op_name="prec_to_tril")
+        d = self.scale_tril.shape[-1]
+        batch = jnp.broadcast_shapes(tuple(self.loc.shape[:-1]),
+                                     tuple(self.scale_tril.shape[:-2]))
+        super().__init__(batch, (d,))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def covariance_matrix(self):
+        return _apply(lambda L: L @ jnp.swapaxes(L, -1, -2), self.scale_tril,
+                      op_name="matmul")
+
+    @property
+    def variance(self):
+        return _apply(lambda L: jnp.sum(L * L, axis=-1), self.scale_tril,
+                      op_name="mvn_variance")
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape + self.event_shape
+        eps = jax.random.normal(self._key(), shp, jnp.float32)
+        return _apply(
+            lambda l, L: l + jnp.einsum("...ij,...j->...i", L, eps),
+            self.loc, self.scale_tril, op_name="mvn_rsample")
+
+    def log_prob(self, value):
+        def fn(v, l, L):
+            d = L.shape[-1]
+            diff = v - l
+            # solve_triangular does not auto-broadcast batch dims: tile the
+            # factor up to the value's batch shape
+            Lb = jnp.broadcast_to(L, diff.shape[:-1] + L.shape[-2:])
+            z = jax.scipy.linalg.solve_triangular(
+                Lb, diff[..., None], lower=True)[..., 0]
+            half_logdet = jnp.log(
+                jnp.abs(jnp.diagonal(L, axis1=-2, axis2=-1))).sum(-1)
+            return (-0.5 * (z * z).sum(-1) - half_logdet
+                    - 0.5 * d * _LOG_2PI)
+
+        return _apply(fn, _t(value), self.loc, self.scale_tril,
+                      op_name="mvn_log_prob")
+
+    def entropy(self):
+        def fn(L):
+            d = L.shape[-1]
+            half_logdet = jnp.log(
+                jnp.abs(jnp.diagonal(L, axis1=-2, axis2=-1))).sum(-1)
+            return 0.5 * d * (1.0 + _LOG_2PI) + half_logdet
+
+        return _apply(fn, self.scale_tril, op_name="mvn_entropy")
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost ``reinterpreted_batch_rank`` batch dims of
+    ``base`` as event dims (reference: paddle.distribution.Independent)."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        b = base.batch_shape
+        if not 0 <= self.rank <= len(b):
+            raise ValueError(
+                f"reinterpreted_batch_rank {self.rank} exceeds the base "
+                f"distribution's batch rank {len(b)} (batch_shape {b})")
+        super().__init__(b[:len(b) - self.rank],
+                         b[len(b) - self.rank:] + base.event_shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return _apply(
+            lambda v: v.sum(tuple(range(v.ndim - self.rank, v.ndim))),
+            lp, op_name="independent_sum") if self.rank else lp
+
+    def entropy(self):
+        e = self.base.entropy()
+        return _apply(
+            lambda v: v.sum(tuple(range(v.ndim - self.rank, v.ndim))),
+            e, op_name="independent_sum") if self.rank else e
+
+
+class TransformedDistribution(Distribution):
+    """reference: paddle.distribution.TransformedDistribution(base,
+    transforms): push ``base`` through a chain of bijectors; log_prob uses
+    the change-of-variables formula with each transform's log|det J|."""
+
+    def __init__(self, base, transforms):
+        from . import transform as T
+
+        self.base = base
+        if isinstance(transforms, T.Transform):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        for t in self.transforms:
+            if not isinstance(t, T.Transform):
+                raise TypeError(f"not a Transform: {t!r}")
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        value = _t(value)
+        lp = None
+        x = value
+        for t in reversed(self.transforms):
+            y = x
+            x = t.inverse(y)
+            ld = t.forward_log_det_jacobian(x)
+            lp = ld if lp is None else _apply(jnp.add, lp, ld, op_name="add")
+        base_lp = self.base.log_prob(x)
+        if lp is None:
+            return base_lp
+        return _apply(lambda b, l: b - l, base_lp, lp, op_name="sub")
+
+
 # ------------------------------------------------------------ KL registry
 _KL_REGISTRY = {}
 
@@ -568,3 +890,53 @@ def _kl_beta(p, q):
                 + (bp - bq) * (digamma(bp) - t))
 
     return _apply(fn, p.alpha, p.beta, q.alpha, q.beta, op_name="kl_beta")
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy(p, q):
+    # closed form (Chyzak & Nielsen 2019):
+    # log[((gp+gq)^2 + (mp-mq)^2) / (4 gp gq)]
+    return _apply(
+        lambda lp, sp, lq, sq: jnp.log(((sp + sq) ** 2 + (lp - lq) ** 2)
+                                       / (4 * sp * sq)),
+        p.loc, p.scale, q.loc, q.scale, op_name="kl_cauchy_cauchy")
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    from jax.scipy.special import xlogy
+
+    return _apply(
+        lambda rp, rq: xlogy(rp, rp / rq) + rq - rp,
+        p.rate, q.rate, op_name="kl_poisson_poisson")
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    def fn(lp, Lp, lq, Lq):
+        d = Lp.shape[-1]
+        # solve_triangular does not auto-broadcast batch dims (same note as
+        # MultivariateNormal.log_prob): tile everything to the common batch
+        batch = jnp.broadcast_shapes(lp.shape[:-1], lq.shape[:-1],
+                                     Lp.shape[:-2], Lq.shape[:-2])
+        lp = jnp.broadcast_to(lp, batch + lp.shape[-1:])
+        lq = jnp.broadcast_to(lq, batch + lq.shape[-1:])
+        Lp = jnp.broadcast_to(Lp, batch + Lp.shape[-2:])
+        Lq = jnp.broadcast_to(Lq, batch + Lq.shape[-2:])
+        # M = Lq^-1 Lp ; trace term = ||M||_F^2
+        M = jax.scipy.linalg.solve_triangular(Lq, Lp, lower=True)
+        tr = jnp.sum(M * M, axis=(-2, -1))
+        z = jax.scipy.linalg.solve_triangular(
+            Lq, (lq - lp)[..., None], lower=True)[..., 0]
+        maha = jnp.sum(z * z, axis=-1)
+        logdet_p = jnp.log(jnp.abs(jnp.diagonal(Lp, axis1=-2,
+                                                axis2=-1))).sum(-1)
+        logdet_q = jnp.log(jnp.abs(jnp.diagonal(Lq, axis1=-2,
+                                                axis2=-1))).sum(-1)
+        return 0.5 * (tr + maha - d) + logdet_q - logdet_p
+
+    return _apply(fn, p.loc, p.scale_tril, q.loc, q.scale_tril,
+                  op_name="kl_mvn_mvn")
+
+
+from . import transform  # noqa: E402  (public submodule, __all__ entry)

@@ -1,0 +1,190 @@
+"""paddle.distribution.transform — bijectors for TransformedDistribution
+(reference: python/paddle/distribution/transform.py).
+
+Each Transform is a differentiable bijection y = f(x) with an analytic
+log|det J_f(x)|; everything is one fused jnp formula through the dispatch
+layer, so transformed log_probs backprop into both the value and any
+Tensor-valued transform parameters.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.dispatch import apply as _apply
+from ..tensor.tensor import Tensor
+
+__all__ = ["Transform", "AffineTransform", "ExpTransform", "PowerTransform",
+           "SigmoidTransform", "TanhTransform", "ChainTransform",
+           "SoftmaxTransform", "AbsTransform"]
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, jnp.float32), stop_gradient=True)
+
+
+class Transform:
+    """Bijector base: forward / inverse / forward_log_det_jacobian."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return _apply(lambda v: -v,
+                      self.forward_log_det_jacobian(self.inverse(y)),
+                      op_name="neg")
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return _apply(lambda v, l, s: l + s * v, _t(x), self.loc, self.scale,
+                      op_name="affine_fwd")
+
+    def inverse(self, y):
+        return _apply(lambda v, l, s: (v - l) / s, _t(y), self.loc,
+                      self.scale, op_name="affine_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return _apply(
+            lambda v, s: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                          jnp.broadcast_shapes(v.shape,
+                                                               s.shape)),
+            _t(x), self.scale, op_name="affine_logdet")
+
+
+class ExpTransform(Transform):
+    """y = exp(x)."""
+
+    def forward(self, x):
+        return _apply(jnp.exp, _t(x), op_name="exp")
+
+    def inverse(self, y):
+        return _apply(jnp.log, _t(y), op_name="log")
+
+    def forward_log_det_jacobian(self, x):
+        return _t(x)  # log|d exp(x)/dx| = x
+
+
+class PowerTransform(Transform):
+    """y = x ** power (x > 0)."""
+
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def forward(self, x):
+        return _apply(lambda v, p: v ** p, _t(x), self.power, op_name="pow")
+
+    def inverse(self, y):
+        return _apply(lambda v, p: v ** (1.0 / p), _t(y), self.power,
+                      op_name="pow_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return _apply(
+            lambda v, p: jnp.log(jnp.abs(p)) + (p - 1) * jnp.log(v),
+            _t(x), self.power, op_name="pow_logdet")
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x)."""
+
+    def forward(self, x):
+        return _apply(jax.nn.sigmoid, _t(x), op_name="sigmoid")
+
+    def inverse(self, y):
+        return _apply(lambda v: jnp.log(v) - jnp.log1p(-v), _t(y),
+                      op_name="logit")
+
+    def forward_log_det_jacobian(self, x):
+        # log sigmoid'(x) = -softplus(-x) - softplus(x)
+        return _apply(
+            lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v), _t(x),
+            op_name="sigmoid_logdet")
+
+
+class TanhTransform(Transform):
+    """y = tanh(x)."""
+
+    def forward(self, x):
+        return _apply(jnp.tanh, _t(x), op_name="tanh")
+
+    def inverse(self, y):
+        return _apply(jnp.arctanh, _t(y), op_name="arctanh")
+
+    def forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2(log2 - x - softplus(-2x)) — the stable form
+        return _apply(
+            lambda v: 2.0 * (jnp.log(2.0) - v - jax.nn.softplus(-2.0 * v)),
+            _t(x), op_name="tanh_logdet")
+
+
+class AbsTransform(Transform):
+    """y = |x| (non-bijective; inverse returns the positive branch)."""
+
+    def forward(self, x):
+        return _apply(jnp.abs, _t(x), op_name="abs")
+
+    def inverse(self, y):
+        return _t(y)
+
+    def forward_log_det_jacobian(self, x):
+        return _apply(jnp.zeros_like, _t(x), op_name="zeros_like")
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (not a bijection on R^n; inverse
+    maps to the log-probability representative, matching the reference)."""
+
+    def forward(self, x):
+        return _apply(lambda v: jax.nn.softmax(v, axis=-1), _t(x),
+                      op_name="softmax")
+
+    def inverse(self, y):
+        return _apply(jnp.log, _t(y), op_name="log")
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not a bijection on R^n; no scalar log-det")
+
+
+class ChainTransform(Transform):
+    """Composition: forward applies left-to-right."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else _apply(jnp.add, total, ld,
+                                                    op_name="add")
+            x = t.forward(x)
+        return total

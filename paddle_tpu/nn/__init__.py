@@ -14,13 +14,13 @@ from .layers.common import (  # noqa: F401
     Dropout3D, Embedding, Flatten, Fold, Identity, Linear, Pad1D, Pad2D, Pad3D,
     PairwiseDistance, PixelShuffle, PixelUnshuffle, Unfold, Unflatten,
     Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad1D, ZeroPad2D,
-    ZeroPad3D, Dropout1D,
+    ZeroPad3D, Dropout1D, FeatureAlphaDropout,
 )
 from .layers.conv import (  # noqa: F401
     Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D, Conv3DTranspose,
 )
 from .layers.loss import (  # noqa: F401
-    BCELoss, BCEWithLogitsLoss, CTCLoss, CosineEmbeddingLoss, CrossEntropyLoss,
+    AdaptiveLogSoftmaxWithLoss, BCELoss, BCEWithLogitsLoss, CTCLoss, CosineEmbeddingLoss, CrossEntropyLoss,
     GaussianNLLLoss, HSigmoidLoss, HingeEmbeddingLoss, KLDivLoss, L1Loss, MSELoss,
     MarginRankingLoss, MultiLabelSoftMarginLoss, MultiMarginLoss, NLLLoss,
     PoissonNLLLoss, SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
@@ -34,8 +34,8 @@ from .layers.norm import (  # noqa: F401
 from .layers.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
     AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
-    LPPool1D, LPPool2D, MaxPool1D, MaxPool2D, MaxPool3D, MaxUnPool1D,
-    MaxUnPool2D, MaxUnPool3D,
+    FractionalMaxPool2D, FractionalMaxPool3D, LPPool1D, LPPool2D, MaxPool1D,
+    MaxPool2D, MaxPool3D, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
 )
 from .layers.rnn import (  # noqa: F401
     GRU, LSTM, RNN, BeamSearchDecoder, BiRNN, GRUCell, LSTMCell, RNNCellBase,

@@ -337,3 +337,87 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive(x, output_size, 3, "NCDHW", "max")
+
+
+def _fractional_boundaries(in_size, out_size, u):
+    """Fractional pooling region boundaries (Graham 2014 pseudo-random
+    sequence): b_i = ceil(alpha*(i+u)) with b_0=0, b_out=in — region i is
+    [b_i, b_{i+1}), width 1 or 2 px for out <= in < 2*out."""
+    alpha = in_size / out_size
+    b = np.ceil(alpha * (np.arange(out_size) + u)).astype(np.int64)
+    b = np.concatenate([[0], np.minimum(b[:-1], in_size - 1), [in_size]])
+    # enforce monotonicity (degenerate alpha/u combinations)
+    b = np.maximum.accumulate(b)
+    return b
+
+
+def _fractional_pool_axis(v, axis, in_size, out_size, u):
+    """Max-pool one spatial axis into fractional regions via segment_max
+    (XLA scatter-max — no per-region Python loop)."""
+    b = _fractional_boundaries(in_size, out_size, u)
+    seg = np.searchsorted(b[1:], np.arange(in_size), side="right")
+    seg = jnp.asarray(np.minimum(seg, out_size - 1))
+    moved = jnp.moveaxis(v, axis, 0)
+    pooled = jax.ops.segment_max(moved, seg, num_segments=out_size)
+    return jnp.moveaxis(pooled, 0, axis)
+
+
+def _fractional_max_pool(x, output_size, n, random_u, name):
+    v = unwrap(x)
+    if random_u is None:
+        import random as _pyrand
+
+        random_u = _pyrand.random()
+    if not 0 < float(random_u) < 1:
+        raise ValueError(f"random_u must be in (0, 1), got {random_u}")
+    out_sp = _norm_tuple(output_size, n)
+    for i in range(n):
+        if out_sp[i] > v.shape[2 + i]:
+            raise ValueError(
+                f"fractional_max_pool{n}d: output_size {out_sp} exceeds "
+                f"input spatial shape {tuple(v.shape[2:])} on dim {i}")
+
+    def fn(vv):
+        out = vv
+        for i in range(n):
+            axis = 2 + i  # NC(D)HW
+            out = _fractional_pool_axis(out, axis, vv.shape[axis],
+                                        out_sp[i], float(random_u))
+        return out
+
+    return apply(fn, x, op_name=f"fractional_max_pool{n}d")
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """reference: paddle.nn.functional.fractional_max_pool2d (NCHW).
+    Pseudo-random DISJOINT pooling regions from the fractional sequence;
+    deterministic given ``random_u``.  The reference's overlapping mode
+    (kernel_size set) is refused loudly rather than silently producing
+    disjoint-region numerics."""
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool2d(return_mask=True): indices of fractional "
+            "regions are not exposed")
+    if kernel_size is not None:
+        raise NotImplementedError(
+            "fractional_max_pool2d(kernel_size=...): overlapping fractional "
+            "pooling is not implemented; omit kernel_size for the disjoint "
+            "mode")
+    return _fractional_max_pool(x, output_size, 2, random_u, name)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """reference: paddle.nn.functional.fractional_max_pool3d (NCDHW); see
+    fractional_max_pool2d for the kernel_size/overlapping caveat."""
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool3d(return_mask=True): indices of fractional "
+            "regions are not exposed")
+    if kernel_size is not None:
+        raise NotImplementedError(
+            "fractional_max_pool3d(kernel_size=...): overlapping fractional "
+            "pooling is not implemented; omit kernel_size for the disjoint "
+            "mode")
+    return _fractional_max_pool(x, output_size, 3, random_u, name)

@@ -274,3 +274,37 @@ class Dropout1D(Layer):
         # mask varies on (N, C) and broadcasts along L: whole channels drop
         axis = [0, 1] if self.data_format == "NCL" else [0, 2]
         return F.dropout(x, self.p, axis=axis, training=self.training)
+
+
+class FeatureAlphaDropout(Layer):
+    """reference: paddle.nn.FeatureAlphaDropout — alpha dropout that drops
+    whole CHANNELS (feature maps) with SELU-preserving statistics."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        import jax
+        import jax.numpy as jnp
+
+        from ...framework import random as _rng
+        from ...tensor.dispatch import apply
+
+        p = self.p
+        key = _rng.next_key()
+
+        def fn(v):
+            # mask shape [N, C, 1, 1, ...]: one draw per feature map
+            shape = v.shape[:2] + (1,) * (v.ndim - 2)
+            keep = jax.random.bernoulli(key, 1.0 - p, shape)
+            alpha = 1.6732632423543772
+            scale = 1.0507009873554805
+            a_prime = -alpha * scale
+            a = ((1 - p) * (1 + p * a_prime ** 2)) ** -0.5
+            b = -a * a_prime * p
+            return (a * jnp.where(keep, v, a_prime) + b).astype(v.dtype)
+
+        return apply(fn, x, op_name="feature_alpha_dropout")

@@ -166,3 +166,41 @@ class LPPool2D(Layer):
         nt, k, s, p, cm, df = self.args
         return F.lp_pool2d(x, nt, k, stride=s, padding=p, ceil_mode=cm,
                            data_format=df)
+
+
+class FractionalMaxPool2D(Layer):
+    """reference: paddle.nn.FractionalMaxPool2D(output_size, random_u=None)."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        import random as _pyrand
+
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.return_mask = return_mask
+        # one draw per LAYER (reference: the region layout is fixed at
+        # construction when random_u is None)
+        self.random_u = random_u if random_u is not None else _pyrand.random()
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size, self.kernel_size,
+                                       self.random_u, self.return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    """reference: paddle.nn.FractionalMaxPool3D(output_size, random_u=None)."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        import random as _pyrand
+
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.return_mask = return_mask
+        self.random_u = random_u if random_u is not None else _pyrand.random()
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size, self.kernel_size,
+                                       self.random_u, self.return_mask)

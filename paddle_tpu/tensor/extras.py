@@ -539,3 +539,10 @@ _INPLACE_BIN_RULES = {
 
 for _n, _f in _INPLACE_BIN_RULES.items():
     globals().setdefault(_n + "_", _gen_inplace_bin(_n, _f))
+
+
+def tolist(x, name=None):
+    """reference: paddle.tolist(x) — nested Python list of the values."""
+    from .tensor import Tensor
+
+    return x.tolist() if isinstance(x, Tensor) else Tensor(x).tolist()

@@ -1,0 +1,233 @@
+"""r5 distribution-family completion (VERDICT r4 missing #4b): StudentT,
+Cauchy, Poisson, Chi2, MultivariateNormal, Independent,
+TransformedDistribution + transforms — log_prob/entropy/KL validated
+against torch.distributions as the oracle, sampling validated by moments.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+from paddle_tpu.distribution import transform as T
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+def test_student_t_log_prob_entropy_vs_torch():
+    df, loc, scale = 5.0, 1.5, 2.0
+    p = D.StudentT(df, loc, scale)
+    q = torch.distributions.StudentT(df, loc, scale)
+    v = np.linspace(-4, 7, 23).astype("float32")
+    np.testing.assert_allclose(_np(p.log_prob(paddle.to_tensor(v))),
+                               q.log_prob(torch.tensor(v)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(_np(p.entropy())),
+                               float(q.entropy()), rtol=1e-5)
+    paddle.seed(0)
+    s = _np(p.rsample([20000]))
+    assert abs(s.mean() - loc) < 0.15
+    # variance df/(df-2) * scale^2 = 6.67 — loose moment check
+    assert abs(s.var() - scale * scale * df / (df - 2)) < 1.5
+
+
+def test_cauchy_log_prob_entropy_cdf_kl_vs_torch():
+    p = D.Cauchy(0.5, 1.5)
+    q = torch.distributions.Cauchy(0.5, 1.5)
+    v = np.linspace(-8, 8, 17).astype("float32")
+    np.testing.assert_allclose(_np(p.log_prob(paddle.to_tensor(v))),
+                               q.log_prob(torch.tensor(v)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(_np(p.entropy())), float(q.entropy()),
+                               rtol=1e-5)
+    np.testing.assert_allclose(_np(p.cdf(paddle.to_tensor(v))),
+                               q.cdf(torch.tensor(v)).numpy(), rtol=1e-5,
+                               atol=1e-6)
+    p2 = D.Cauchy(2.0, 0.7)
+    # closed-form Cauchy KL: log[((g1+g2)^2 + (m1-m2)^2) / (4 g1 g2)]
+    want = np.log(((1.5 + 0.7) ** 2 + (0.5 - 2.0) ** 2) / (4 * 1.5 * 0.7))
+    np.testing.assert_allclose(float(_np(D.kl_divergence(p, p2))), want,
+                               rtol=1e-6)
+    paddle.seed(1)
+    s = _np(p.rsample([4000]))
+    assert abs(np.median(s) - 0.5) < 0.1  # median = loc (mean undefined)
+
+
+def test_poisson_log_prob_vs_torch():
+    rate = np.asarray([0.5, 3.0, 20.0], "float32")
+    p = D.Poisson(paddle.to_tensor(rate))
+    q = torch.distributions.Poisson(torch.tensor(rate))
+    v = np.asarray([[0.0, 2, 18], [1, 5, 30]], "float32")
+    np.testing.assert_allclose(_np(p.log_prob(paddle.to_tensor(v))),
+                               q.log_prob(torch.tensor(v)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_poisson_entropy_numpy_oracle():
+    rate = np.asarray([0.5, 3.0, 20.0], "float32")
+    p = D.Poisson(paddle.to_tensor(rate))
+    ent = _np(p.entropy())
+    import math
+
+    for i, lam in enumerate(rate):
+        k = np.arange(0, int(lam + 12 * np.sqrt(lam) + 30))
+        logpmf = k * np.log(lam) - lam - np.array(
+            [math.lgamma(x + 1) for x in k])
+        want = float(-(np.exp(logpmf) * logpmf).sum())
+        np.testing.assert_allclose(ent[i], want, rtol=1e-4, atol=1e-5)
+    # KL closed form
+    p2 = D.Poisson(paddle.to_tensor(np.asarray([1.0, 1.0, 10.0], "float32")))
+    want = rate * np.log(rate / np.asarray([1, 1, 10.0])) \
+        + np.asarray([1, 1, 10.0]) - rate
+    np.testing.assert_allclose(_np(D.kl_divergence(p, p2)), want, rtol=1e-5)
+    # sampling: mean ~ rate
+    paddle.seed(2)
+    s = _np(p.sample([4000]))
+    np.testing.assert_allclose(s.mean(0), rate, rtol=0.1)
+    with pytest.raises(NotImplementedError):
+        p.rsample()
+
+
+def test_chi2_log_prob_entropy_kl_vs_torch():
+    p = D.Chi2(paddle.to_tensor(np.asarray(4.0, "float32")))
+    q = torch.distributions.Chi2(torch.tensor(4.0))
+    v = np.linspace(0.2, 15, 19).astype("float32")
+    np.testing.assert_allclose(_np(p.log_prob(paddle.to_tensor(v))),
+                               q.log_prob(torch.tensor(v)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(_np(p.entropy())), float(q.entropy()),
+                               rtol=1e-5)
+    # KL rides the Gamma registration (Chi2 IS-A Gamma)
+    p2 = D.Chi2(paddle.to_tensor(np.asarray(7.0, "float32")))
+    qt = torch.distributions.kl_divergence(q, torch.distributions.Chi2(
+        torch.tensor(7.0)))
+    np.testing.assert_allclose(float(_np(D.kl_divergence(p, p2))),
+                               float(qt), rtol=1e-5)
+    paddle.seed(3)
+    s = _np(p.rsample([20000]))
+    assert abs(s.mean() - 4.0) < 0.2 and abs(s.var() - 8.0) < 0.8
+
+
+def test_mvn_log_prob_entropy_kl_vs_torch():
+    rs = np.random.RandomState(0)
+    A = rs.randn(3, 3).astype("float32")
+    cov = (A @ A.T + 3 * np.eye(3)).astype("float32")
+    loc = rs.randn(3).astype("float32")
+    p = D.MultivariateNormal(paddle.to_tensor(loc),
+                             covariance_matrix=paddle.to_tensor(cov))
+    q = torch.distributions.MultivariateNormal(
+        torch.tensor(loc), covariance_matrix=torch.tensor(cov))
+    v = rs.randn(6, 3).astype("float32")
+    np.testing.assert_allclose(_np(p.log_prob(paddle.to_tensor(v))),
+                               q.log_prob(torch.tensor(v)).numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(_np(p.entropy())), float(q.entropy()),
+                               rtol=1e-5)
+    B = rs.randn(3, 3).astype("float32")
+    cov2 = (B @ B.T + 2 * np.eye(3)).astype("float32")
+    loc2 = rs.randn(3).astype("float32")
+    p2 = D.MultivariateNormal(paddle.to_tensor(loc2),
+                              covariance_matrix=paddle.to_tensor(cov2))
+    q2 = torch.distributions.MultivariateNormal(
+        torch.tensor(loc2), covariance_matrix=torch.tensor(cov2))
+    np.testing.assert_allclose(
+        float(_np(D.kl_divergence(p, p2))),
+        float(torch.distributions.kl_divergence(q, q2)), rtol=1e-4)
+    # scale_tril / precision ctor agreement
+    L = np.linalg.cholesky(cov).astype("float32")
+    p3 = D.MultivariateNormal(paddle.to_tensor(loc),
+                              scale_tril=paddle.to_tensor(L))
+    prec = np.linalg.inv(cov).astype("float32")
+    p4 = D.MultivariateNormal(paddle.to_tensor(loc),
+                              precision_matrix=paddle.to_tensor(prec))
+    for alt in (p3, p4):
+        np.testing.assert_allclose(_np(alt.log_prob(paddle.to_tensor(v))),
+                                   _np(p.log_prob(paddle.to_tensor(v))),
+                                   rtol=1e-3, atol=1e-4)
+    # reparameterized sampling: empirical covariance converges
+    paddle.seed(4)
+    s = _np(p.rsample([30000]))
+    np.testing.assert_allclose(s.mean(0), loc, atol=0.06)
+    np.testing.assert_allclose(np.cov(s.T), cov, rtol=0.1, atol=0.12)
+
+
+def test_independent_sums_event_dims():
+    base = D.Normal(paddle.to_tensor(np.zeros((4, 3), "float32")),
+                    paddle.to_tensor(np.ones((4, 3), "float32")))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (4,) and ind.event_shape == (3,)
+    v = np.random.RandomState(0).randn(4, 3).astype("float32")
+    np.testing.assert_allclose(_np(ind.log_prob(paddle.to_tensor(v))),
+                               _np(base.log_prob(paddle.to_tensor(v))).sum(-1),
+                               rtol=1e-6)
+    np.testing.assert_allclose(_np(ind.entropy()),
+                               _np(base.entropy()).sum(-1), rtol=1e-6)
+
+
+def test_transformed_distribution_lognormal_equivalence():
+    """Normal pushed through ExpTransform == LogNormal (the canonical
+    change-of-variables identity)."""
+    loc, scale = 0.3, 0.8
+    td = D.TransformedDistribution(D.Normal(loc, scale), [T.ExpTransform()])
+    ln = D.LogNormal(loc, scale)
+    v = np.linspace(0.1, 6, 17).astype("float32")
+    np.testing.assert_allclose(_np(td.log_prob(paddle.to_tensor(v))),
+                               _np(ln.log_prob(paddle.to_tensor(v))),
+                               rtol=1e-5, atol=1e-6)
+    paddle.seed(5)
+    s = _np(td.rsample([20000]))
+    want_mean = np.exp(loc + scale * scale / 2)
+    assert abs(s.mean() - want_mean) < 0.1
+
+
+def test_transforms_roundtrip_and_logdet_vs_torch():
+    cases = [
+        (T.AffineTransform(1.0, 2.5),
+         torch.distributions.transforms.AffineTransform(1.0, 2.5),
+         np.linspace(-3, 3, 11)),
+        (T.ExpTransform(), torch.distributions.transforms.ExpTransform(),
+         np.linspace(-2, 2, 11)),
+        (T.SigmoidTransform(),
+         torch.distributions.transforms.SigmoidTransform(),
+         np.linspace(-3, 3, 11)),
+        (T.TanhTransform(), torch.distributions.transforms.TanhTransform(),
+         np.linspace(-2, 2, 11)),
+        (T.PowerTransform(2.0),
+         torch.distributions.transforms.PowerTransform(2.0),
+         np.linspace(0.2, 3, 11)),
+    ]
+    for ours, theirs, xs in cases:
+        xs = xs.astype("float32")
+        xt = paddle.to_tensor(xs)
+        y = _np(ours.forward(xt))
+        np.testing.assert_allclose(y, theirs(torch.tensor(xs)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(_np(ours.inverse(paddle.to_tensor(y))),
+                                   xs, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            _np(ours.forward_log_det_jacobian(xt)),
+            theirs.log_abs_det_jacobian(torch.tensor(xs),
+                                        theirs(torch.tensor(xs))).numpy(),
+            rtol=1e-5, atol=1e-6)
+    # chain: tanh(affine(x)) logdet adds
+    chain = T.ChainTransform([T.AffineTransform(0.5, 2.0), T.TanhTransform()])
+    xs = np.linspace(-1, 1, 9).astype("float32")
+    xt = paddle.to_tensor(xs)
+    direct = _np(T.AffineTransform(0.5, 2.0).forward_log_det_jacobian(xt)) + \
+        _np(T.TanhTransform().forward_log_det_jacobian(
+            T.AffineTransform(0.5, 2.0).forward(xt)))
+    np.testing.assert_allclose(_np(chain.forward_log_det_jacobian(xt)),
+                               direct, rtol=1e-6)
+
+
+def test_student_t_rsample_grad_flows():
+    """rsample is reparameterized: d E[x]/d loc exists through the tape."""
+    loc = paddle.to_tensor(np.asarray(1.0, "float32"), stop_gradient=False)
+    p = D.StudentT(4.0, loc, 1.0)
+    paddle.seed(6)
+    s = p.rsample([64])
+    s.mean().backward()
+    np.testing.assert_allclose(_np(loc.grad), 1.0, rtol=1e-5)

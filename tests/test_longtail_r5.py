@@ -1,0 +1,90 @@
+"""r5 layer/op long-tail closure (VERDICT r4 missing #4): the last
+genuinely-absent reference surfaces — fractional max pooling,
+FeatureAlphaDropout, AdaptiveLogSoftmaxWithLoss, paddle.tolist."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+
+def test_tolist_top_level():
+    assert paddle.tolist(paddle.to_tensor(np.arange(4))) == [0, 1, 2, 3]
+    assert paddle.tolist(np.asarray([[1.5, 2.5]]))[0] == [1.5, 2.5]
+
+
+def test_fractional_max_pool_2d_3d():
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(2, 3, 9, 9).astype("float32"))
+    layer = nn.FractionalMaxPool2D(output_size=5, random_u=0.3)
+    out = layer(x)
+    assert tuple(out.shape) == (2, 3, 5, 5)
+    # deterministic given random_u; layer == functional
+    np.testing.assert_array_equal(
+        out.numpy(), F.fractional_max_pool2d(x, 5, random_u=0.3).numpy())
+    # region maxes: every output equals the max of SOME input window —
+    # oracle via the boundary formula
+    from paddle_tpu.nn.functional.pooling import _fractional_boundaries
+
+    b = _fractional_boundaries(9, 5, 0.3)
+    xn = x.numpy()
+    want = np.stack([
+        np.stack([xn[:, :, b[i]:b[i + 1], b[j]:b[j + 1]].max((-1, -2))
+                  for j in range(5)], -1)
+        for i in range(5)], -2)
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-6)
+    # different random_u -> different region layout (usually)
+    out2 = F.fractional_max_pool2d(x, 5, random_u=0.9)
+    assert out2.shape == out.shape
+    x3 = paddle.to_tensor(rs.randn(1, 2, 8, 8, 8).astype("float32"))
+    assert tuple(nn.FractionalMaxPool3D(4, random_u=0.5)(x3).shape) \
+        == (1, 2, 4, 4, 4)
+    with pytest.raises(ValueError):
+        F.fractional_max_pool2d(x, 5, random_u=1.5)
+    with pytest.raises(NotImplementedError):
+        F.fractional_max_pool2d(x, 5, random_u=0.5, return_mask=True)
+
+
+def test_feature_alpha_dropout_channelwise():
+    rs = np.random.RandomState(1)
+    fad = nn.FeatureAlphaDropout(0.4)
+    fad.train()
+    paddle.seed(0)
+    x = paddle.to_tensor(rs.randn(8, 16, 6, 6).astype("float32"))
+    y = fad(x).numpy()
+    stds = y.reshape(8, 16, -1).std(-1)
+    # dropped feature maps collapse to a constant; kept ones keep variance
+    assert (stds < 1e-6).any() and (stds > 0.5).any()
+    fad.eval()
+    np.testing.assert_array_equal(fad(x).numpy(), x.numpy())
+
+
+def test_adaptive_log_softmax_with_loss():
+    rs = np.random.RandomState(2)
+    m = nn.AdaptiveLogSoftmaxWithLoss(16, 40, cutoffs=[8, 24], div_value=2.0)
+    x = paddle.to_tensor(rs.randn(12, 16).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 40, (12,)).astype("int64"))
+    lp = m.log_prob(x).numpy()
+    assert lp.shape == (12, 40)
+    np.testing.assert_allclose(np.exp(lp).sum(-1), 1.0, rtol=2e-4)
+    out, loss = m(x, y)
+    np.testing.assert_allclose(out.numpy(), lp[np.arange(12), y.numpy()],
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(loss.numpy()), -out.numpy().mean(),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(m.predict(x).numpy(), lp.argmax(-1))
+    # the hierarchy trains end to end
+    o = opt.Adam(learning_rate=1e-2, parameters=m.parameters())
+    losses = []
+    for _ in range(10):
+        _, loss = m(x, y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    with pytest.raises(ValueError):
+        nn.AdaptiveLogSoftmaxWithLoss(16, 40, cutoffs=[24, 8])
