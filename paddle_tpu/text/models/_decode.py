@@ -39,13 +39,21 @@ def make_sampler(temperature, top_k, top_p):
 
 
 def decode_loop(model, fwd, ids0, max_new_tokens, init_cache,
-                temperature=1.0, top_k=0, top_p=1.0, seed=None):
+                temperature=1.0, top_k=0, top_p=1.0, seed=None,
+                program_key=None):
     """Generic prefill + per-token decode over an arbitrary cache PYTREE.
 
     fwd(params, bufs, ids, cache, pos) -> (last-token logits f32, cache).
     The cache (dense [L,B,T,h,d] buffers, paged pools, anything jax) is
     DONATED into each compiled step, so decode state updates in-place in
     HBM.  Returns the full id matrix.
+
+    program_key: when the caller can name everything its fwd closure is
+    specialized on (cache impl, shapes, sampling params — see generate()),
+    the compiled prefill/step pair is CACHED on the model and reused by
+    later calls.  Without it every generate() call re-traced and
+    re-compiled both programs, which dominated short decodes (~30s compile
+    vs ms/token through a tunneled chip).
     """
     import numpy as np
 
@@ -54,17 +62,32 @@ def decode_loop(model, fwd, ids0, max_new_tokens, init_cache,
     bufs = {k: b._value for k, b in model.named_buffers()}
     modes = [(m, m.training) for m in model.sublayers(include_self=True)]
     model.eval()
-    sample = make_sampler(temperature, top_k, top_p)
 
-    @jax.jit
-    def prefill(params, bufs, ids, cache, key):
-        logits, cache = fwd(params, bufs, ids, cache, jnp.int32(0))
-        return sample(logits, key), cache
+    progs = None
+    store = None
+    if program_key is not None:
+        store = model.__dict__.get("_decode_programs")
+        if store is None:
+            store = {}
+            object.__setattr__(model, "_decode_programs", store)
+        progs = store.get(program_key)
+    if progs is None:
+        sample = make_sampler(temperature, top_k, top_p)
 
-    @functools.partial(jax.jit, donate_argnums=(3,))
-    def step(params, bufs, last, cache, pos, key):
-        logits, cache = fwd(params, bufs, last, cache, pos)
-        return sample(logits, key), cache
+        @jax.jit
+        def prefill(params, bufs, ids, cache, key):
+            logits, cache = fwd(params, bufs, ids, cache, jnp.int32(0))
+            return sample(logits, key), cache
+
+        @functools.partial(jax.jit, donate_argnums=(3,))
+        def step(params, bufs, last, cache, pos, key):
+            logits, cache = fwd(params, bufs, last, cache, pos)
+            return sample(logits, key), cache
+
+        progs = (prefill, step)
+        if store is not None:
+            store[program_key] = progs
+    prefill, step = progs
 
     try:
         cache = init_cache()
@@ -89,7 +112,8 @@ def decode_loop(model, fwd, ids0, max_new_tokens, init_cache,
 
 
 def jitted_decode(model, fwd, ids0, max_new_tokens, cache_shape, cache_dtype,
-                  temperature=1.0, top_k=0, top_p=1.0, seed=None):
+                  temperature=1.0, top_k=0, top_p=1.0, seed=None,
+                  program_key=None):
     """Dense-cache decode (the original API): zero-initialized K/V buffers
     [L, B, T, h, d]; fwd takes (params, bufs, ids, ks, vs, pos)."""
 
@@ -104,7 +128,7 @@ def jitted_decode(model, fwd, ids0, max_new_tokens, cache_shape, cache_dtype,
 
     return decode_loop(model, fwd_cache, ids0, max_new_tokens, init_cache,
                        temperature=temperature, top_k=top_k, top_p=top_p,
-                       seed=seed)
+                       seed=seed, program_key=program_key)
 
 
 def paged_pool_shape(batch, max_len, num_kv_heads, head_dim, page_size=16):

@@ -327,7 +327,10 @@ class GPTForCausalLM(Layer):
 
             return decode_loop(self, fwd_paged, ids0, max_new_tokens,
                                init_cache, temperature=temperature,
-                               top_k=top_k, top_p=top_p, seed=seed)
+                               top_k=top_k, top_p=top_p, seed=seed,
+                               program_key=("paged", B, S0, T, page_size,
+                                            temperature, top_k, top_p,
+                                            bool(self.training)))
         if cache_impl != "dense":
             raise ValueError(f"cache_impl must be 'dense' or 'paged', "
                              f"got {cache_impl!r}")
@@ -351,7 +354,9 @@ class GPTForCausalLM(Layer):
         return jitted_decode(self, fwd, ids0, max_new_tokens,
                              (L, B, T, h_heads, blk.head_dim), dt,
                              temperature=temperature, top_k=top_k,
-                             top_p=top_p, seed=seed)
+                             top_p=top_p, seed=seed,
+                             program_key=("dense", B, S0, T, temperature,
+                                          top_k, top_p, bool(self.training)))
 
     def _generate_eager(self, input_ids, max_new_tokens=32, temperature=1.0,
                         top_k=0, top_p=1.0, seed=None):

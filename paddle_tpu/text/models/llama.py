@@ -428,7 +428,10 @@ class LlamaForCausalLM(Layer):
 
             return decode_loop(self, fwd_paged, ids0, max_new_tokens,
                                init_cache, temperature=temperature,
-                               top_k=top_k, top_p=top_p, seed=seed)
+                               top_k=top_k, top_p=top_p, seed=seed,
+                               program_key=("paged", B, S0, T, page_size,
+                                            temperature, top_k, top_p,
+                                            bool(self.training)))
         if cache_impl != "dense":
             raise ValueError(f"cache_impl must be 'dense' or 'paged', "
                              f"got {cache_impl!r}")

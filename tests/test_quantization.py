@@ -158,3 +158,35 @@ def test_int8_deploy_bert_classify_head():
         agree += (got.argmax(-1) == ref.argmax(-1)).sum()
         tot += ref.shape[0]
     assert agree / tot >= 0.95, (agree, tot)
+
+
+def test_int8_model_exports_and_serves_via_predictor(tmp_path):
+    """The full deploy chain (r4 missing #3 done-criterion): PTQ scales ->
+    convert_to_int8 -> jit.save StableHLO -> paddle.inference predictor,
+    numerics preserved through the artifact (int8 weights ride it)."""
+    from paddle_tpu import inference
+    from paddle_tpu.quantization import PTQ, QuantConfig, convert_to_int8
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(8, 8).astype("float32"))
+    ptq = PTQ(QuantConfig())
+    q = ptq.quantize(m)
+    q(x)  # calibrate
+    q = convert_to_int8(ptq.convert(q))
+    want = q(x).numpy()
+
+    path = str(tmp_path / "int8_model")
+    paddle.jit.save(q, path, input_spec=[InputSpec([None, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(loaded(x).numpy(), want, rtol=1e-5, atol=1e-6)
+
+    cfg = inference.Config(path)
+    pred = inference.create_predictor(cfg)
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(x.numpy())
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
