@@ -195,6 +195,40 @@ def _run_section(name):
     raise ValueError(name)
 
 
+def _flatten(obj, prefix=""):
+    """BENCH result dict -> flat (dotted-path, number) pairs."""
+    out = []
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.extend(_flatten(v, f"{prefix}.{k}" if prefix else str(k)))
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            out.extend(_flatten(v, f"{prefix}.{i}"))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        out.append((prefix, float(obj)))
+    return out
+
+
+def emit_metrics(result, out_dir=None, registry=None):
+    """Route a BENCH result dict through the profiler.metrics registry so
+    BENCH_*.json and the metrics exporters share one schema: every numeric
+    leaf becomes a ``bench`` gauge labelled with its dotted path, exported
+    as metrics.jsonl (+ metrics.prom).  Returns the jsonl path (or None
+    when no out_dir/PADDLE_METRICS_DIR is set)."""
+    import os
+
+    from paddle_tpu.profiler import metrics as _metrics
+
+    reg = registry if registry is not None else _metrics.get_registry()
+    g = reg.gauge("bench", "benchmark result leaves (labelled by path)")
+    for path, value in _flatten(result):
+        g.set(value, path=path)
+    d = out_dir or os.environ.get("PADDLE_METRICS_DIR")
+    if not d:
+        return None
+    return reg.export_snapshot(d)
+
+
 def main():
     import os
 
@@ -295,6 +329,20 @@ def main():
         },
     }
     print(json.dumps(out))
+    if "--emit-metrics" in sys.argv:
+        path = emit_metrics(out, out_dir=_metrics_dir_from_argv())
+        if path is None:
+            print("--emit-metrics: no --metrics-dir/PADDLE_METRICS_DIR set; "
+                  "nothing written", file=sys.stderr)
+
+
+def _metrics_dir_from_argv():
+    for i, a in enumerate(sys.argv):
+        if a == "--metrics-dir" and i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+        if a.startswith("--metrics-dir="):
+            return a.split("=", 1)[1]
+    return None  # emit_metrics falls back to PADDLE_METRICS_DIR
 
 
 if __name__ == "__main__":

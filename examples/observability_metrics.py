@@ -1,0 +1,91 @@
+"""Observability walkthrough: profile a fused TrainStep run, print the
+per-op summary table, and get per-epoch metrics (compiles / retraces /
+MFU / dataloader stall) from ``Model.fit`` for free.
+
+    PADDLE_METRICS_DIR=/tmp/obs python examples/observability_metrics.py
+    # -> /tmp/obs/metrics.jsonl, metrics.prom, train_metrics.jsonl,
+    #    plus a chrome trace (host events) and the XPlane device trace
+
+Env knobs (README "Observability"): PADDLE_PROFILER_DIR,
+PADDLE_METRICS_DIR, PADDLE_METRICS_FLUSH_SECS, PADDLE_TRAINSTEP_COST,
+PADDLE_PEAK_FLOPS.
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+import paddle_tpu.profiler as profiler
+
+
+def profile_train_step(steps, batch):
+    """Profiler around a TrainStep loop: scheduler-driven device trace +
+    host op timers -> summary table + chrome-trace export."""
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(64, 256), nn.ReLU(), nn.Linear(256, 10))
+    optim = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, optim, loss_fn=nn.CrossEntropyLoss())
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(batch, 64).astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1)
+                         .randint(0, 10, (batch,)).astype("int64"))
+
+    trace_dir = os.environ.get("PADDLE_PROFILER_DIR", "/tmp/paddle_tpu_trace")
+    p = profiler.Profiler(
+        scheduler=profiler.make_scheduler(closed=1, ready=1,
+                                          record=steps - 2, repeat=1),
+        on_trace_ready=profiler.export_chrome_tracing(trace_dir))
+    with p:
+        for _ in range(steps):
+            float(step(x, y))
+            p.step(num_samples=batch)
+    p.summary(sorted_by="total")          # per-op table to stdout
+    print("step cost:", step.cost_analysis())  # XLA flops/bytes of the step
+
+    loaded = profiler.load_profiler_result(trace_dir)
+    print(f"reloaded {len(loaded.events)} host events from {loaded.path}")
+
+
+def fit_with_metrics_logger(epochs, batch):
+    """Model.fit users get the observability table via one callback."""
+    from paddle_tpu.io import TensorDataset
+
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 4))
+    model = paddle.Model(net)
+    model.prepare(optimizer=opt.Adam(learning_rate=1e-3,
+                                     parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss())
+    ds = TensorDataset([np.random.RandomState(0)
+                        .randn(256, 16).astype("float32"),
+                        np.random.RandomState(1)
+                        .randint(0, 4, (256,)).astype("int64")])
+    model.fit(ds, batch_size=batch, epochs=epochs, verbose=0, shuffle=False,
+              callbacks=[paddle.callbacks.MetricsLoggerCallback()])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    args = ap.parse_args()
+
+    profile_train_step(args.steps, args.batch_size)
+    fit_with_metrics_logger(args.epochs, args.batch_size)
+
+    from paddle_tpu.profiler import metrics
+
+    d = metrics.flush()  # one explicit snapshot (flusher also runs if env set)
+    if d:
+        print(f"metrics snapshot in {d}/metrics.jsonl and {d}/metrics.prom")
+    else:
+        print("set PADDLE_METRICS_DIR to export metrics snapshots")
+
+
+if __name__ == "__main__":
+    main()

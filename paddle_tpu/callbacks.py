@@ -2,6 +2,7 @@
 re-export of the hapi callback classes)."""
 
 from .hapi.callbacks import (  # noqa: F401
-    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
-    ReduceLROnPlateau, VisualDL, WandbCallback,
+    Callback, EarlyStopping, LRScheduler, MetricsLoggerCallback,
+    ModelCheckpoint, ProgBarLogger, ReduceLROnPlateau, VisualDL,
+    WandbCallback,
 )

@@ -23,6 +23,14 @@ _GROUPS: dict[int, "Group"] = {}
 _NEXT_GID = [0]
 
 
+def _gauge_groups():
+    from ..profiler import metrics as _metrics
+
+    _metrics.get_registry().gauge(
+        "collective.groups_active",
+        "live communication groups in the registry").set(len(_GROUPS))
+
+
 class ReduceOp:
     SUM = 0
     MAX = 1
@@ -99,6 +107,12 @@ def new_group(ranks=None, backend=None, timeout=None, axis_name=None) -> Group:
     gid = _NEXT_GID[0]
     g = Group(ranks, gid, axis_name=axis_name or f"g{gid}")
     _GROUPS[gid] = g
+    from ..profiler import metrics as _metrics
+
+    _metrics.get_registry().counter(
+        "collective.groups_created", "new_group() calls").inc(
+        nranks=g.nranks)
+    _gauge_groups()
     return g
 
 
@@ -109,6 +123,7 @@ def destroy_process_group(group=None):
         _GROUPS.clear()
     else:
         _GROUPS.pop(group.id, None)
+    _gauge_groups()
 
 
 def is_available() -> bool:

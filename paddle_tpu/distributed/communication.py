@@ -26,6 +26,7 @@ TPU-native semantics (single-controller SPMD — SURVEY.md §5.8):
 from __future__ import annotations
 
 import functools
+from time import perf_counter
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +46,7 @@ except ImportError:  # older jax
         return _shard_map_old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                               check_rep=False)
 
+from ..profiler import metrics as _metrics
 from ..tensor.tensor import Tensor
 from .collective import Group, ReduceOp, get_default_group
 
@@ -142,14 +144,48 @@ def _stacked(v, g: Group) -> bool:
     return v.ndim >= 1 and v.shape[0] == g.nranks and g.nranks > 1
 
 
+def _nbytes(v):
+    try:
+        return int(v.size) * jnp.dtype(v.dtype).itemsize
+    except Exception:
+        return 0
+
+
+def record_collective_traffic(op_name, nranks, nbytes, t0=None, phase="eager"):
+    """THE per-collective accounting sink (profiler.metrics): op, bytes
+    moved, participant count, latency.  Shared by the eager collectives
+    here and the trace-time recorders in fleet.meta_parallel (mp layers,
+    pipeline ppermute) so the {op, phase, nranks} series stays one schema.
+    ``phase='traced'`` fires once per trace — it counts programs built and
+    their per-execution payload, not executions (those live inside the
+    compiled module where the host can't see them)."""
+    reg = _metrics.get_registry()
+    labels = {"op": op_name, "phase": phase, "nranks": nranks}
+    reg.counter("collective.calls", "collective invocations").inc(**labels)
+    if nbytes:
+        reg.counter("collective.bytes",
+                    "payload bytes through collectives").inc(nbytes, **labels)
+    if t0 is not None:
+        reg.histogram("collective.latency_seconds",
+                      "eager collective dispatch latency").observe(
+            perf_counter() - t0, op=op_name)
+
+
+def _record_collective(op_name, g, v, t0=None, phase="eager"):
+    record_collective_traffic(op_name, g.nranks, _nbytes(v), t0, phase)
+
+
 # ------------------------------------------------------------------ public API
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=False):
     g = _group(group)
     v = _unwrap(tensor)
     if _is_traced(v):
         out = _reduce_traced(v, op, g.axis_name)
+        _record_collective("all_reduce", g, v, phase="traced")
     elif _stacked(v, g):
+        t0 = perf_counter()
         out = _jitted(g, "all_reduce", op)(_to_group_sharded(v, g))
+        _record_collective("all_reduce", g, v, t0)
     else:  # replicated single-controller value
         n = g.nranks
         out = {ReduceOp.SUM: v * n, ReduceOp.PROD: v ** n}.get(op, v)
@@ -164,9 +200,12 @@ def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
     v = _unwrap(tensor)
     if _is_traced(v):
         out = _reduce_traced(v, op, g.axis_name)
+        _record_collective("reduce", g, v, phase="traced")
     elif _stacked(v, g):
+        t0 = perf_counter()
         out = _jitted(g, "reduce", op, dst=g.get_group_rank(dst) if dst in g.ranks else dst)(
             _to_group_sharded(v, g))
+        _record_collective("reduce", g, v, t0)
     else:
         n = g.nranks
         out = {ReduceOp.SUM: v * n, ReduceOp.PROD: v ** n}.get(op, v)
@@ -183,11 +222,14 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     v = _unwrap(tensor)
     if _is_traced(v):
         out = lax.all_gather(v, g.axis_name, axis=0)
+        _record_collective("all_gather", g, v, phase="traced")
         if tensor_list is not None:
             tensor_list.extend(Tensor(out[i]) for i in range(g.nranks))
         return Tensor(out)
     if _stacked(v, g):
+        t0 = perf_counter()
         full = _jitted(g, "all_gather")(_to_group_sharded(v, g))
+        _record_collective("all_gather", g, v, t0)
     else:
         full = jnp.stack([v] * g.nranks)
     if tensor_list is not None:
@@ -250,8 +292,11 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
             full = _reduce_traced(v, op, ax)
             out = lax.dynamic_index_in_dim(full, lax.axis_index(ax), axis=0,
                                            keepdims=False)
+        _record_collective("reduce_scatter", g, v, phase="traced")
     elif v.ndim >= 2 and v.shape[0] == g.nranks and v.shape[1] == g.nranks:
+        t0 = perf_counter()
         out = _jitted(g, "reduce_scatter", op)(_to_group_sharded(v, g))
+        _record_collective("reduce_scatter", g, v, t0)
     else:
         out = v
     if isinstance(tensor, Tensor):
@@ -267,8 +312,11 @@ def broadcast(tensor, src, group=None, sync_op=True):
     if _is_traced(v):
         full = lax.all_gather(v, g.axis_name, axis=0)
         out = full[src_local]
+        _record_collective("broadcast", g, v, phase="traced")
     elif _stacked(v, g):
+        t0 = perf_counter()
         out = _jitted(g, "broadcast", src=src_local)(_to_group_sharded(v, g))
+        _record_collective("broadcast", g, v, t0)
     else:
         out = v
     if isinstance(tensor, Tensor):
@@ -302,8 +350,11 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         v = _unwrap(in_tensor_list)
     if _is_traced(v):
         out = lax.all_to_all(v, g.axis_name, split_axis=0, concat_axis=0, tiled=True)
+        _record_collective("alltoall", g, v, phase="traced")
     elif v.ndim >= 2 and v.shape[0] == g.nranks and v.shape[1] == g.nranks:
+        t0 = perf_counter()
         out = _jitted(g, "alltoall")(_to_group_sharded(v, g))
+        _record_collective("alltoall", g, v, t0)
     else:
         out = v
     if isinstance(out_tensor_list, list):
@@ -318,10 +369,13 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
     n = g.nranks
     if _is_traced(v):
         out = lax.all_to_all(v, g.axis_name, split_axis=0, concat_axis=0, tiled=True)
+        _record_collective("alltoall_single", g, v, phase="traced")
     elif v.ndim >= 1 and v.shape[0] == n * n:
         # stacked layout [n*n, ...]: rank j holds rows [j*n, (j+1)*n)
+        t0 = perf_counter()
         v2 = v.reshape((n, n) + tuple(v.shape[1:]))
         out = _jitted(g, "alltoall")(_to_group_sharded(v2, g)).reshape(v.shape)
+        _record_collective("alltoall_single", g, v, t0)
     else:
         out = v
     if isinstance(out_tensor, Tensor):
@@ -359,6 +413,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     g = _group(group)
     src = jax.process_index()
     q = _MAILBOX.setdefault((src, g.id), [])
+    _record_collective("send", g, _unwrap(tensor))
     q.append(_unwrap(tensor))
     if len(q) > 64:  # bound the shim: unmatched sends must not leak HBM
         q.pop(0)
@@ -397,9 +452,11 @@ def barrier(group=None):
     g = _group(group)
     if g.nranks <= 1:
         return
+    t0 = perf_counter()
     one = jnp.ones((g.nranks,), jnp.int32)
     out = _jitted(g, "all_reduce", ReduceOp.SUM)(_to_group_sharded(one, g))
     jax.block_until_ready(out)
+    _record_collective("barrier", g, one, t0)
 
 
 class stream:

@@ -42,6 +42,20 @@ def _in_manual_mp() -> bool:
         return False
 
 
+def _record_mp(op_name, t, nbytes=None):
+    """Trace-time accounting for manual-region TP collectives (routes
+    through communication.record_collective_traffic — one schema)."""
+    try:
+        from ...communication import _nbytes, record_collective_traffic
+
+        v = t._value if isinstance(t, Tensor) else t
+        nb = nbytes if nbytes is not None else _nbytes(v)
+        record_collective_traffic(op_name, int(jax.lax.axis_size("mp")), nb,
+                                  phase="traced")
+    except Exception:
+        pass
+
+
 def _shard_param(p, spec, mesh):
     if mesh is not None:
         p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
@@ -84,6 +98,7 @@ class ColumnParallelLinear(Layer):
             # manual region: weight/bias are the local column shards
             y = F.linear(x, self.weight, self.bias)
             if self.gather_output:
+                _record_mp("mp_all_gather", y)
                 y = _apply(lambda v: jax.lax.all_gather(v, "mp", axis=v.ndim - 1,
                                                         tiled=True),
                            y, op_name="mp_all_gather")
@@ -132,6 +147,7 @@ class RowParallelLinear(Layer):
 
                 x = _apply(scatter, x, op_name="mp_scatter")
             y = F.linear(x, self.weight)
+            _record_mp("mp_allreduce", y)
             y = _apply(lambda v: jax.lax.psum(v, "mp"), y, op_name="mp_allreduce")
             if self.bias is not None:
                 y = y + self.bias
@@ -178,6 +194,10 @@ class VocabParallelEmbedding(Layer):
                 out = jnp.where(ok[..., None], out, 0)
                 return jax.lax.psum(out, "mp")
 
+            # the psum moves the [*, H] embedding output, not the ids
+            _record_mp("vocab_parallel_embedding_psum", x,
+                       nbytes=int(x.size) * self._embedding_dim
+                       * jnp.dtype(self.weight.dtype).itemsize)
             return _apply(fn, x, self.weight, op_name="vocab_parallel_embedding")
         y = F.embedding(x, self.weight)
         spec_tail = (None,) * (y.ndim - 1)

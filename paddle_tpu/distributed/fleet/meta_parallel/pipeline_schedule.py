@@ -134,7 +134,29 @@ def spmd_pipeline(block_fn, stacked_params, x_micro, mesh, axis="pp",
         in_specs=(in_param_specs, P(*bspec)),
         out_specs=P(*bspec),
     )
+    if schedule == "interleaved":
+        v = jax.tree_util.tree_leaves(stacked_params)[0].shape[1]
+        ticks = v * M + S - 1  # the interleaved body's scan length T
+    else:
+        ticks = M + S - 1
+    _record_pp_bytes(x_micro, S, ticks)
     return mapped(stacked_params, x_micro)
+
+
+def _record_pp_bytes(x_micro, S, ticks):
+    """Observability: one ring hop of a micro-batch per scan tick
+    (trace-time accounting — forward-pass bytes the program will move per
+    execution; the backward's reverse rotation is not counted).  Routes
+    through communication.record_collective_traffic — one schema."""
+    try:
+        from ...communication import _nbytes, record_collective_traffic
+
+        mb_bytes = _nbytes(
+            jax.ShapeDtypeStruct(x_micro.shape[1:], x_micro.dtype))
+        record_collective_traffic("pp_ppermute", S, mb_bytes * ticks,
+                                  phase="traced")
+    except Exception:
+        pass
 
 
 def _gpipe_body(fn, S, M, axis):
@@ -248,6 +270,7 @@ def spmd_pipeline_1f1b(block_fn, stacked_params, x_micro, mesh, axis="pp",
     bspec = (None, batch_axis) if batch_axis else (None,)
     in_param_specs = param_specs if param_specs is not None else \
         jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    _record_pp_bytes(x_micro, S, M + S - 1)
 
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
     rev_perm = [((i + 1) % S, i) for i in range(S)]

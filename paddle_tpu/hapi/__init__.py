@@ -4,5 +4,6 @@ from .model import Model  # noqa: F401
 from .model_summary import summary, flops  # noqa: F401
 from . import callbacks  # noqa: F401
 from .callbacks import (  # noqa: F401
-    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, VisualDL,
+    Callback, MetricsLoggerCallback, ProgBarLogger, ModelCheckpoint,
+    EarlyStopping, VisualDL,
 )

@@ -327,6 +327,117 @@ class ReduceLROnPlateau(Callback):
             self._cooling = self.cooldown
 
 
+class MetricsLoggerCallback(Callback):
+    """Observability surface for ``Model.fit``: per-epoch summary table of
+    throughput + the process-wide metrics registry (TrainStep compile /
+    retrace / MFU, dataloader stall split, collective bytes), plus a JSONL
+    record per epoch.
+
+    Counters are reported as per-epoch DELTAS (the registry is process-
+    wide and monotonic); gauges as their current value.  Files written
+    under ``log_dir`` (default: PADDLE_METRICS_DIR or ./log):
+
+    - ``train_metrics.jsonl``: one line per epoch (this callback's rows)
+    - ``metrics.prom``: latest full registry snapshot, Prometheus text
+
+    Usage::
+
+        model.fit(data, callbacks=[paddle.callbacks.MetricsLoggerCallback()])
+    """
+
+    # counters whose per-epoch delta is worth a table row
+    _COUNTERS = ("train_step.compiles", "train_step.retraces",
+                 "dataloader.host_wait_seconds", "dataloader.consumer_seconds",
+                 "dataloader.batches", "collective.bytes", "collective.calls")
+    _GAUGES = ("train_step.compile_seconds", "train_step.donated_bytes",
+               "train_step.flops_per_step", "train_step.achieved_tflops",
+               "train_step.mfu")
+
+    def __init__(self, log_dir=None, registry=None, verbose=1):
+        super().__init__()
+        self.log_dir = log_dir or os.environ.get("PADDLE_METRICS_DIR", "./log")
+        self._registry_override = registry
+        self.verbose = verbose
+        self._baseline = {}
+        self._epoch_steps = 0
+        self._t0 = None
+
+    def _registry(self):
+        if self._registry_override is not None:
+            return self._registry_override
+        from ..profiler import metrics as _metrics
+
+        return _metrics.get_registry()
+
+    def _counter_total(self, name):
+        m = self._registry().get(name)
+        return m.total() if m is not None else 0.0
+
+    def _gauge_value(self, name):
+        m = self._registry().get(name)
+        return m.get() if m is not None else None
+
+    # ------------------------------------------------------------ lifecycle
+    def on_epoch_begin(self, epoch, logs=None):
+        self._t0 = time.time()
+        self._epoch_steps = 0
+        self._baseline = {n: self._counter_total(n) for n in self._COUNTERS}
+
+    def on_train_batch_end(self, step, logs=None):
+        self._epoch_steps += 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        dt = time.time() - (self._t0 or time.time())
+        row = {"epoch": epoch, "steps": self._epoch_steps,
+               "epoch_time_s": round(dt, 4)}
+        if self._epoch_steps:
+            row["avg_step_ms"] = round(1e3 * dt / self._epoch_steps, 3)
+        for k, v in (logs or {}).items():
+            if isinstance(v, numbers.Number):
+                row[k] = float(v)
+        for n in self._COUNTERS:
+            row[n] = self._counter_total(n) - self._baseline.get(n, 0.0)
+        for n in self._GAUGES:
+            v = self._gauge_value(n)
+            if v is not None:
+                row[n] = v
+        self._write(row)
+        if self.verbose:
+            self._print_table(row)
+
+    def on_train_end(self, logs=None):
+        try:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._registry().export_prometheus(
+                os.path.join(self.log_dir, "metrics.prom"))
+        except OSError:
+            pass
+
+    # -------------------------------------------------------------- output
+    def _write(self, row):
+        import json
+
+        try:
+            os.makedirs(self.log_dir, exist_ok=True)
+            with open(os.path.join(self.log_dir, "train_metrics.jsonl"), "a") as f:
+                f.write(json.dumps(row) + "\n")
+        except OSError:
+            pass
+
+    def _print_table(self, row):
+        w = max(len(k) for k in row) + 2
+        sep = "-" * (w + 14)
+        lines = [sep, f"observability | epoch {row['epoch']}", sep]
+        for k, v in row.items():
+            if k == "epoch":
+                continue
+            if isinstance(v, float):
+                v = f"{v:.6g}"
+            lines.append(f"{k.ljust(w)}{v}")
+        lines.append(sep)
+        print("\n".join(lines))
+
+
 class WandbCallback(Callback):
     """Weights & Biases logging (reference: paddle.callbacks.WandbCallback).
     Requires the wandb package (not bundled here — no network egress);

@@ -17,6 +17,7 @@ import math
 import numpy as np
 
 from ..framework import random as _rng
+from ..profiler import metrics as _metrics
 from ..tensor.tensor import Tensor
 
 
@@ -291,6 +292,37 @@ class DataLoader:
                                               batch_size=batch_size, drop_last=drop_last)
 
     def __iter__(self):
+        """Wraps the raw batch iterator with stall accounting: time spent
+        producing the next batch is ``dataloader.host_wait_seconds`` (input
+        pipeline stall); time between our yield and the next request is
+        ``dataloader.consumer_seconds`` (the training step — device time
+        under async dispatch).  The ratio is THE dataloader-bound-or-not
+        diagnostic."""
+        from time import perf_counter
+
+        reg = _metrics.get_registry()
+        m_wait = reg.counter("dataloader.host_wait_seconds",
+                             "time the consumer waited on batch production"
+                             ).labels()
+        m_consumer = reg.counter("dataloader.consumer_seconds",
+                                 "time the consumer held each batch "
+                                 "(train/device work between requests)"
+                                 ).labels()
+        m_batches = reg.counter("dataloader.batches", "batches yielded").labels()
+        it = self._batches()
+        while True:
+            t0 = perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            t1 = perf_counter()
+            m_wait.inc(t1 - t0)
+            m_batches.inc()
+            yield batch
+            m_consumer.inc(perf_counter() - t1)
+
+    def _batches(self):
         if self._iterable:
             it = iter(self.dataset)
             while True:

@@ -23,7 +23,10 @@ in place exactly like the reference.
 
 from __future__ import annotations
 
+import os
+import warnings
 from collections import OrderedDict
+from time import perf_counter
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +34,32 @@ import jax.numpy as jnp
 from ..framework import random as _rng
 from ..framework.state import no_grad_ctx
 from ..optimizer.lr import LRScheduler
+from ..profiler import events as _prof_events
+from ..profiler import metrics as _metrics
 from ..tensor.tensor import Tensor
+
+# bf16 datasheet peaks per chip generation, for the MFU gauge (BENCH
+# convention: the v5e int8 TOPS line is NOT the bf16 peak).  Override with
+# PADDLE_PEAK_FLOPS (FLOP/s) — required on the CPU test mesh.
+_PEAK_BF16_FLOPS = {"v6": 918e12, "v5p": 459e12, "v5 lite": 197e12,
+                    "v5e": 197e12, "v4": 275e12, "v3": 123e12, "v2": 45e12}
+
+
+def _peak_flops():
+    env = os.environ.get("PADDLE_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            return None  # malformed override must not kill the train loop
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return None
+    for k, v in _PEAK_BF16_FLOPS.items():
+        if k in kind:
+            return v
+    return None
 
 
 class TrainStep:
@@ -108,6 +136,35 @@ class TrainStep:
         self._lr_dev = None
         self._rng_carry = None
 
+        # observability handles (profiler.metrics): compile/retrace events,
+        # per-step latency, donated HBM, achieved-FLOPs/MFU
+        reg = _metrics.get_registry()
+        self._m_compiles = reg.counter(
+            "train_step.compiles", "TrainStep XLA program compilations")
+        self._m_retraces = reg.counter(
+            "train_step.retraces",
+            "recompilations after the first variant (input shape/dtype churn)")
+        self._m_compile_s = reg.gauge(
+            "train_step.compile_seconds",
+            "wall time of the last trace+compile (first dispatch of a variant)")
+        self._m_step_s = reg.histogram(
+            "train_step.step_seconds",
+            "wall time between consecutive fused-step dispatches")
+        self._m_donated = reg.gauge(
+            "train_step.donated_bytes",
+            "HBM held by donated params + optimizer state + buffers")
+        self._m_flops = reg.gauge(
+            "train_step.flops_per_step", "XLA cost_analysis flops of the step")
+        self._m_tflops = reg.gauge(
+            "train_step.achieved_tflops", "flops_per_step / step wall time")
+        self._m_mfu = reg.gauge(
+            "train_step.mfu", "achieved FLOP/s over device peak "
+            "(PADDLE_PEAK_FLOPS or the chip's bf16 datasheet number)")
+        self._retrace_count = 0
+        self._flops_per_step = None
+        self._last_call_t = None
+        self._m_donated.set(self._donated_bytes())
+
         # ZeRO: group_sharded_parallel marks the optimizer; lay the fresh
         # functional states out over the sharding axis (donation keeps it)
         if getattr(optimizer, "_sharded_states_axis", None):
@@ -139,20 +196,60 @@ class TrainStep:
         avals = (treedef, tuple((v.shape, str(v.dtype)) for v in vals),
                  bool(self.model.training))
         fn = self._compiled.get(avals)
-        if fn is None:
+        new_variant = fn is None
+        if new_variant:
+            if self._compiled:
+                # a second signature means every step with it pays a full
+                # XLA compile — loud by design (the #1 silent perf killer)
+                self._retrace_count += 1
+                self._m_retraces.inc()
+                warnings.warn(
+                    f"TrainStep retrace #{self._retrace_count}: input "
+                    f"signature changed to {avals[1]} "
+                    f"(training={avals[2]}); {len(self._compiled)} compiled "
+                    "variant(s) already exist.  Each distinct batch "
+                    "shape/dtype compiles a new XLA program — pad or bucket "
+                    "batches to avoid recompilation.", stacklevel=2)
             fn = self._build(treedef, bool(self.model.training))
             self._compiled[avals] = fn
         # avals only, for dist_main_program re-lowering: holding the real
         # arrays would pin a full batch of HBM for the TrainStep's lifetime
         self._last_batch_vals = [jax.ShapeDtypeStruct(v.shape, v.dtype)
                                  for v in vals]
+        call_args = (self._diff_params, self._opt_state, self._buffers,
+                     self._frozen_params, self._lr_dev, self._rng_carry)
         if self._scaler_state is not None:
-            out = fn(self._diff_params, self._opt_state, self._buffers,
-                     self._frozen_params, self._lr_dev, self._rng_carry,
-                     self._scaler_state, *vals)
+            call_args += (self._scaler_state,)
+        t_call = perf_counter()
+        if self._last_call_t is not None and not new_variant:
+            # steady-state wall time per step (the honest MFU denominator:
+            # includes host work between dispatches, excludes compiles)
+            dt = t_call - self._last_call_t
+            self._m_step_s.observe(dt)
+            if self._flops_per_step:
+                achieved = self._flops_per_step / max(dt, 1e-12)
+                self._m_tflops.set(achieved / 1e12)
+                peak = _peak_flops()
+                if peak:
+                    self._m_mfu.set(achieved / peak)
+        self._last_call_t = t_call
+        if _prof_events._ACTIVE:
+            with _prof_events.record("TrainStep"):
+                out = fn(*call_args, *vals)
         else:
-            out = fn(self._diff_params, self._opt_state, self._buffers,
-                     self._frozen_params, self._lr_dev, self._rng_carry, *vals)
+            out = fn(*call_args, *vals)
+        if new_variant:
+            # first dispatch of a variant = trace + XLA compile (+ async
+            # enqueue); record it and refresh the donation footprint
+            self._m_compiles.inc()
+            self._m_compile_s.set(perf_counter() - t_call)
+            self._m_donated.set(self._donated_bytes())
+            if (os.environ.get("PADDLE_TRAINSTEP_COST", "0").lower()
+                    not in ("", "0", "false", "no")) or _prof_events._ACTIVE:
+                self.cost_analysis(_fn=fn)
+            # the next call's inter-step dt would include this compile —
+            # restart the steady-state clock
+            self._last_call_t = None
         loss, self._diff_params, self._opt_state, self._buffers, outs, \
             self._rng_carry, scaler_state = out
         if scaler_state is not None:
@@ -169,6 +266,47 @@ class TrainStep:
     def _lr_value(self):
         lr = self.optimizer._lr
         return float(lr()) if isinstance(lr, LRScheduler) else float(lr)
+
+    # --------------------------------------------------------- observability
+    def _donated_bytes(self):
+        """Bytes of the donated carry (params + opt state + buffers + rng +
+        scaler): the HBM the fused step holds across the update."""
+        total = 0
+        carry = (self._diff_params, self._opt_state, self._buffers,
+                 self._rng_carry, self._scaler_state)
+        for v in jax.tree_util.tree_leaves(carry):
+            try:
+                total += int(v.nbytes)
+            except Exception:
+                pass  # prng keys on some backends hide their bytes
+        return total
+
+    def cost_analysis(self, _fn=None):
+        """flops / bytes-accessed of the compiled step via XLA cost
+        analysis; feeds the flops/MFU gauges.  Runs automatically on each
+        compile when PADDLE_TRAINSTEP_COST=1 or a Profiler is recording
+        (it re-lowers and compiles the program once more, so it is not free
+        — hence the gate); callable explicitly any time after step one."""
+        fn = _fn if _fn is not None else next(iter(self._compiled.values()), None)
+        if fn is None or getattr(self, "_last_batch_vals", None) is None:
+            return None
+        try:
+            args = [self._diff_params, self._opt_state, self._buffers,
+                    self._frozen_params, self._lr_dev, self._rng_carry]
+            if self._scaler_state is not None:
+                args.append(self._scaler_state)
+            comp = fn._jitted.lower(*args, *self._last_batch_vals).compile()
+            ca = comp.cost_analysis()
+            ca = ca[0] if isinstance(ca, list) else ca
+            flops = float(ca.get("flops", 0.0))
+            out = {"flops": flops,
+                   "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+        except Exception:
+            return None
+        if flops > 0:
+            self._flops_per_step = flops
+            self._m_flops.set(flops)
+        return out
 
     def _build(self, treedef, training):
         model = self.model

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ..framework import dtypes as _dt
 from ..framework import state as _state
+from ..profiler import events as _prof_events
 from ..tensor.tensor import Parameter, Tensor
 from . import initializer as I
 
@@ -45,7 +46,13 @@ class Layer:
             result = hook(self, args)
             if result is not None:
                 args = result if isinstance(result, tuple) else (result,)
-        out = self.forward(*args, **kwargs)
+        if _prof_events._ACTIVE:
+            # layer-level host region while a Profiler records (one flag
+            # load per call otherwise); ops nest under it in the event tree
+            with _prof_events.record(type(self).__name__):
+                out = self.forward(*args, **kwargs)
+        else:
+            out = self.forward(*args, **kwargs)
         for hook in self._forward_post_hooks.values():
             result = hook(self, args, out)
             if result is not None:

@@ -1,155 +1,34 @@
-"""paddle.profiler (reference: python/paddle/profiler/ — Profiler context
-with wait/warmup/active scheduler states, chrome-trace export, op summaries).
+"""paddle.profiler — TPU-native observability package (SURVEY.md §5.1).
 
-TPU-native (SURVEY.md §5.1): delegates to jax.profiler — XPlane traces
-viewable in TensorBoard/perfetto carry the real XLA:TPU timeline (the CUPTI
-analog).  The reference's scheduler states, RecordEvent annotation, and
-export API shapes are kept; summary tables come from on-host step timing.
+Submodules:
+
+- :mod:`.profiler` — the reference-shaped ``Profiler`` context
+  (CLOSED/READY/RECORD scheduler, on_trace_ready handlers, per-op summary
+  tables, chrome-trace export, ``load_profiler_result``).
+- :mod:`.events` — the host-side ``RecordEvent`` tree the op-level timers
+  in ``nn.Layer.__call__`` / ``tensor.dispatch`` feed while profiling.
+- :mod:`.metrics` — process-wide metrics registry (counters / gauges /
+  histograms with labels) with JSONL + Prometheus-text exporters and an
+  env-gated background flusher (``PADDLE_METRICS_DIR``).
+
+Env flags: ``PADDLE_PROFILER_DIR`` (trace output dir),
+``PADDLE_METRICS_DIR`` / ``PADDLE_METRICS_FLUSH_SECS`` (metrics flusher),
+``PADDLE_TRAINSTEP_COST`` / ``PADDLE_PEAK_FLOPS`` (TrainStep FLOPs/MFU
+accounting) — see README "Observability".
 """
 
 from __future__ import annotations
 
-import contextlib
-import enum
-import os
-import time
+from . import events, metrics  # noqa: F401
+from .events import RecordEvent  # noqa: F401
+from .profiler import (  # noqa: F401
+    Profiler, ProfilerResult, ProfilerState, ProfilerTarget, SummaryView,
+    export_chrome_tracing, export_protobuf, load_profiler_result,
+    make_scheduler,
+)
 
-import jax
-
-
-class ProfilerTarget(enum.Enum):
-    CPU = 0
-    GPU = 1
-    CUSTOM_DEVICE = 2
-    TPU = 3
-
-
-class ProfilerState(enum.Enum):
-    CLOSED = 0
-    READY = 1
-    RECORD = 2
-    RECORD_AND_RETURN = 3
-
-
-def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
-    """reference: profiler.make_scheduler — maps step number to state."""
-
-    def scheduler(step):
-        if step < skip_first:
-            return ProfilerState.CLOSED
-        s = (step - skip_first) % max(closed + ready + record, 1)
-        if s < closed:
-            return ProfilerState.CLOSED
-        if s < closed + ready:
-            return ProfilerState.READY
-        if s == closed + ready + record - 1:
-            return ProfilerState.RECORD_AND_RETURN
-        return ProfilerState.RECORD
-
-    return scheduler
-
-
-def export_chrome_tracing(dir_name, worker_name=None):
-    def handler(prof):
-        prof._export_dir = dir_name
-
-    return handler
-
-
-export_protobuf = export_chrome_tracing
-
-
-class Profiler:
-    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
-                 timer_only=False, record_shapes=False, profile_memory=False,
-                 with_flops=False, emit_nvtx=False):
-        self._timer_only = timer_only
-        self._scheduler = scheduler if callable(scheduler) else None
-        if isinstance(scheduler, (tuple, list)):
-            lo, hi = scheduler
-            self._scheduler = make_scheduler(closed=lo, record=hi - lo)
-        self._on_ready = on_trace_ready
-        self._export_dir = os.environ.get("PADDLE_PROFILER_DIR", "/tmp/paddle_tpu_trace")
-        self._step = 0
-        self._tracing = False
-        self._step_times = []
-        self._t0 = None
-
-    # -------------------------------------------------------------- control
-    def start(self):
-        self._t0 = time.time()
-        if not self._timer_only and self._scheduler is None:
-            self._start_trace()
-        return self
-
-    def stop(self):
-        if self._tracing:
-            self._stop_trace()
-        if self._on_ready is not None:
-            self._on_ready(self)
-
-    def _start_trace(self):
-        os.makedirs(self._export_dir, exist_ok=True)
-        try:
-            jax.profiler.start_trace(self._export_dir)
-            self._tracing = True
-        except Exception:
-            self._tracing = False
-
-    def _stop_trace(self):
-        try:
-            jax.profiler.stop_trace()
-        except Exception:
-            pass
-        self._tracing = False
-
-    def step(self, num_samples=None):
-        now = time.time()
-        if self._t0 is not None:
-            self._step_times.append((now - self._t0, num_samples))
-        self._t0 = now
-        self._step += 1
-        if self._scheduler is not None and not self._timer_only:
-            state = self._scheduler(self._step)
-            if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
-                if not self._tracing:
-                    self._start_trace()
-            elif self._tracing:
-                self._stop_trace()
-
-    def step_info(self, unit="samples"):
-        if not self._step_times:
-            return "no steps recorded"
-        dts = [d for d, _ in self._step_times[-10:]]
-        avg = sum(dts) / len(dts)
-        ns = [n for _, n in self._step_times[-10:] if n]
-        ips = (sum(ns) / sum(dts)) if ns else None
-        s = f"avg step {avg * 1e3:.2f} ms"
-        if ips:
-            s += f", {ips:.1f} {unit}/sec"
-        return s
-
-    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms"):
-        print(self.step_info())
-
-    def export(self, path=None, format="json"):
-        """The XPlane trace is already on disk (TensorBoard-loadable)."""
-        return self._export_dir
-
-    def __enter__(self):
-        return self.start()
-
-    def __exit__(self, *exc):
-        self.stop()
-
-
-@contextlib.contextmanager
-def RecordEvent(name, event_type=None):
-    """reference: profiler.RecordEvent — names a region in the device trace."""
-    with jax.profiler.TraceAnnotation(name):
-        yield
-
-
-def load_profiler_result(path):
-    raise NotImplementedError("XPlane traces load in TensorBoard, not in-process")
+__all__ = [
+    "Profiler", "ProfilerResult", "ProfilerState", "ProfilerTarget",
+    "SummaryView", "RecordEvent", "make_scheduler", "export_chrome_tracing",
+    "export_protobuf", "load_profiler_result", "events", "metrics",
+]

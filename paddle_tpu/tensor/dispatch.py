@@ -10,6 +10,7 @@ Under a jax trace (to_static) the same functions trace transparently.
 
 from __future__ import annotations
 
+from time import perf_counter as _perf_counter
 from typing import Any
 
 import jax.numpy as jnp
@@ -17,6 +18,7 @@ import jax.numpy as jnp
 from ..framework import state
 from ..autograd.tape import Node
 from .. import flags as _flags
+from ..profiler import events as _prof_events
 
 
 def unwrap(x):
@@ -49,7 +51,16 @@ def apply(fn, *args, op_name: str = "", n_outs: int = 1, **kwargs):
 
         fn.__name__ = getattr(inner, "__name__", op_name)
     vals = [unwrap(a) for a in args]
-    out_val = fn(*vals, **kwargs)
+    if _prof_events._ACTIVE:
+        # op-level host timer (profiler active only: one flag load otherwise).
+        # Under async dispatch this is time-to-enqueue — the reference's
+        # CPU-side op summary semantics; the device timeline is the XPlane.
+        t0 = _perf_counter()
+        out_val = fn(*vals, **kwargs)
+        _prof_events.add_complete(op_name or getattr(fn, "__name__", "op"),
+                                  t0, _perf_counter())
+    else:
+        out_val = fn(*vals, **kwargs)
 
     if _flags.get_flag("check_nan_inf"):
         _check_nan_inf(out_val, op_name or getattr(fn, "__name__", "op"))
