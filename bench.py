@@ -130,6 +130,93 @@ def _measure_decode(cache_impl, B=8, S0=32, lo=64, hi=320):
     return B * (hi - lo) / max(t_hi - t_lo, 1e-9)
 
 
+def _measure_serving(n_requests=8, num_slots=4, S0=32, page_size=32,
+                     max_news=None, model_kwargs=None, warm_tokens=4):
+    """Continuous batching vs sequential generate() on a mixed-length
+    workload (the acceptance workload for paddle_tpu.serving).
+
+    Sequential baseline: one generate() per request, SAME pinned max_len so
+    every call reuses one compiled prefill/step pair — the engine's win
+    must come from iteration-level batching, not from the baseline paying
+    extra compiles.  Engine: all requests submitted at once; slots backfill
+    as short requests retire.  TTFT / inter-token quantiles read back from
+    the serving.* histograms in the PR-1 registry (reservoir quantiles)."""
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics as _metrics
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models import GPTForCausalLM
+
+    paddle.seed(0)
+    m = GPTForCausalLM(**(model_kwargs or {})).eval()  # default: GPT-base
+    vocab = m.gpt.word_embeddings.weight.shape[0]
+    rs = np.random.RandomState(0)
+    if max_news is None:  # varied per-request budgets (mixed-length decode)
+        max_news = [16, 96, 32, 128, 48, 64, 24, 112]
+    max_news = [int(max_news[i % len(max_news)]) for i in range(n_requests)]
+    prompts = [rs.randint(1, min(vocab, 50000), (S0,)).astype("int64")
+               for _ in range(n_requests)]
+    max_len = S0 + max(max_news)
+    total_tokens = sum(max_news)
+
+    # --- sequential per-request generate() (one compiled program pair) ---
+    def gen(p, n):
+        m.generate(paddle.to_tensor(p[None, :]), max_new_tokens=n,
+                   temperature=0.0, cache_impl="paged", page_size=page_size,
+                   max_len=max_len)
+
+    gen(prompts[0], warm_tokens)  # compile
+    t0 = time.time()
+    for p, n in zip(prompts, max_news):
+        gen(p, n)
+    t_seq = time.time() - t0
+
+    # --- continuous batching engine ---
+    reg = _metrics.get_registry()
+    engine = ServingEngine(m, num_slots=num_slots, page_size=page_size,
+                           max_model_len=max_len)
+    with engine:
+        engine.generate(prompts[0], max_new_tokens=warm_tokens,
+                        timeout=600)  # compile prefill+step
+        # snapshot AFTER warm-up: the warm request's TTFT is the compile
+        # time (tens of seconds) and would dominate the reported mean
+        ttft_h = reg.get("serving.ttft_seconds").labels()
+        ttft_sum0, ttft_n0 = ttft_h.sum, ttft_h.count
+        t0 = time.time()
+        handles = [engine.submit(p, max_new_tokens=n)
+                   for p, n in zip(prompts, max_news)]
+        for h in handles:
+            h.result(timeout=600)
+        t_engine = time.time() - t0
+        step_traces = engine.step_traces
+
+    def _q(name, q):
+        h = reg.get(name)
+        c = h.labels() if h is not None else None
+        return (c.quantile(q) if c is not None and c.count else None)
+
+    ttft_n = ttft_h.count - ttft_n0
+    ttft_mean = (ttft_h.sum - ttft_sum0) / ttft_n if ttft_n else None
+    return {
+        "n_requests": n_requests,
+        "num_slots": num_slots,
+        "tokens": total_tokens,
+        "engine_tokens_per_sec": round(total_tokens / t_engine, 2),
+        "sequential_tokens_per_sec": round(total_tokens / t_seq, 2),
+        "speedup_vs_sequential": round(t_seq / t_engine, 3),
+        "ttft_mean_s": round(ttft_mean, 4) if ttft_mean is not None else None,
+        # reservoir quantiles: the handful of warm-up ITL samples are noise
+        # against the measured phase's hundreds
+        "itl_p50_s": _q("serving.inter_token_seconds", 0.5),
+        "itl_p95_s": _q("serving.inter_token_seconds", 0.95),
+        "step_traces": step_traces,
+        "note": ("continuous batching over the paged KV pool; sequential "
+                 "baseline reuses ONE compiled generate() program pair "
+                 "(pinned max_len)"),
+    }
+
+
 def _mfu_fields(flops_per_sec, peak, matmul_tflops):
     out = {"achieved_tflops": round(flops_per_sec / 1e12, 2),
            "frac_of_measured_matmul": round(
@@ -187,6 +274,8 @@ def _run_section(name):
         return {"tps": _measure_decode("dense")}
     if name == "decode_paged":
         return {"tps": _measure_decode("paged")}
+    if name == "serving":
+        return _measure_serving()
     if name == "allreduce":
         bw, n = micro.allreduce_bus_bw()
         return {"bw": bw, "n": n}
@@ -235,6 +324,18 @@ def main():
     section = os.environ.get("BENCH_SECTION")
     if section:
         print(json.dumps(_run_section(section)))
+        return
+
+    if "--serving" in sys.argv:
+        # serving micro-benchmark only (own process = fresh device state,
+        # same hygiene as the per-section subprocesses of the full run)
+        out = {"serving": _section("serving")}
+        print(json.dumps(out))
+        if "--emit-metrics" in sys.argv:
+            path = emit_metrics(out, out_dir=_metrics_dir_from_argv())
+            if path is None:
+                print("--emit-metrics: no --metrics-dir/PADDLE_METRICS_DIR "
+                      "set; nothing written", file=sys.stderr)
         return
 
     from benchmarks.raw_resnet50 import fwd_flops_per_image

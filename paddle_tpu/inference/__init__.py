@@ -107,7 +107,12 @@ class Config:
         self._inert("optim_cache_dir", d)
 
     def enable_profile(self):
-        self._inert("profile")
+        """Arm the REAL profiler (PR-1), not an inert flag: a Predictor
+        built from this config runs under a recording
+        :class:`paddle_tpu.profiler.Profiler` (host op timers; no device
+        XPlane session), so ``run()`` feeds the per-op summary table —
+        fetch it with :meth:`Predictor.profile_summary`."""
+        self._profile = True
 
     def disable_glog_info(self):
         self._inert("glog_off")
@@ -130,6 +135,9 @@ class Config:
             "  executor        : XLA (StableHLO artifact; graph passes owned "
             "by the compiler)",
         ]
+        if getattr(self, "_profile", False):
+            lines.append("  profile         : enabled (paddle_tpu.profiler "
+                         "op timers)")
         for k, v in self._flags.items():
             lines.append(f"  [inert] {k}      : {v}")
         return "\n".join(lines)
@@ -193,6 +201,27 @@ class Predictor:
             self._inputs["input_0"] = PredictorTensor("input_0")
         self._outputs = []
         self._config = config
+        # one dashboard schema with the serving engine: the legacy
+        # single-request path reports through the same PR-1 registry
+        from ..profiler import metrics as _metrics
+
+        model_label = os.path.basename(config._prefix or "model")
+        self._m_requests = _metrics.counter(
+            "inference.requests", "Predictor.run() calls")
+        self._m_in_bytes = _metrics.counter(
+            "inference.input_bytes", "host bytes staged into run()")
+        self._m_out_bytes = _metrics.counter(
+            "inference.output_bytes", "host bytes fetched out of run()")
+        self._m_run_seconds = _metrics.histogram(
+            "inference.run_seconds", "wall latency of run()")
+        self._model_label = model_label
+        self._profiler = None
+        if getattr(config, "_profile", False):
+            from ..profiler import Profiler
+
+            # host-only op timers (no device XPlane session): RECORD from
+            # start so every run() lands in the op table
+            self._profiler = Profiler(device_trace=False).start()
 
     # ---------------------------------------------------------------- api
     def get_input_names(self):
@@ -202,7 +231,13 @@ class Predictor:
         return self._inputs[name]
 
     def get_output_names(self):
-        n = getattr(self, "_n_outs", 0) or len(self._outputs) or 1
+        # post-run the observed arity is authoritative; pre-run, artifacts
+        # saved with jit.save carry the true arity in spec.json
+        # ("n_outputs"), so the names are right BEFORE the first run()
+        # instead of defaulting to 1
+        n = (getattr(self, "_n_outs", 0)
+             or int(self._layer._meta.get("n_outputs") or 0)
+             or len(self._outputs) or 1)
         return [f"output_{i}" for i in range(n)]
 
     def get_output_handle(self, name):
@@ -228,6 +263,8 @@ class Predictor:
 
     def run(self, inputs=None):
         """Execute; also callable functionally: run([np_arrays]) -> list."""
+        import time
+
         from ..tensor.tensor import Tensor
 
         if inputs is not None:
@@ -239,23 +276,60 @@ class Predictor:
             for h, a in zip(self._inputs.values(), inputs):
                 h.copy_from_cpu(np.asarray(a))
         args = []
+        in_bytes = 0
         for h in self._inputs.values():
             if h._value is None:
                 raise RuntimeError(f"input {h.name()!r} not set; call "
                                    "copy_from_cpu first")
+            in_bytes += np.asarray(h._value).nbytes
             args.append(Tensor(np.asarray(h._value)))
-        out = self._layer(*args)
+        t0 = time.perf_counter()
+        if self._profiler is not None:
+            from ..profiler import RecordEvent
+
+            with RecordEvent("predictor.run"):
+                out = self._layer(*args)
+        else:
+            out = self._layer(*args)
         outs = out if isinstance(out, (tuple, list)) else [out]
         # update handles IN PLACE: a handle fetched before run() must see
         # the results (reference API contract)
+        out_bytes = 0
         for i, o in enumerate(outs):
             if i >= len(self._outputs):
                 self._outputs.append(PredictorTensor(f"output_{i}"))
-            self._outputs[i].copy_from_cpu(np.asarray(o.numpy()))
+            arr = np.asarray(o.numpy())
+            out_bytes += arr.nbytes
+            self._outputs[i].copy_from_cpu(arr)
         self._n_outs = len(outs)  # pre-created extra handles stay alive
+        dt = time.perf_counter() - t0
+        lab = {"model": self._model_label}
+        self._m_requests.inc(**lab)
+        self._m_in_bytes.inc(in_bytes, **lab)
+        self._m_out_bytes.inc(out_bytes, **lab)
+        self._m_run_seconds.observe(dt, **lab)
+        if self._profiler is not None:
+            n = np.asarray(next(iter(self._inputs.values()))._value)
+            self._profiler.step(num_samples=int(n.shape[0]) if n.ndim else 1)
         if inputs is not None:
             return [t.copy_to_cpu() for t in self._outputs[:self._n_outs]]
         return True
+
+    @property
+    def profiler(self):
+        return self._profiler
+
+    def profile_summary(self, sorted_by=None, stop=True):
+        """Per-op summary table of the profiled runs (the reference's
+        profile report).  ``stop=True`` (default) ends collection first —
+        the reference emits its report once, at predictor teardown."""
+        if self._profiler is None:
+            raise RuntimeError(
+                "profiling not enabled; call Config.enable_profile() before "
+                "create_predictor")
+        if stop and self._profiler._cur_state is not None:
+            self._profiler.stop()
+        return self._profiler.summary(sorted_by=sorted_by)
 
     def clone(self):
         return Predictor(self._config)

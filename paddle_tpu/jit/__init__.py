@@ -20,6 +20,7 @@ import json
 import os
 
 import jax
+import jax.export  # noqa: F401  (binds jax.export — lazy attr since 0.4.34)
 import jax.numpy as jnp
 
 from ..framework import random as _rng
@@ -480,6 +481,9 @@ def save(layer, path, input_spec=None, **configs):
                        for s in spec],
         "pnames": pnames,
         "bnames": bnames,
+        # output arity travels with the artifact so a Predictor can report
+        # get_output_names() correctly BEFORE its first run()
+        "n_outputs": len(exported.out_avals),
     }
     with open(base + ".spec.json", "w") as f:
         json.dump(meta, f)

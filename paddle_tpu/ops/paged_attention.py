@@ -120,7 +120,11 @@ def _paged_pallas(q, k_pages, v_pages, page_table, seq_lens, scale,
     # legalize (r5: compile failed from inside paddle_tpu but succeeded in a
     # bare-jax process; bisected to exactly this flag).  Every dtype in the
     # kernel is pinned, so x32 promotion rules change nothing numerically.
-    with jax.enable_x64(False):
+    # (jax.enable_x64 is a lazy attr some versions never bind — the
+    # experimental spelling is the stable one.)
+    from jax.experimental import enable_x64 as _enable_x64
+
+    with _enable_x64(False):
         out = pl.pallas_call(
             functools.partial(_paged_kernel, page_size=page_size, scale=scale,
                               num_kv_heads=HKV),
@@ -231,6 +235,47 @@ def paged_decode_attend(q, k_pages, v_pages, pos, scale=None):
              + jnp.arange(PP, dtype=jnp.int32)[None, :])
     lens = jnp.full((B,), pos + 1, jnp.int32)
     return paged_attention(q, pool_k, pool_v, table, lens, scale)
+
+
+# ------------------------------------------------- serving-engine utils
+# Table-addressed variants for the continuous-batching engine
+# (paddle_tpu.serving): ONE global pool [P, ps, h, d] shared by every
+# sequence through an explicit page table, and PER-SLOT lengths — each slot
+# decodes at its own position, which is what iteration-level batching needs
+# (the lock-step helpers above share one scalar ``pos`` across the batch).
+
+
+def paged_table_prefill_write(pool, kv, table):
+    """Write whole prompts into their table pages at position 0.
+
+    pool: [P, ps, h, d]; kv: [B, S, h, d]; table: [B, NP] int32.  S is a
+    trace-time constant; each row's S tokens land in pages
+    ``table[b, 0:ceil(S/ps)]`` (rows shorter than S are right-padded by the
+    caller — the junk tokens go into pages that per-slot ``seq_lens``
+    masking keeps invisible, or into the caller's scratch page)."""
+    B, S, h, d = kv.shape
+    ps = pool.shape[1]
+    pad = (ps - S % ps) % ps
+    if pad:
+        kv = jnp.pad(kv, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    chunks = kv.reshape(B, -1, ps, h, d)
+    nc = chunks.shape[1]
+    idx = table[:, :nc].reshape(-1)
+    return pool.at[idx].set(
+        chunks.reshape(B * nc, ps, h, d).astype(pool.dtype))
+
+
+def paged_table_token_write(pool, tok, table, lens):
+    """Write one token's K or V per slot at each slot's OWN position.
+
+    pool: [P, ps, h, d]; tok: [B, h, d]; table: [B, NP]; lens: [B] int32 —
+    slot b's token lands in page ``table[b, lens[b]//ps]`` slot
+    ``lens[b]%ps``.  All args may be traced (scatter write)."""
+    B = tok.shape[0]
+    ps = pool.shape[1]
+    lens = lens.astype(jnp.int32)
+    pages = table[jnp.arange(B, dtype=jnp.int32), lens // ps]
+    return pool.at[pages, lens % ps].set(tok.astype(pool.dtype))
 
 
 class PagedKVCache:

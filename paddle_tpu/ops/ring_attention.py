@@ -53,9 +53,18 @@ def _block_attn(qf, kf, vf, scale, causal):
     return o, m + jnp.log(jnp.maximum(l, 1e-30))
 
 
+def _axis_size(axis):
+    """Static mapped-axis size.  jax >= 0.6 spells it lax.axis_size; on
+    0.4.x jax.core.axis_frame(name) returns the size itself."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    fr = jax.core.axis_frame(axis)
+    return int(getattr(fr, "size", fr))
+
+
 def _ring_body(q, k, v, axis, scale, causal):
     """Per-device body: q,k,v local [B, S_loc, H, D]."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     idx = lax.axis_index(axis)
     b, s_loc, h, d = q.shape
 

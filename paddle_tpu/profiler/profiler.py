@@ -123,8 +123,13 @@ class Profiler:
 
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=False, record_shapes=False, profile_memory=False,
-                 with_flops=False, emit_nvtx=False):
+                 with_flops=False, emit_nvtx=False, device_trace=True):
         self._timer_only = timer_only
+        # device_trace=False keeps only the host event tree (op table /
+        # chrome export) without opening a jax XPlane trace — what
+        # long-lived embedders like inference.Config.enable_profile() want:
+        # per-op summaries with no unbounded device-trace session
+        self._device_trace = device_trace
         self._scheduler = scheduler if callable(scheduler) else None
         if isinstance(scheduler, (tuple, list)):
             lo, hi = scheduler
@@ -204,6 +209,9 @@ class Profiler:
             self._collector = None
 
     def _start_trace(self):
+        if not self._device_trace:
+            self._tracing = False
+            return
         os.makedirs(self._export_dir, exist_ok=True)
         try:
             jax.profiler.start_trace(self._export_dir)

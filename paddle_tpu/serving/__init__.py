@@ -1,0 +1,31 @@
+"""paddle_tpu.serving — continuous-batching LLM serving over the paged KV
+cache (ROADMAP north star: "serves heavy traffic from millions of users").
+
+- :mod:`.engine` — :class:`ServingEngine`: iteration-level (Orca-style)
+  scheduler over a fixed-shape decode batch; one compiled step per
+  iteration, donated page pools, per-slot positions.
+- :mod:`.block_manager` — :class:`BlockManager`: vLLM-style paged KV block
+  allocation, capacity-based admission control, optional prefix sharing.
+- :mod:`.adapter` — model adapters (:class:`GPTAdapter`) reducing a causal
+  LM to the prefill/step closures the engine compiles.
+- :mod:`.api` — :class:`ContinuousBatchingPredictor`, the
+  ``paddle.inference``-shaped deployment facade.
+
+Metrics (PR-1 registry, README "Serving"): ``serving.*`` histograms /
+gauges / counters — TTFT, inter-token latency, queue depth, slot
+occupancy, page-pool utilization, admission/preemption/trace counters.
+"""
+
+from .adapter import GPTAdapter  # noqa: F401
+from .api import ContinuousBatchingPredictor  # noqa: F401
+from .block_manager import BlockManager, PageAllocation  # noqa: F401
+from .engine import (  # noqa: F401
+    Request, RequestHandle, RequestRejectedError, SamplingParams,
+    ServingEngine,
+)
+
+__all__ = [
+    "ServingEngine", "Request", "RequestHandle", "RequestRejectedError",
+    "SamplingParams", "BlockManager", "PageAllocation", "GPTAdapter",
+    "ContinuousBatchingPredictor",
+]

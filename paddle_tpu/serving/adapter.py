@@ -1,0 +1,88 @@
+"""Model adapters: the pure functions the ServingEngine jit-compiles.
+
+An adapter reduces a causal LM to two closures over explicit jax state
+(the engine wraps them in ``jax.jit`` with DONATED pools, once per
+(batch-shape, sampler) tuple — the ``_decode.py`` discipline):
+
+- ``prefill(params, bufs, ids, kp, vp, table, lens)`` — run the
+  (right-padded) prompts ``ids [B, S]`` densely, write their K/V into the
+  global page pools through ``table [B, NP]``, and return the next-token
+  logits gathered at each row's true last position ``lens[b] - 1``.
+- ``step(params, bufs, last, kp, vp, table, lens)`` — one decode token per
+  slot at each slot's OWN position ``lens[b]`` (iteration-level batching:
+  no lock-step scalar pos), attention through the paged kernel.
+
+Both return ``(logits [B, V] f32, kp, vp)`` with
+``kp/vp: [L, P, ps, h, d]`` stacked per-layer global pools.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class GPTAdapter:
+    """Adapter for :class:`paddle_tpu.text.models.GPTForCausalLM` (and any
+    model exposing the same ``.gpt`` decoder structure with the "served"
+    cache variant)."""
+
+    def __init__(self, model, page_size=16):
+        self.model = model
+        self.gpt = model.gpt
+        blk = self.gpt.layers[0]
+        self.num_layers = len(self.gpt.layers)
+        self.head_dim = blk.head_dim
+        # local head count from the actual projection width (TP-safe)
+        self.num_kv_heads = blk.qkv.weight.shape[-1] // (3 * blk.head_dim)
+        self.dtype = self.gpt.word_embeddings.weight._value.dtype
+        self.max_model_len = self.gpt.position_embeddings.weight.shape[0]
+        self.page_size = int(page_size)
+
+    def params_and_buffers(self):
+        params = {k: p._value for k, p in self.model.named_parameters()}
+        bufs = {k: b._value for k, b in self.model.named_buffers()}
+        return params, bufs
+
+    def init_pools(self, num_pages):
+        """Zeroed per-layer K/V pools [L, P, ps, h, d]."""
+        shape = (self.num_layers, int(num_pages), self.page_size,
+                 self.num_kv_heads, self.head_dim)
+        kp = jnp.zeros(shape, self.dtype)
+        return kp, jnp.zeros_like(kp)
+
+    # ------------------------------------------------------------- closures
+    def _run(self, params, bufs, ids, kp, vp, table, lens, pos_ids):
+        from ..framework import random as _rng
+        from ..framework.state import no_grad_ctx
+        from ..tensor.tensor import Tensor
+
+        gpt = self.gpt
+        with no_grad_ctx(), _rng.rng_scope(jax.random.key(0)), \
+                self.model.bind(params, bufs):
+            lc = [("served", Tensor(kp[i]), Tensor(vp[i]), Tensor(table),
+                   Tensor(lens)) for i in range(self.num_layers)]
+            x, new_cache = gpt(Tensor(ids), position_ids=Tensor(pos_ids),
+                               cache=lc)
+            w = gpt.word_embeddings.weight._value
+            kp = jnp.stack([c[1]._value for c in new_cache])
+            vp = jnp.stack([c[2]._value for c in new_cache])
+            return x._value, w, kp, vp
+
+    def prefill(self, params, bufs, ids, kp, vp, table, lens):
+        S = ids.shape[1]
+        pos_ids = jnp.arange(S, dtype=jnp.int64)[None, :]
+        x, w, kp, vp = self._run(params, bufs, ids, kp, vp, table, lens,
+                                 pos_ids)
+        # logits at each row's LAST REAL position (rows are right-padded)
+        idx = (lens.astype(jnp.int32) - 1)[:, None, None]
+        h = jnp.take_along_axis(x, idx, axis=1)[:, 0]
+        logits = h.astype(jnp.float32) @ w.T.astype(jnp.float32)
+        return logits, kp, vp
+
+    def step(self, params, bufs, last, kp, vp, table, lens):
+        pos_ids = lens[:, None].astype(jnp.int64)
+        x, w, kp, vp = self._run(params, bufs, last, kp, vp, table, lens,
+                                 pos_ids)
+        logits = x[:, -1].astype(jnp.float32) @ w.T.astype(jnp.float32)
+        return logits, kp, vp

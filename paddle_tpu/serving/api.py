@@ -1,0 +1,129 @@
+"""Deployment-facing surface of the serving engine.
+
+:class:`ContinuousBatchingPredictor` bridges the reference
+``paddle.inference`` Config/Predictor API (named input/output handles,
+``copy_from_cpu`` / ``run()`` / ``copy_to_cpu``) onto the
+:class:`~.engine.ServingEngine`: every row of the staged ``input_ids``
+batch becomes an independent request, so concurrent ``run()`` callers (and
+the rows within one call) share the engine's iteration-level batch instead
+of serializing behind each other — the drop-in upgrade path from the
+single-request :class:`paddle_tpu.inference.Predictor`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .engine import ServingEngine
+
+
+class ContinuousBatchingPredictor:
+    """Predictor-shaped facade over a :class:`ServingEngine`.
+
+    ``model``: a causal LM the engine's adapter understands (GPT-family).
+    ``config``: optional ``paddle.inference.Config`` — accepted for script
+    compatibility (device/flags recorded; the engine executes via its own
+    compiled programs, not the StableHLO artifact, because serving needs
+    the KV-cache decode path the artifact does not carry).
+
+    Input handle ``input_ids``: int64 ``[B, S]``, rows right-padded with
+    ``pad_token_id``.  Output handle ``output_0``: int64
+    ``[B, S + max_new_tokens]`` — prompt + generated ids, right-padded.
+    """
+
+    def __init__(self, model, config=None, max_new_tokens=32,
+                 temperature=0.0, eos_token_id=None, pad_token_id=0,
+                 engine=None, **engine_kwargs):
+        from ..inference import PredictorTensor
+
+        self._engine = engine if engine is not None \
+            else ServingEngine(model, **engine_kwargs)
+        self._config = config
+        self._max_new_tokens = int(max_new_tokens)
+        self._temperature = float(temperature)
+        self._eos = eos_token_id
+        self._pad = int(pad_token_id)
+        self._input = PredictorTensor("input_ids", [None, None], "int64")
+        self._output = PredictorTensor("output_0", None, "int64")
+
+    # --------------------------------------------------- reference surface
+    def get_input_names(self):
+        return ["input_ids"]
+
+    def get_input_handle(self, name):
+        if name != "input_ids":
+            raise KeyError(f"unknown input {name!r}; valid: ['input_ids']")
+        return self._input
+
+    def get_output_names(self):
+        return ["output_0"]
+
+    def get_output_handle(self, name):
+        if name != "output_0":
+            raise KeyError(f"unknown output {name!r}; valid: ['output_0']")
+        return self._output
+
+    def run(self, inputs=None):
+        """Fan the staged batch out as one request per row, wait for all,
+        refill the output handle.  Functional spelling
+        ``run([ids_batch])`` returns ``[np.ndarray]`` like the reference."""
+        if inputs is not None:
+            if len(inputs) != 1:
+                raise ValueError(f"run() takes one input batch, "
+                                 f"got {len(inputs)}")
+            self._input.copy_from_cpu(np.asarray(inputs[0]))
+        ids = self._input.copy_to_cpu()
+        if ids is None or ids.ndim != 2:
+            raise RuntimeError("input_ids not set (or not [B, S]); call "
+                               "copy_from_cpu first")
+        ids = ids.astype(np.int64)
+        handles = []
+        try:
+            for row in ids:
+                # strip TRAILING padding only (pad_token_id may be a real
+                # token mid-prompt); all-pad rows keep one token
+                nz = np.nonzero(row != self._pad)[0]
+                prompt = row[:nz[-1] + 1] if nz.size else row[:1]
+                handles.append(self._engine.submit(
+                    prompt, max_new_tokens=self._max_new_tokens,
+                    temperature=self._temperature, eos_token_id=self._eos))
+        except Exception:
+            # a mid-batch rejection must not leave earlier rows decoding
+            # unobserved (burning slots/pages with nobody collecting them)
+            for h in handles:
+                h.cancel()
+            raise
+        B, S = ids.shape
+        out = np.full((B, S + self._max_new_tokens), self._pad, np.int64)
+        out[:, :S] = ids
+        for b, h in enumerate(handles):
+            new = h.result()
+            out[b, S:S + len(new)] = new
+        self._output.copy_from_cpu(out)
+        if inputs is not None:
+            return [out.copy()]
+        return True
+
+    # ------------------------------------------------------------- passthru
+    def submit(self, prompt_ids, **kw):
+        kw.setdefault("max_new_tokens", self._max_new_tokens)
+        kw.setdefault("temperature", self._temperature)
+        kw.setdefault("eos_token_id", self._eos)
+        return self._engine.submit(prompt_ids, **kw)
+
+    def stream(self, prompt_ids, **kw):
+        return self.submit(prompt_ids, **kw).stream()
+
+    @property
+    def engine(self):
+        return self._engine
+
+    def close(self):
+        self._engine.stop()
+
+    def __enter__(self):
+        self._engine.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

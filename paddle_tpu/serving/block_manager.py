@@ -1,0 +1,172 @@
+"""Paged KV block manager — the allocator side of the serving engine.
+
+vLLM block-manager analog over this repo's page-pool layout: the engine
+owns per-layer GLOBAL page pools ``[L, P, page_size, h, d]`` (see
+``ops.paged_attention``); this module owns which of the ``P`` rows belong
+to which live sequence.  Everything here is host-side Python — the device
+only ever sees the ``[B, NP]`` page table the engine rebuilds from these
+allocations.
+
+Capacity-based admission control: :meth:`allocate` returns ``None`` when
+the pool cannot cover a sequence's worst case (prompt + max_new_tokens),
+and the engine keeps the request queued instead of admitting it — no
+mid-flight page exhaustion, so no copy-out preemption path is needed.
+
+Prefix sharing (``prefix_sharing=True``): pages FULLY covered by a prompt
+are content-addressed by the token prefix they encode (K/V at position p
+is a pure function of tokens 0..p and the weights, so the page for
+positions ``[i*ps, (i+1)*ps)`` is keyed by ``prompt[:(i+1)*ps]``).  Two
+live sequences with identical prompt prefixes share those physical pages
+(refcounted); decode never writes them — a sequence's first generated
+token lands at position ``len(prompt)``, which is always past the last
+fully-covered page.  When the last holder retires, shared pages park in an
+idle cache and are resurrected on the next identical prefix (or evicted
+LRU when the free list runs dry).  Memory sharing is real; prefill compute
+still runs per sequence (skipping it is future work).
+"""
+
+from __future__ import annotations
+
+import collections
+
+
+class PageAllocation:
+    """One live sequence's pages, in sequence order.  The first
+    ``len(shared_keys)`` entries are refcounted prefix pages; the rest are
+    private and return to the free list on :meth:`BlockManager.free`."""
+
+    __slots__ = ("pages", "shared_keys")
+
+    def __init__(self, pages, shared_keys=()):
+        self.pages = list(pages)
+        self.shared_keys = tuple(shared_keys)
+
+    @property
+    def num_shared(self):
+        return len(self.shared_keys)
+
+    def __len__(self):
+        return len(self.pages)
+
+
+class BlockManager:
+    def __init__(self, num_pages, page_size, prefix_sharing=False):
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.prefix_sharing = bool(prefix_sharing)
+        self._free = collections.deque(range(self.num_pages))
+        self._active = {}                       # prefix key -> [page, refs]
+        self._idle = collections.OrderedDict()  # prefix key -> page (refs 0)
+
+    # ------------------------------------------------------------ accounting
+    def pages_for(self, num_tokens):
+        return -(-int(num_tokens) // self.page_size)
+
+    @property
+    def free_pages(self):
+        """Pages obtainable right now (free list + evictable idle cache)."""
+        return len(self._free) + len(self._idle)
+
+    @property
+    def used_pages(self):
+        return self.num_pages - self.free_pages
+
+    def utilization(self):
+        return self.used_pages / self.num_pages
+
+    # ------------------------------------------------------------ allocation
+    def _pop_free(self):
+        if self._free:
+            return self._free.popleft()
+        # free list dry: evict the least-recently-idled shared prefix page
+        _, page = self._idle.popitem(last=False)
+        return page
+
+    def _prefix_hits(self, prompt_ids, n_sharable):
+        """Longest run of already-resident prefix pages.  A miss at page i
+        implies misses after it: whoever registered a longer prefix also
+        registered every shorter one."""
+        hits = []
+        for i in range(n_sharable):
+            key = tuple(prompt_ids[:(i + 1) * self.page_size])
+            if key in self._active or key in self._idle:
+                hits.append(key)
+            else:
+                break
+        return hits
+
+    def can_allocate(self, prompt_ids, num_tokens):
+        return self._plan(prompt_ids, num_tokens) is not None
+
+    def _plan(self, prompt_ids, num_tokens):
+        need = self.pages_for(num_tokens)
+        n_sharable = 0
+        if self.prefix_sharing:
+            # pages fully covered by the prompt; decode's first write goes
+            # to position len(prompt), past all of them even when the
+            # prompt ends exactly on a page boundary
+            n_sharable = min(len(prompt_ids) // self.page_size, need)
+        hits = self._prefix_hits(prompt_ids, n_sharable) \
+            if n_sharable else []
+        fresh = need - len(hits)
+        idle_hits = sum(1 for k in hits if k in self._idle)
+        if fresh > len(self._free) + (len(self._idle) - idle_hits):
+            return None
+        return need, n_sharable, hits
+
+    def allocate(self, prompt_ids, num_tokens):
+        """Reserve pages covering ``num_tokens`` for a sequence with this
+        prompt; ``None`` when the pool can't satisfy it (caller keeps the
+        request queued).  ``num_tokens`` must include the prompt AND every
+        token the sequence may generate."""
+        prompt_ids = [int(t) for t in prompt_ids]
+        if num_tokens < len(prompt_ids):
+            raise ValueError("num_tokens must cover the prompt")
+        plan = self._plan(prompt_ids, num_tokens)
+        if plan is None:
+            return None
+        need, n_sharable, hits = plan
+        pages, keys = [], []
+        for key in hits:
+            ent = self._active.get(key)
+            if ent is not None:
+                ent[1] += 1
+            else:
+                ent = self._active[key] = [self._idle.pop(key), 1]
+            pages.append(ent[0])
+            keys.append(key)
+        for i in range(len(hits), need):
+            key = tuple(prompt_ids[:(i + 1) * self.page_size]) \
+                if i < n_sharable else None
+            # idle keys are not prefix-closed (LRU eviction drops them
+            # independently), so a key past the first hit-miss can still sit
+            # idle: claim it here, or free() would later overwrite the idle
+            # entry and orphan its page from the pool
+            if key is not None and key in self._idle:
+                page = self._idle.pop(key)
+            else:
+                page = self._pop_free()
+            pages.append(page)
+            if key is not None:  # new shareable prefix page: register it
+                self._active[key] = [page, 1]
+                keys.append(key)
+        return PageAllocation(pages, keys)
+
+    def free(self, alloc: PageAllocation):
+        """Release a retired sequence's pages: private pages return to the
+        free list; shared prefix pages decref and park in the idle cache
+        when the last holder leaves."""
+        for key in alloc.shared_keys:
+            ent = self._active[key]
+            ent[1] -= 1
+            if ent[1] == 0:
+                del self._active[key]
+                self._idle[key] = ent[0]
+        for page in alloc.pages[alloc.num_shared:]:
+            self._free.append(page)
+        alloc.pages = []
+        alloc.shared_keys = ()

@@ -1,0 +1,617 @@
+"""Continuous-batching LLM serving engine (Orca iteration-level scheduling
+x vLLM paged KV blocks, TPU-native).
+
+One fixed-shape batch of ``num_slots`` decode slots runs against per-layer
+GLOBAL page pools; a background scheduler thread executes iterations:
+
+1. retire slots that hit EOS / max_new_tokens / deadline / cancellation
+   (pages return to the :class:`~.block_manager.BlockManager` immediately);
+2. admit waiting prompts into free slots while the page pool can cover
+   their worst case (prompt + max_new) — each admit runs one compiled
+   prefill that writes the prompt's K/V into its pages and samples the
+   first token;
+3. run ONE compiled decode step for the whole batch — every slot at its
+   OWN position (per-slot lens / page table rows), inactive slots pointed
+   at a scratch page — then sync the sampled tokens to the host.
+
+No caller ever waits for the slowest sequence in the batch: a short
+request retires and its slot backfills from the queue while long ones keep
+decoding.  The compiled programs follow the ``_decode.py`` discipline —
+pools are DONATED into each call and the jitted prefill/step pair is
+cached in :func:`~paddle_tpu.text.models._decode.program_store`, so there
+is exactly ONE trace per (model, batch-shape, sampler) tuple; trace
+counters are exported so tests can assert it.
+
+Observability (PR-1 metrics registry): ``serving.ttft_seconds``,
+``serving.inter_token_seconds``, ``serving.step_seconds``,
+``serving.prefill_seconds`` histograms; ``serving.queue_depth``,
+``serving.active_slots``, ``serving.slot_occupancy``,
+``serving.page_utilization``, ``serving.pages_in_use`` gauges;
+``serving.requests{status=...}``, ``serving.tokens_generated``,
+``serving.admissions_blocked``, ``serving.preemptions``,
+``serving.step_traces``, ``serving.prefill_traces`` counters.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import itertools
+import queue as _queue
+import threading
+import time
+
+import jax
+import numpy as np
+
+from .adapter import GPTAdapter
+from .block_manager import BlockManager
+
+
+class RequestRejectedError(RuntimeError):
+    """Raised by submit() for requests the engine can never serve (too long
+    for the model/page pool) or when the admission queue is full."""
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request sampling.  ``temperature <= 0`` is greedy; temperature
+    rows and greedy rows share ONE compiled step (the batched sampler
+    branches per slot).  top_k/top_p are engine-level statics — part of the
+    compiled program key, not per-request."""
+
+    temperature: float = 0.0
+    seed: int | None = None  # reserved; draws come from the engine stream
+
+
+@dataclasses.dataclass
+class Request:
+    prompt: list
+    max_new_tokens: int
+    sampling: SamplingParams
+    eos_token_id: int | None
+    deadline: float | None      # absolute time.time() seconds
+    handle: "RequestHandle"
+
+
+class RequestHandle:
+    """Caller-side view of a submitted request.
+
+    ``result(timeout)`` blocks for the generated ids; ``stream()`` yields
+    tokens as the engine produces them (closing the iterator cancels the
+    request and frees its pages); ``cancel()`` retires it at the next
+    iteration."""
+
+    def __init__(self, request_id, prompt_len):
+        self.request_id = request_id
+        self.prompt_len = prompt_len
+        self.token_ids = []            # generated ids (appended by the engine)
+        self.status = "queued"
+        self.submitted_at = time.time()
+        self.first_token_at = None
+        self.finished_at = None
+        self.first_token_iteration = None
+        self.finished_iteration = None
+        self._events = _queue.Queue()
+        self._done = threading.Event()
+        self._cancel = threading.Event()
+        self._error = None
+
+    # ----------------------------------------------------------------- api
+    def cancel(self):
+        self._cancel.set()
+
+    @property
+    def cancelled(self):
+        return self._cancel.is_set()
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        """Generated token ids (blocks until the request finishes)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished after {timeout}s")
+        if self._error is not None:
+            raise RuntimeError("serving engine failed") from self._error
+        return list(self.token_ids)
+
+    def stream(self):
+        """Token-at-a-time iterator.  Abandoning the iterator (``close()``
+        / ``break`` + GC) cancels the request so its pages free."""
+        try:
+            while True:
+                kind, val = self._events.get()
+                if kind == "token":
+                    yield val
+                else:
+                    break
+            if self._error is not None:
+                raise RuntimeError("serving engine failed") from self._error
+        finally:
+            if not self._done.is_set():
+                self.cancel()
+
+    __iter__ = stream
+
+    @property
+    def ttft(self):
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+
+class _Slot:
+    __slots__ = ("handle", "req", "alloc", "table_row", "length", "last",
+                 "produced", "temp", "eos", "max_new", "deadline",
+                 "last_token_t")
+
+    def __init__(self, req, alloc, table_row):
+        self.handle = req.handle
+        self.req = req
+        self.alloc = alloc
+        self.table_row = table_row          # np.int32 [<= NP] real pages
+        self.length = len(req.prompt)       # tokens whose K/V are in pages
+        self.last = 0                       # last sampled token id
+        self.produced = 0
+        self.temp = float(req.sampling.temperature)
+        self.eos = req.eos_token_id
+        self.max_new = req.max_new_tokens
+        self.deadline = req.deadline
+        self.last_token_t = None
+
+
+class ServingEngine:
+    """See module docstring.  Typical use::
+
+        engine = ServingEngine(model, num_slots=4, page_size=16)
+        with engine:
+            h = engine.submit([1, 2, 3], max_new_tokens=64)
+            for tok in h.stream():
+                ...
+    """
+
+    def __init__(self, model, num_slots=4, page_size=16, max_model_len=None,
+                 num_pages=None, top_k=0, top_p=1.0, prefix_sharing=False,
+                 max_queue=None, seed=0, adapter=None):
+        self._model = model
+        self._adapter = adapter if adapter is not None \
+            else GPTAdapter(model, page_size)
+        self.page_size = int(page_size)
+        self.num_slots = int(num_slots)
+        cap = self._adapter.max_model_len
+        self.max_model_len = min(int(max_model_len), cap) if max_model_len \
+            else cap
+        self.table_width = -(-self.max_model_len // self.page_size)  # NP
+        if num_pages is None:
+            num_pages = self.num_slots * self.table_width  # full residency
+        self._bm = BlockManager(num_pages, self.page_size,
+                                prefix_sharing=prefix_sharing)
+        # pool row num_pages is the SCRATCH page: inactive decode slots and
+        # padded table tails point at it (every table entry must be a valid
+        # pool row; junk written there is never attended)
+        self._scratch = int(num_pages)
+        self._pools = self._adapter.init_pools(num_pages + 1)
+        self._params, self._bufs = self._adapter.params_and_buffers()
+        from ..text.models._decode import make_batched_sampler
+
+        self._sampler = make_batched_sampler(top_k, top_p)
+        self._top = (int(top_k), float(top_p))
+        self._base_key = jax.random.key(int(seed))
+        self._key_counter = itertools.count()
+        self._rid_counter = itertools.count()
+
+        self._queue = collections.deque()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._slots = [None] * self.num_slots
+        self._max_queue = max_queue
+        self._stop_evt = threading.Event()
+        self._thread = None
+        self._started = False
+        self._modes = None
+        self._iteration = 0
+        self._error = None
+
+        from ..profiler import metrics as _metrics
+
+        self._m_ttft = _metrics.histogram(
+            "serving.ttft_seconds", "submit -> first token")
+        self._m_itl = _metrics.histogram(
+            "serving.inter_token_seconds", "per-sequence inter-token latency")
+        self._m_step_seconds = _metrics.histogram(
+            "serving.step_seconds", "one batched decode iteration")
+        self._m_prefill_seconds = _metrics.histogram(
+            "serving.prefill_seconds", "admit-time prefill")
+        self._m_queue_depth = _metrics.gauge(
+            "serving.queue_depth", "requests waiting for a slot")
+        self._m_active = _metrics.gauge(
+            "serving.active_slots", "slots decoding this iteration")
+        self._m_occupancy = _metrics.gauge(
+            "serving.slot_occupancy", "active_slots / num_slots")
+        self._m_page_util = _metrics.gauge(
+            "serving.page_utilization", "KV pages in use / pool size")
+        self._m_pages_used = _metrics.gauge(
+            "serving.pages_in_use", "KV pages held by live sequences")
+        self._m_tokens = _metrics.counter(
+            "serving.tokens_generated", "tokens emitted to callers")
+        self._m_requests = _metrics.counter(
+            "serving.requests", "requests by terminal status")
+        self._m_blocked = _metrics.counter(
+            "serving.admissions_blocked",
+            "admissions deferred: page pool exhausted")
+        self._m_preempt = _metrics.counter(
+            "serving.preemptions", "running sequences retired by deadline")
+        self._m_step_traces = _metrics.counter(
+            "serving.step_traces", "decode-step program traces")
+        self._m_prefill_traces = _metrics.counter(
+            "serving.prefill_traces", "prefill program traces")
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        # error check FIRST: after a scheduler-thread crash _started may
+        # still read True, and submit() must reject loudly, not enqueue
+        # onto a dead engine
+        if self._error is not None:
+            raise RuntimeError("engine previously failed") from self._error
+        if self._started:
+            return self
+        self._modes = [(m, m.training)
+                       for m in self._model.sublayers(include_self=True)]
+        self._model.eval()
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="paddle-serving-engine", daemon=True)
+        self._started = True
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if not self._started:
+            return
+        self._stop_evt.set()
+        with self._cv:
+            self._cv.notify_all()
+        # generous join: a first-call prefill may sit in a minutes-long XLA
+        # compile.  NEVER touch slots/pages while the thread could still be
+        # alive — that would double-free pages it is about to retire.
+        self._thread.join(timeout=600)
+        if self._thread.is_alive():
+            raise RuntimeError(
+                "serving scheduler thread did not stop within 600s "
+                "(stuck in a compile or device call); engine state left "
+                "untouched — retry stop() once the call returns")
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                self._bm.free(s.alloc)
+                self._slots[i] = None
+                self._finish(s.handle, "cancelled")
+        with self._lock:
+            while self._queue:
+                self._finish(self._queue.popleft().handle, "cancelled")
+        if self._modes is not None:
+            for m, tr in self._modes:
+                m.training = tr
+            self._modes = None
+        self._started = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------------ api
+    def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
+               eos_token_id=None, deadline_s=None, sampling=None):
+        """Queue one request; returns a :class:`RequestHandle` immediately.
+        ``deadline_s`` is a wall-clock budget from now — a sequence still
+        queued or decoding past it is retired with status ``expired``."""
+        prompt = self._normalize_prompt(prompt_ids)
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        sampling = sampling if sampling is not None \
+            else SamplingParams(temperature=temperature)
+        total = len(prompt) + int(max_new_tokens)
+        handle = RequestHandle(next(self._rid_counter), len(prompt))
+        if total > self.max_model_len \
+                or self._bm.pages_for(total) > self._bm.num_pages:
+            self._m_requests.inc(status="rejected")
+            raise RequestRejectedError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
+                f"needs {self._bm.pages_for(total)} pages / "
+                f"{total} positions; engine caps are "
+                f"{self._bm.num_pages} pages / {self.max_model_len} positions")
+        self.start()  # before enqueue: a failed engine rejects loudly
+        with self._cv:
+            if self._max_queue is not None \
+                    and len(self._queue) >= self._max_queue:
+                self._m_requests.inc(status="rejected")
+                raise RequestRejectedError(
+                    f"admission queue full ({self._max_queue})")
+            deadline = time.time() + deadline_s if deadline_s is not None \
+                else None
+            self._queue.append(Request(prompt, int(max_new_tokens), sampling,
+                                       eos_token_id, deadline, handle))
+            self._m_requests.inc(status="submitted")
+            self._m_queue_depth.set(len(self._queue))
+            self._cv.notify_all()
+        return handle
+
+    def generate(self, prompt_ids, max_new_tokens=32, timeout=None, **kw):
+        """Blocking convenience: submit + wait; returns generated ids."""
+        return self.submit(prompt_ids, max_new_tokens, **kw).result(timeout)
+
+    def stream(self, prompt_ids, max_new_tokens=32, **kw):
+        """Token-at-a-time iterator (see :meth:`RequestHandle.stream`)."""
+        return self.submit(prompt_ids, max_new_tokens, **kw).stream()
+
+    # ------------------------------------------------------------ internals
+    @staticmethod
+    def _normalize_prompt(prompt_ids):
+        arr = prompt_ids
+        if hasattr(arr, "numpy"):
+            arr = arr.numpy()
+        arr = np.asarray(arr)
+        if arr.ndim == 2 and arr.shape[0] == 1:
+            arr = arr[0]
+        if arr.ndim != 1:
+            raise ValueError(f"prompt must be 1-D (or [1, S]), "
+                             f"got shape {arr.shape}")
+        return [int(t) for t in arr]
+
+    def _next_key(self):
+        return jax.random.fold_in(self._base_key, next(self._key_counter))
+
+    def _program(self, key, build):
+        from ..text.models._decode import program_store
+
+        store = program_store(self._model)
+        ent = store.get(key)
+        if ent is None:
+            ent = store[key] = build()
+        return ent
+
+    def _step_program(self):
+        key = ("serve_step", self.num_slots, self.table_width,
+               self._pools[0].shape, str(self._pools[0].dtype), self._top)
+
+        def build():
+            traces = [0]
+            adapter, sampler = self._adapter, self._sampler
+
+            @functools.partial(jax.jit, donate_argnums=(3, 4))
+            def step(params, bufs, last, kp, vp, table, lens, temps, rkey):
+                traces[0] += 1  # python side effect: runs at TRACE time only
+                logits, kp, vp = adapter.step(params, bufs, last, kp, vp,
+                                              table, lens)
+                return sampler(logits, temps, rkey), kp, vp
+
+            return step, traces
+
+        return self._program(key, build)
+
+    def _prefill_program(self, s_pad):
+        key = ("serve_prefill", s_pad, self.table_width,
+               self._pools[0].shape, str(self._pools[0].dtype), self._top)
+
+        def build():
+            traces = [0]
+            adapter, sampler = self._adapter, self._sampler
+
+            @functools.partial(jax.jit, donate_argnums=(3, 4))
+            def prefill(params, bufs, ids, kp, vp, table, lens, temps, rkey):
+                traces[0] += 1
+                logits, kp, vp = adapter.prefill(params, bufs, ids, kp, vp,
+                                                 table, lens)
+                return sampler(logits, temps, rkey), kp, vp
+
+            return prefill, traces
+
+        return self._program(key, build)
+
+    @property
+    def step_traces(self):
+        """Trace count of this engine's decode-step program (the continuous
+        batching invariant: 1 for the engine's lifetime)."""
+        try:
+            return self._step_program()[1][0]
+        except Exception:
+            return 0
+
+    # ---------------------------------------------------------- loop thread
+    def _loop(self):
+        try:
+            while not self._stop_evt.is_set():
+                self._admit()
+                self._update_gauges()
+                if not any(s is not None for s in self._slots):
+                    with self._cv:
+                        if not self._queue and not self._stop_evt.is_set():
+                            self._cv.wait(timeout=0.02)
+                    continue
+                self._step_once()
+        except BaseException as e:  # surface to every waiter, don't hang
+            self._error = e
+            self._abort_all(e)
+
+    def _abort_all(self, exc):
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                self._bm.free(s.alloc)
+                self._slots[i] = None
+                s.handle._error = exc
+                self._finish(s.handle, "error")
+        with self._lock:
+            while self._queue:
+                req = self._queue.popleft()
+                req.handle._error = exc
+                self._finish(req.handle, "error")
+
+    def _admit(self):
+        while True:
+            free_slot = next((i for i, s in enumerate(self._slots)
+                              if s is None), None)
+            if free_slot is None:
+                return
+            with self._lock:
+                req = None
+                while self._queue:
+                    cand = self._queue[0]
+                    if cand.handle.cancelled:
+                        self._queue.popleft()
+                        self._finish(cand.handle, "cancelled")
+                        continue
+                    if cand.deadline is not None \
+                            and time.time() > cand.deadline:
+                        self._queue.popleft()
+                        self._finish(cand.handle, "expired")
+                        continue
+                    req = cand
+                    break
+                if req is None:
+                    return
+                alloc = self._bm.allocate(
+                    req.prompt, len(req.prompt) + req.max_new_tokens)
+                if alloc is None:
+                    # FIFO admission: park until a retirement frees pages
+                    self._m_blocked.inc()
+                    return
+                self._queue.popleft()
+                self._m_queue_depth.set(len(self._queue))
+            self._prefill(req, alloc, free_slot)
+
+    def _prefill(self, req, alloc, slot_idx):
+        ps = self.page_size
+        S0 = len(req.prompt)
+        s_pad = max(ps, -(-S0 // ps) * ps)  # bucket: multiple of page_size
+        ids = np.zeros((1, s_pad), np.int64)
+        ids[0, :S0] = req.prompt
+        table_row = np.asarray(alloc.pages, np.int32)
+        table = np.full((1, self.table_width), self._scratch, np.int32)
+        table[0, :len(table_row)] = table_row
+        lens = np.asarray([S0], np.int32)
+        temps = np.asarray([req.sampling.temperature], np.float32)
+        prog, traces = self._prefill_program(s_pad)
+        n0 = traces[0]
+        t0 = time.perf_counter()
+        tok, kp, vp = prog(self._params, self._bufs, ids, *self._pools,
+                           table, lens, temps, self._next_key())
+        self._pools = (kp, vp)
+        tok = int(np.asarray(tok)[0])
+        if traces[0] > n0:
+            self._m_prefill_traces.inc(traces[0] - n0)
+        self._m_prefill_seconds.observe(time.perf_counter() - t0)
+        slot = _Slot(req, alloc, table_row)
+        slot.last = tok
+        slot.produced = 1
+        req.handle.status = "running"
+        self._slots[slot_idx] = slot
+        self._emit_token(slot, tok)
+        self._retire_if_done(slot_idx)
+
+    def _step_once(self):
+        B = self.num_slots
+        last = np.zeros((B, 1), np.int64)
+        lens = np.zeros((B,), np.int32)
+        temps = np.zeros((B,), np.float32)
+        table = np.full((B, self.table_width), self._scratch, np.int32)
+        active = []
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            active.append(i)
+            last[i, 0] = s.last
+            lens[i] = s.length
+            temps[i] = s.temp
+            table[i, :len(s.table_row)] = s.table_row
+        prog, traces = self._step_program()
+        n0 = traces[0]
+        t0 = time.perf_counter()
+        tok, kp, vp = prog(self._params, self._bufs, last, *self._pools,
+                           table, lens, temps, self._next_key())
+        self._pools = (kp, vp)
+        tok = np.asarray(tok)
+        if traces[0] > n0:
+            self._m_step_traces.inc(traces[0] - n0)
+        self._m_step_seconds.observe(time.perf_counter() - t0)
+        self._iteration += 1
+        for i in active:
+            s = self._slots[i]
+            s.length += 1
+            s.produced += 1
+            s.last = int(tok[i])
+            self._emit_token(s, s.last)
+            self._retire_if_done(i)
+
+    def _emit_token(self, slot, tok):
+        h = slot.handle
+        now = time.time()
+        if h.first_token_at is None:
+            h.first_token_at = now
+            h.first_token_iteration = self._iteration
+            self._m_ttft.observe(now - h.submitted_at)
+        elif slot.last_token_t is not None:
+            self._m_itl.observe(now - slot.last_token_t)
+        slot.last_token_t = now
+        h.token_ids.append(tok)
+        h._events.put(("token", tok))
+        self._m_tokens.inc()
+
+    def _retire_if_done(self, i):
+        slot = self._slots[i]
+        h = slot.handle
+        status = None
+        if h.cancelled:
+            status = "cancelled"
+        elif slot.eos is not None and slot.last == slot.eos:
+            status = "completed"
+        elif slot.produced >= slot.max_new:
+            status = "completed"
+        elif slot.deadline is not None and time.time() > slot.deadline:
+            status = "expired"
+            self._m_preempt.inc()
+        if status is None:
+            return False
+        self._bm.free(slot.alloc)
+        self._slots[i] = None
+        self._finish(h, status)
+        return True
+
+    def _finish(self, handle, status):
+        handle.status = status
+        handle.finished_at = time.time()
+        handle.finished_iteration = self._iteration
+        self._m_requests.inc(status=status)
+        handle._events.put(("done", status))
+        handle._done.set()
+
+    def _update_gauges(self):
+        n = sum(1 for s in self._slots if s is not None)
+        self._m_queue_depth.set(len(self._queue))
+        self._m_active.set(n)
+        self._m_occupancy.set(n / self.num_slots)
+        self._m_page_util.set(self._bm.utilization())
+        self._m_pages_used.set(self._bm.used_pages)
+
+    # -------------------------------------------------------------- insight
+    @property
+    def block_manager(self):
+        return self._bm
+
+    def stats(self):
+        return {
+            "iteration": self._iteration,
+            "queue_depth": len(self._queue),
+            "active_slots": sum(1 for s in self._slots if s is not None),
+            "num_slots": self.num_slots,
+            "pages_in_use": self._bm.used_pages,
+            "num_pages": self._bm.num_pages,
+            "page_utilization": self._bm.utilization(),
+            "step_traces": self.step_traces,
+        }

@@ -15,6 +15,7 @@ from __future__ import annotations
 import contextlib
 
 import jax
+import jax.export  # noqa: F401  (binds jax.export — lazy attr since 0.4.34)
 import jax.numpy as jnp
 
 from ..framework import dtypes as _dt

@@ -18,6 +18,21 @@ import jax.numpy as jnp
 from ...tensor.tensor import Tensor
 
 
+def program_store(model):
+    """The per-model compiled-program cache.
+
+    decode_loop keys it by its program_key tuples; the serving engine
+    (paddle_tpu.serving) keys it by (kind, batch-shape, sampler) tuples so
+    a second engine over the same model reuses the compiled prefill/step
+    pair instead of re-tracing.  Stored via object.__setattr__ so Layer's
+    attribute bookkeeping never sees it."""
+    store = model.__dict__.get("_decode_programs")
+    if store is None:
+        store = {}
+        object.__setattr__(model, "_decode_programs", store)
+    return store
+
+
 def make_sampler(temperature, top_k, top_p):
     def sample(logits, key):
         if temperature == 0.0:
@@ -34,6 +49,32 @@ def make_sampler(temperature, top_k, top_p):
             kth = jnp.take_along_axis(srt, (keep_n - 1)[:, None], axis=-1)
             l = jnp.where(l < kth, -jnp.inf, l)
         return jax.random.categorical(key, l, axis=-1)
+
+    return sample
+
+
+def make_batched_sampler(top_k=0, top_p=1.0):
+    """Per-slot sampler for the serving engine: ONE traced program covers
+    greedy and temperature rows (``temps[b] <= 0`` selects argmax), so a
+    batch mixing greedy and sampled requests shares a single compiled
+    decode step.  top_k/top_p stay static — they are part of the engine's
+    program key, matching make_sampler's trace-time specialization."""
+
+    def sample(logits, temps, key):
+        greedy = jnp.argmax(logits, axis=-1)
+        l = logits / jnp.maximum(temps, jnp.float32(1e-6))[:, None]
+        if top_k:
+            kk = min(int(top_k), l.shape[-1])
+            kth = jax.lax.top_k(l, kk)[0][:, -1][:, None]
+            l = jnp.where(l < kth, -jnp.inf, l)
+        if top_p < 1.0:  # nucleus: smallest prefix of sorted probs >= top_p
+            srt = jnp.sort(l, axis=-1)[:, ::-1]
+            p = jax.nn.softmax(srt, axis=-1)
+            keep_n = (jnp.cumsum(p, axis=-1) - p < top_p).sum(-1)
+            kth = jnp.take_along_axis(srt, (keep_n - 1)[:, None], axis=-1)
+            l = jnp.where(l < kth, -jnp.inf, l)
+        samp = jax.random.categorical(key, l, axis=-1)
+        return jnp.where(temps <= jnp.float32(0.0), greedy, samp)
 
     return sample
 
@@ -66,10 +107,7 @@ def decode_loop(model, fwd, ids0, max_new_tokens, init_cache,
     progs = None
     store = None
     if program_key is not None:
-        store = model.__dict__.get("_decode_programs")
-        if store is None:
-            store = {}
-            object.__setattr__(model, "_decode_programs", store)
+        store = program_store(model)
         progs = store.get(program_key)
     if progs is None:
         sample = make_sampler(temperature, top_k, top_p)

@@ -96,6 +96,50 @@ class GPTDecoderLayer(Layer):
         heads_here = qkv.shape[-1] // (3 * self.head_dim)
         qkv = qkv.reshape([B, S, heads_here, 3, self.head_dim])
         q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        if cache is not None and len(cache) == 5 and cache[0] == "served":
+            # SERVED cache (continuous-batching engine, paddle_tpu.serving):
+            # ONE global page pool [P, ps, h, d] shared by every slot
+            # through an explicit per-slot page table [B, NP], and per-slot
+            # lengths [B] — each slot decodes at its OWN position, which is
+            # what iteration-level batching needs (the "paged" branch below
+            # locks the whole batch to a single scalar ``pos``).
+            from ...ops.paged_attention import (paged_attention,
+                                                paged_table_prefill_write,
+                                                paged_table_token_write)
+
+            _, kp, vp, table, lens = cache
+            if S > 1:
+                # admit-time prefill: dense causal attention over the
+                # (right-padded) prompt; positions past a row's true length
+                # write junk into pages that per-slot seq_lens masking (or
+                # the engine's scratch page) keeps invisible
+                attn = F.scaled_dot_product_attention(
+                    q, k, v, is_causal=True, dropout_p=0.0, training=False)
+                kp = _apply(paged_table_prefill_write, kp, k, table,
+                            op_name="paged_write")
+                vp = _apply(paged_table_prefill_write, vp, v, table,
+                            op_name="paged_write")
+            else:
+                kp = _apply(
+                    lambda pgs, kk, tb, ln:
+                        paged_table_token_write(pgs, kk[:, 0], tb, ln),
+                    kp, k, table, lens, op_name="paged_write")
+                vp = _apply(
+                    lambda pgs, vv, tb, ln:
+                        paged_table_token_write(pgs, vv[:, 0], tb, ln),
+                    vp, v, table, lens, op_name="paged_write")
+                attn = _apply(
+                    lambda qq, kps, vps, tb, ln:
+                        paged_attention(qq[:, 0], kps, vps, tb,
+                                        ln.astype(jnp.int32) + 1)[:, None],
+                    q, kp, vp, table, lens, op_name="paged_attention")
+            attn = attn.reshape([B, S, heads_here * self.head_dim])
+            x = residual + self.dropout(self.out_proj(attn))
+            residual = x
+            h = self.ln2(x)
+            h = self.ffn2(self.act(self.ffn1(h)))
+            x = residual + self.dropout(h)
+            return x, ("served", kp, vp, table, lens)
         if cache is not None and len(cache) == 4 and cache[0] == "paged":
             # PAGED cache (serving decode): per-layer page pools
             # [B, PP, ps, h, d] — HBM bound by pages allocated, not a dense

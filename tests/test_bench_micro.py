@@ -65,7 +65,8 @@ def test_attention_sweep_runs_and_matches():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
-def test_raw_bert_step_trains():
+@pytest.mark.slow  # ~170s on the single-core CI mesh: 17% of the whole
+def test_raw_bert_step_trains():  # tier-1 budget for one baseline check
     p = raw_bert.build_params(jax.random.key(0))
     m = jax.tree_util.tree_map(jnp.zeros_like, p)
     v = jax.tree_util.tree_map(jnp.zeros_like, p)

@@ -23,8 +23,9 @@ def _gt():
     return paddle.to_tensor(gtb), paddle.to_tensor(gtl)
 
 
-def test_yolo_trains_and_evals():
-    from paddle_tpu.vision.models import yolov3
+@pytest.mark.slow  # full-detector train loops (~30-50s each on the CI
+def test_yolo_trains_and_evals():  # mesh); tier-1 keeps the cheap shape/
+    from paddle_tpu.vision.models import yolov3  # loss/backbone coverage
 
     rng = np.random.RandomState(0)
     img = paddle.to_tensor(rng.randn(2, 3, 128, 128).astype("float32"))
@@ -47,6 +48,7 @@ def test_yolo_trains_and_evals():
     assert dets[0]["valid"].numpy().dtype == bool
 
 
+@pytest.mark.slow
 def test_faster_rcnn_trains_and_evals():
     from paddle_tpu.vision.models import faster_rcnn
 
@@ -285,6 +287,7 @@ def test_varifocal_loss_formula():
     np.testing.assert_allclose(got, bce * w, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ppyoloe_trains_and_evals():
     from paddle_tpu.vision.models.detection import ppyoloe
 

@@ -1,0 +1,114 @@
+"""Inference-path observability satellites (serving PR): real profiler
+behind Config.enable_profile(), Predictor.run() metrics, and pre-run
+output arity from the saved spec.json metadata."""
+
+import json
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.inference as infer
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.profiler import metrics as prof_metrics
+
+
+class _TwoOut(Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        return h, h + 1.0
+
+
+def _save(m, d, spec_shape=(None, 4)):
+    prefix = d + "/model"
+    paddle.jit.save(m, prefix, input_spec=[
+        paddle.static.InputSpec(list(spec_shape), "float32", name="x")])
+    return prefix
+
+
+def test_output_arity_from_spec_json_pre_run():
+    """get_output_names() must reflect the artifact's true output count
+    BEFORE the first run() (n_outputs recorded by jit.save), instead of
+    defaulting to 1."""
+    paddle.seed(0)
+    with tempfile.TemporaryDirectory() as d:
+        prefix = _save(_TwoOut(), d)
+        with open(prefix + ".spec.json") as f:
+            assert json.load(f)["n_outputs"] == 2
+        pred = infer.create_predictor(infer.Config(prefix))
+        assert pred.get_output_names() == ["output_0", "output_1"]
+        # and post-run the observed arity agrees
+        outs = pred.run([np.ones((2, 4), "float32")])
+        assert len(outs) == 2
+        assert pred.get_output_names() == ["output_0", "output_1"]
+
+
+def test_output_arity_fallback_without_meta():
+    """Artifacts saved before n_outputs existed keep the old default."""
+    paddle.seed(0)
+    with tempfile.TemporaryDirectory() as d:
+        prefix = _save(_TwoOut(), d)
+        with open(prefix + ".spec.json") as f:
+            meta = json.load(f)
+        del meta["n_outputs"]
+        with open(prefix + ".spec.json", "w") as f:
+            json.dump(meta, f)
+        pred = infer.create_predictor(infer.Config(prefix))
+        assert pred.get_output_names() == ["output_0"]  # legacy default
+        pred.run([np.ones((2, 4), "float32")])
+        assert pred.get_output_names() == ["output_0", "output_1"]
+
+
+def test_predictor_run_metrics():
+    """The legacy single-request path reports through the same PR-1
+    registry schema as the serving engine."""
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    with tempfile.TemporaryDirectory() as d:
+        prefix = _save(m, d, spec_shape=(None, 8))
+        pred = infer.create_predictor(infer.Config(prefix))
+        reg = prof_metrics.get_registry()
+        req0 = reg.get("inference.requests").total() \
+            if reg.get("inference.requests") else 0
+        x = np.random.RandomState(0).randn(3, 8).astype("float32")
+        pred.run([x])
+        pred.run([x])
+        lab = {"model": "model"}
+        assert reg.get("inference.requests").total() == req0 + 2
+        assert reg.get("inference.input_bytes").get(**lab) >= 2 * x.nbytes
+        assert reg.get("inference.output_bytes").get(**lab) >= 2 * 3 * 4 * 4
+        h = reg.get("inference.run_seconds").labels(**lab)
+        assert h.count >= 2 and h.sum > 0
+        assert "inference_run_seconds_bucket" in reg.to_prometheus()
+
+
+def test_enable_profile_is_real():
+    """Config.enable_profile() arms the PR-1 profiler: run() produces a
+    per-op summary (not an inert recorded flag)."""
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    with tempfile.TemporaryDirectory() as d:
+        prefix = _save(m, d, spec_shape=(None, 8))
+        cfg = infer.Config(prefix)
+        cfg.enable_profile()
+        assert "profile" in cfg.summary()
+        pred = infer.create_predictor(cfg)
+        assert pred.profiler is not None
+        x = np.random.RandomState(0).randn(3, 8).astype("float32")
+        pred.run([x])
+        pred.run([x])
+        txt = pred.profile_summary()
+        # the op table saw the predictor region AND the artifact execution
+        assert "predictor.run" in txt
+        assert "translated_layer" in txt
+        # un-profiled predictors refuse instead of returning junk
+        p2 = infer.create_predictor(infer.Config(prefix))
+        assert p2.profiler is None
+        with pytest.raises(RuntimeError):
+            p2.profile_summary()

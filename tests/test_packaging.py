@@ -18,7 +18,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_pyproject_metadata_parses():
-    import tomllib
+    try:
+        import tomllib  # py3.11+
+    except ImportError:
+        import tomli as tomllib
 
     with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
         meta = tomllib.load(f)

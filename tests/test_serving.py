@@ -1,0 +1,395 @@
+"""paddle_tpu.serving — continuous-batching engine over the paged KV cache.
+
+All on the CPU backend with a tiny GPT: mixed-length independence, slot
+backfill, page-pool admission control, stream cancellation, deadline
+expiry, the one-trace-per-(batch-shape, sampler) invariant, metrics in the
+PR-1 registry, and exact greedy parity with generate()."""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.profiler import metrics as prof_metrics
+from paddle_tpu.serving import (
+    BlockManager, ContinuousBatchingPredictor, RequestRejectedError,
+    ServingEngine,
+)
+from paddle_tpu.text.models.gpt import GPTForCausalLM
+
+PS = 8          # page size used throughout
+MAXLEN = 64
+
+
+def _tiny_gpt(train_steps=5, seed=0):
+    """Tiny GPT, briefly trained so greedy decode emits varied tokens."""
+    paddle.seed(seed)
+    m = GPTForCausalLM(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                       num_attention_heads=2, max_position_embeddings=MAXLEN)
+    if train_steps:
+        o = opt.AdamW(learning_rate=1e-2, parameters=m.parameters())
+        step = paddle.jit.TrainStep(m, o, loss_fn=None)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(1, 96, (8, 20)).astype("int64"))
+        for _ in range(train_steps):
+            step({"input_ids": ids, "labels": ids})
+    return m.eval()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_gpt()
+
+
+def _prompt(n, seed=1):
+    return np.random.RandomState(seed).randint(1, 96, (n,)).tolist()
+
+
+def _ref_tokens(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray([prompt], "int64"))
+    out = model.generate(ids, max_new_tokens=n, temperature=0.0,
+                         cache_impl="paged", page_size=PS,
+                         max_len=len(prompt) + n)
+    return [int(t) for t in out.numpy()[0, len(prompt):]]
+
+
+# ================================================================ engine
+def test_greedy_parity_with_generate(model):
+    """Engine tokens == generate() greedy tokens, per request, for prompts
+    at and across page boundaries — continuous batching must not change
+    the math."""
+    prompts = [_prompt(3, 2), _prompt(8, 3), _prompt(13, 4), _prompt(16, 5)]
+    with ServingEngine(model, num_slots=3, page_size=PS,
+                       max_model_len=MAXLEN) as eng:
+        hs = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        results = [h.result(timeout=300) for h in hs]
+    for p, r in zip(prompts, results):
+        assert r == _ref_tokens(model, p, 12)
+
+
+def test_mixed_lengths_finish_independently(model):
+    """A short request is NOT held hostage by a long one sharing the batch
+    (the lock-step decode's failure mode this engine exists to fix)."""
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN) as eng:
+        long_h = eng.submit(_prompt(8, 10), max_new_tokens=30)
+        short_h = eng.submit(_prompt(6, 11), max_new_tokens=4)
+        short_toks = short_h.result(timeout=300)
+        long_toks = long_h.result(timeout=300)
+    assert len(short_toks) == 4 and len(long_toks) == 30
+    assert short_h.status == long_h.status == "completed"
+    # the short request retired ~26 iterations before the long one
+    assert short_h.finished_iteration + 20 <= long_h.finished_iteration
+
+
+def test_slot_backfill_after_retirement(model):
+    """4 requests through 2 slots: the 3rd/4th are admitted into slots
+    freed by earlier retirements, not serialized behind the whole batch."""
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN) as eng:
+        hs = [eng.submit(_prompt(6, 20 + i), max_new_tokens=6)
+              for i in range(4)]
+        for h in hs:
+            assert len(h.result(timeout=300)) == 6
+    first_finish = min(h.finished_iteration for h in hs[:2])
+    for h in hs[2:]:
+        assert h.first_token_iteration >= first_finish
+
+
+def test_eos_retires_and_backfills(model):
+    """A sequence hitting EOS retires early (fewer than max_new tokens) and
+    its slot is immediately reused by a queued request."""
+    p = _prompt(6, 30)
+    ref = _ref_tokens(model, p, 12)
+    # pick an eos whose FIRST greedy occurrence is mid-decode
+    eos = next(t for i, t in enumerate(ref) if i > 0 and t not in ref[:i])
+    stop_at = ref.index(eos)
+    with ServingEngine(model, num_slots=1, page_size=PS,
+                       max_model_len=MAXLEN) as eng:
+        h1 = eng.submit(p, max_new_tokens=12, eos_token_id=eos)
+        h2 = eng.submit(_prompt(5, 31), max_new_tokens=3)
+        t1 = h1.result(timeout=300)
+        t2 = h2.result(timeout=300)
+    assert t1 == ref[:stop_at + 1] and t1[-1] == eos  # stopped AT eos
+    assert len(t2) == 3                          # backfilled + completed
+    assert h2.first_token_iteration >= h1.finished_iteration
+    # pages back in the pool
+    assert eng.block_manager.free_pages == eng.block_manager.num_pages
+
+
+def test_page_exhaustion_queues_admission(model):
+    """Admission control: with pages for only one sequence in flight, the
+    second request queues (admissions_blocked counts it) and is admitted
+    after the first retires — not rejected, not corrupted."""
+    blocked0 = prof_metrics.counter("serving.admissions_blocked").total()
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN, num_pages=2) as eng:
+        # each request: 1 page of prompt + 1 page of decode = the whole pool
+        h1 = eng.submit(_prompt(8, 40), max_new_tokens=8)
+        h2 = eng.submit(_prompt(8, 41), max_new_tokens=8)
+        t1 = h1.result(timeout=300)
+        t2 = h2.result(timeout=300)
+    assert len(t1) == 8 and len(t2) == 8
+    assert h2.first_token_iteration >= h1.finished_iteration
+    assert prof_metrics.counter("serving.admissions_blocked").total() \
+        > blocked0
+
+
+def test_stream_and_cancellation_frees_pages(model):
+    """stream() yields token-at-a-time; abandoning the stream cancels the
+    request and returns its pages to the pool while the engine keeps
+    serving."""
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN) as eng:
+        h = eng.submit(_prompt(8, 50), max_new_tokens=40)
+        got = []
+        for tok in h.stream():
+            got.append(tok)
+            if len(got) == 3:
+                break  # closes the generator -> cancel
+        assert h.cancelled
+        assert h._done.wait(60)
+        assert h.status == "cancelled"
+        assert len(h.token_ids) < 40
+        # pages freed; engine still serves new work
+        bm = eng.block_manager
+        assert bm.free_pages == bm.num_pages
+        assert len(eng.generate(_prompt(4, 51), max_new_tokens=2,
+                                timeout=300)) == 2
+
+
+def test_deadline_expiry_semantics(model):
+    """Running past the deadline retires with status 'expired' (partial
+    tokens kept, preemption counted); an already-expired queued request
+    never runs."""
+    preempt0 = prof_metrics.counter("serving.preemptions").total()
+    # own model with a roomy position cap: 240 decode steps give the
+    # deadline plenty of wall-clock room to land mid-decode
+    paddle.seed(11)
+    model = GPTForCausalLM(vocab_size=96, hidden_size=32,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           max_position_embeddings=256).eval()
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=256) as eng:
+        eng.generate(_prompt(8, 60), max_new_tokens=2, timeout=300)  # warm
+        # 240 steps at ~0.5ms/step >> 15ms budget: expires mid-decode
+        h = eng.submit(_prompt(8, 62), max_new_tokens=240, deadline_s=0.015)
+        assert h._done.wait(120)
+        assert h.status == "expired"
+        assert 0 < len(h.token_ids) < 240
+        assert prof_metrics.counter("serving.preemptions").total() > preempt0
+        bm = eng.block_manager
+        assert bm.free_pages == bm.num_pages
+        # queued request whose deadline already passed: expired, no tokens
+        h2 = eng.submit(_prompt(4, 63), max_new_tokens=4, deadline_s=0.0)
+        assert h2._done.wait(60)
+        assert h2.status == "expired" and h2.token_ids == []
+
+
+def test_decode_step_compiles_exactly_once():
+    """The continuous-batching invariant: one trace of the decode step per
+    (batch-shape, sampler) tuple across a whole mixed workload (varied
+    prompt lengths, varied max_new, greedy AND temperature rows)."""
+    m = _tiny_gpt(train_steps=0, seed=7)  # fresh model = fresh program store
+    with ServingEngine(m, num_slots=3, page_size=PS,
+                       max_model_len=MAXLEN) as eng:
+        hs = [eng.submit(_prompt(3 + 2 * i, 70 + i), max_new_tokens=4 + 3 * i,
+                         temperature=0.0 if i % 2 == 0 else 0.8)
+              for i in range(5)]
+        for h in hs:
+            h.result(timeout=300)
+        assert eng.step_traces == 1
+        # and the counter is visible on the shared dashboard
+        assert prof_metrics.counter("serving.step_traces").total() >= 1
+
+    # a SECOND engine over the same model at the same shapes reuses the
+    # compiled pair (program_store) — still one trace
+    with ServingEngine(m, num_slots=3, page_size=PS,
+                       max_model_len=MAXLEN) as eng2:
+        eng2.generate(_prompt(4, 75), max_new_tokens=3, timeout=300)
+        assert eng2.step_traces == 1
+
+
+def test_engine_metrics_exported(model):
+    """TTFT / inter-token / queue-depth / page-utilization series appear in
+    the PR-1 registry and both exporters."""
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN) as eng:
+        hs = [eng.submit(_prompt(6, 80 + i), max_new_tokens=6)
+              for i in range(3)]
+        for h in hs:
+            h.result(timeout=300)
+    reg = prof_metrics.get_registry()
+    ttft = reg.get("serving.ttft_seconds").labels()
+    itl = reg.get("serving.inter_token_seconds").labels()
+    assert ttft.count >= 3 and ttft.mean > 0
+    assert itl.count >= 3 * 4  # >= (6-1) tokens per request, 3 requests
+    assert reg.get("serving.queue_depth") is not None
+    assert reg.get("serving.page_utilization") is not None
+    assert reg.get("serving.tokens_generated").total() >= 18
+    names = {r["name"] for r in reg.collect()}
+    for n in ("serving.ttft_seconds_bucket", "serving.queue_depth",
+              "serving.slot_occupancy", "serving.page_utilization",
+              "serving.requests"):
+        assert n in names, n
+    prom = reg.to_prometheus()
+    assert "serving_ttft_seconds_bucket" in prom
+    assert 'serving_requests{status="completed"}' in prom
+
+
+def test_submit_rejections(model):
+    eng = ServingEngine(model, num_slots=1, page_size=PS,
+                        max_model_len=MAXLEN)
+    rej0 = prof_metrics.counter("serving.requests").get(status="rejected") \
+        or 0
+    with pytest.raises(RequestRejectedError):  # longer than the model cap
+        eng.submit(_prompt(8, 90), max_new_tokens=MAXLEN)
+    eng2 = ServingEngine(model, num_slots=1, page_size=PS,
+                         max_model_len=MAXLEN, max_queue=0)
+    with pytest.raises(RequestRejectedError):  # bounded queue: reject now
+        eng2.submit(_prompt(4, 91), max_new_tokens=4)
+    assert (prof_metrics.counter("serving.requests").get(status="rejected")
+            or 0) >= rej0 + 2
+    eng.stop()
+    eng2.stop()
+
+
+def test_sampling_rows_share_the_batch(model):
+    """Greedy and temperature requests decode in the same iteration batch;
+    sampled ids stay in-vocab and the greedy row stays deterministic."""
+    p = _prompt(6, 95)
+    ref = _ref_tokens(model, p, 8)
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN, seed=3) as eng:
+        hg = eng.submit(p, max_new_tokens=8, temperature=0.0)
+        hs = eng.submit(_prompt(6, 96), max_new_tokens=8, temperature=0.9)
+        assert hg.result(timeout=300) == ref
+        toks = hs.result(timeout=300)
+    assert len(toks) == 8 and all(0 <= t < 96 for t in toks)
+
+
+# ========================================================== block manager
+def test_block_manager_accounting():
+    bm = BlockManager(num_pages=6, page_size=8)
+    a = bm.allocate(list(range(10)), 20)   # 3 pages
+    assert len(a.pages) == 3 and bm.used_pages == 3
+    b = bm.allocate(list(range(5)), 24)    # 3 pages
+    assert bm.used_pages == 6 and bm.free_pages == 0
+    assert bm.allocate([1, 2, 3], 8) is None  # exhausted -> queue, not crash
+    bm.free(a)
+    assert bm.free_pages == 3
+    c = bm.allocate([1, 2, 3], 17)         # 3 pages again
+    assert len(c.pages) == 3 and set(c.pages).isdisjoint(b.pages)
+    bm.free(b), bm.free(c)
+    assert bm.free_pages == 6
+    with pytest.raises(ValueError):
+        bm.allocate([1, 2, 3], 2)          # num_tokens < prompt
+
+
+def test_block_manager_prefix_sharing():
+    bm = BlockManager(num_pages=8, page_size=4, prefix_sharing=True)
+    prompt = list(range(100, 110))         # 10 tokens = 2 full pages + tail
+    a = bm.allocate(prompt, 14)            # 4 pages, 2 shareable
+    assert a.num_shared == 2
+    shared_pages = list(a.pages[:2])       # free() clears alloc.pages
+    b = bm.allocate(list(prompt), 14)      # identical prompt: shares 2 pages
+    assert b.pages[:2] == shared_pages and b.num_shared == 2
+    assert bm.used_pages == 6              # 4 + 2 private, NOT 8
+    # divergent prompt shares nothing
+    c = bm.allocate(list(range(50, 58)), 8)
+    assert set(c.pages).isdisjoint(shared_pages)
+    bm.free(a)
+    assert bm.used_pages == 6              # a's 2 private returned; shared
+    bm.free(b)                             # pages + b + c remain
+    # shared pages idle now, resurrect on the next identical prefix
+    d = bm.allocate(prompt, 14)
+    assert d.pages[:2] == shared_pages
+    bm.free(c), bm.free(d)
+    assert bm.free_pages == 8
+
+
+def test_block_manager_idle_key_reclaim_no_leak():
+    """Regression: idle keys are not prefix-closed (LRU eviction drops them
+    independently), so re-allocating a prompt whose SHORT prefix page was
+    evicted but whose LONG one still sits idle must reclaim the idle page —
+    not register a duplicate and orphan it on free()."""
+    bm = BlockManager(num_pages=6, page_size=2, prefix_sharing=True)
+    a = bm.allocate([1, 2, 3, 4], 4)       # idles keys (1,2) and (1,2,3,4)
+    bm.free(a)
+    b = bm.allocate(list(range(10, 20)), 10)  # 5 pages: evicts ONLY (1,2)
+    bm.free(b)
+    c = bm.allocate([1, 2, 3, 4], 4)       # short prefix misses, long idle
+    bm.free(c)
+    assert bm.free_pages == 6              # nothing orphaned
+
+
+def test_block_manager_idle_eviction():
+    bm = BlockManager(num_pages=3, page_size=4, prefix_sharing=True)
+    a = bm.allocate(list(range(8)), 8)     # 2 shared prefix pages
+    bm.free(a)                             # both park idle
+    assert bm.free_pages == 3
+    # a different prompt needing the whole pool evicts the idle prefixes
+    b = bm.allocate(list(range(20, 24)), 12)
+    assert len(b.pages) == 3
+    bm.free(b)
+
+
+# ============================================================= predictor
+def test_continuous_batching_predictor(model):
+    pred = ContinuousBatchingPredictor(
+        model, max_new_tokens=5, pad_token_id=0,
+        num_slots=2, page_size=PS, max_model_len=MAXLEN)
+    rs = np.random.RandomState(9)
+    ids = np.zeros((3, 10), np.int64)
+    lens = [10, 6, 8]
+    rows = [rs.randint(1, 96, (n,)) for n in lens]
+    for b, row in enumerate(rows):
+        ids[b, :len(row)] = row
+    with pred:
+        assert pred.get_input_names() == ["input_ids"]
+        pred.get_input_handle("input_ids").copy_from_cpu(ids)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    assert out.shape == (3, 15)
+    np.testing.assert_array_equal(out[:, :10], ids)  # prompts preserved
+    for b, row in enumerate(rows):  # continuous batching == per-row greedy
+        ref = _ref_tokens(model, [int(t) for t in row], 5)
+        # generated region starts at column S (padded-prompt alignment)
+        assert list(out[b, 10:15]) == ref
+
+
+# ================================================================ bench
+def test_bench_serving_micro():
+    """bench.py --serving section on a tiny config: emits the aggregate
+    tokens/sec + latency schema and keeps the one-trace invariant."""
+    import bench
+
+    out = bench._measure_serving(
+        n_requests=4, num_slots=2, S0=8, page_size=8,
+        max_news=[4, 10, 6, 12], warm_tokens=2,
+        model_kwargs=dict(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          max_position_embeddings=64))
+    assert out["engine_tokens_per_sec"] > 0
+    assert out["sequential_tokens_per_sec"] > 0
+    assert out["tokens"] == 32
+    assert out["step_traces"] == 1
+    assert out["ttft_mean_s"] is not None and out["itl_p50_s"] is not None
+
+
+@pytest.mark.slow
+def test_bench_serving_beats_sequential():
+    """Acceptance: continuous batching beats sequential generate() on
+    aggregate tokens/sec for a mixed-length workload (>=8 requests).  A
+    bigger model so batching wins clearly; excluded from tier-1 (slow)."""
+    import bench
+
+    out = bench._measure_serving(
+        n_requests=8, num_slots=4, S0=16, page_size=16,
+        model_kwargs=dict(vocab_size=2048, hidden_size=128,
+                          num_hidden_layers=4, num_attention_heads=4,
+                          max_position_embeddings=256),
+        max_news=[8, 48, 16, 64, 24, 32, 12, 56])
+    assert out["speedup_vs_sequential"] > 1.0, out
