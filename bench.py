@@ -217,6 +217,72 @@ def _measure_serving(n_requests=8, num_slots=4, S0=32, page_size=32,
     }
 
 
+def _measure_tracing_overhead(iters=30):
+    """Tracing-enabled vs disabled step-time delta on the two instrumented
+    hot paths (the < 2% disabled-path contract from the observability PR):
+    a small fused TrainStep, and — when more than one device is visible —
+    the eager stacked allreduce.  Reported under --emit-metrics so overhead
+    regressions show up in BENCH_*.json."""
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.observability import tracing
+
+    def timed_steps(fn, n):
+        fn()  # sync point established by caller
+        t0 = time.time()
+        for _ in range(n):
+            out = fn()
+        jax.block_until_ready(out)
+        return (time.time() - t0) / n
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(256, 512), nn.Tanh(), nn.Linear(512, 64))
+    o = opt.Momentum(learning_rate=0.01, momentum=0.9,
+                     parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss())
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(64, 256).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 64, (64,)).astype("int64"))
+
+    def train():
+        return step(x, y)._value
+
+    float(step(x, y))  # compile
+    disabled = timed_steps(train, iters)
+    tr = tracing.Tracer().start()
+    enabled = timed_steps(train, iters)
+    tr.stop()
+    out = {"train_step": {
+        "disabled_s": disabled, "enabled_s": enabled,
+        "overhead_frac": (enabled - disabled) / max(disabled, 1e-12),
+        "spans": len(tr.spans)}}
+
+    if jax.device_count() > 1:
+        import paddle_tpu.distributed as dist
+
+        v = paddle.to_tensor(
+            np.ones((jax.device_count(), 1 << 14), "float32"))
+
+        def allreduce():
+            return dist.all_reduce(v)._value
+
+        allreduce()  # build the shard_map program
+        disabled = timed_steps(allreduce, iters)
+        tr = tracing.Tracer().start()
+        enabled = timed_steps(allreduce, iters)
+        tr.stop()
+        out["allreduce_eager"] = {
+            "disabled_s": disabled, "enabled_s": enabled,
+            "overhead_frac": (enabled - disabled) / max(disabled, 1e-12)}
+    else:
+        out["allreduce_eager"] = {
+            "note": "single device: eager stacked path not exercised"}
+    return out
+
+
 def _mfu_fields(flops_per_sec, peak, matmul_tflops):
     out = {"achieved_tflops": round(flops_per_sec / 1e12, 2),
            "frac_of_measured_matmul": round(
@@ -276,6 +342,8 @@ def _run_section(name):
         return {"tps": _measure_decode("paged")}
     if name == "serving":
         return _measure_serving()
+    if name == "tracing_overhead":
+        return _measure_tracing_overhead()
     if name == "allreduce":
         bw, n = micro.allreduce_bus_bw()
         return {"bw": bw, "n": n}
@@ -326,10 +394,22 @@ def main():
         print(json.dumps(_run_section(section)))
         return
 
+    if "--tracing-overhead" in sys.argv:
+        # standalone: the tracing-enabled vs disabled step-time delta
+        out = {"tracing_overhead": _section("tracing_overhead")}
+        print(json.dumps(out))
+        if "--emit-metrics" in sys.argv:
+            emit_metrics(out, out_dir=_metrics_dir_from_argv())
+        return
+
     if "--serving" in sys.argv:
         # serving micro-benchmark only (own process = fresh device state,
         # same hygiene as the per-section subprocesses of the full run)
         out = {"serving": _section("serving")}
+        if "--emit-metrics" in sys.argv:
+            # the observability contract rides along: tracing on/off delta
+            # in the same BENCH json so overhead regressions are visible
+            out["tracing_overhead"] = _section("tracing_overhead")
         print(json.dumps(out))
         if "--emit-metrics" in sys.argv:
             path = emit_metrics(out, out_dir=_metrics_dir_from_argv())
@@ -429,6 +509,10 @@ def main():
                      "(tests/test_paged_attention.py parity + memory)"),
         },
     }
+    if "--emit-metrics" in sys.argv:
+        # observability contract: the tracing on/off step-time delta lands
+        # in the canonical BENCH_*.json so overhead regressions are visible
+        out["tracing_overhead"] = _section("tracing_overhead")
     print(json.dumps(out))
     if "--emit-metrics" in sys.argv:
         path = emit_metrics(out, out_dir=_metrics_dir_from_argv())

@@ -67,7 +67,8 @@ _LAZY = ("nn", "optimizer", "amp", "io", "metric", "jit", "static", "vision",
          "distributed", "autograd", "device", "framework", "hapi", "profiler",
          "incubate", "utils", "sparse", "signal", "fft", "text", "ops",
          "distribution", "regularizer", "callbacks", "inference",
-         "audio", "version", "quantization", "geometric", "hub", "serving")
+         "audio", "version", "quantization", "geometric", "hub", "serving",
+         "observability")
 
 
 def __getattr__(name):

@@ -398,11 +398,19 @@ class DistModel:
                 "dist_main_program: no compiled program yet — run at least "
                 "one train step (the SPMD module is specialized to the "
                 "first batch's shapes)")
-        fn = next(iter(step._compiled.values()))
-        lowered = fn._jitted.lower(step._diff_params, step._opt_state,
-                                   step._buffers, step._frozen_params,
-                                   step._lr_dev, step._rng_carry,
-                                   *step._last_batch_vals)
+        # the variant that produced _last_batch_vals (TrainStep stamps it
+        # per call) — next(iter(...)) could pair an older variant with the
+        # newest batch avals and re-lower garbage under shape churn
+        fn = getattr(step, "_last_fn", None)
+        if fn is None:
+            fn = next(iter(step._compiled.values()))
+        args = [step._diff_params, step._opt_state, step._buffers,
+                step._frozen_params, step._lr_dev, step._rng_carry]
+        if step._scaler_state is not None:
+            # AMP-scaled steps take the scaler carry as a positional arg;
+            # lowering without it mismatches the jitted signature
+            args.append(step._scaler_state)
+        lowered = fn._jitted.lower(*args, *step._last_batch_vals)
         return lowered.as_text()
 
 

@@ -46,6 +46,9 @@ except ImportError:  # older jax
         return _shard_map_old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                               check_rep=False)
 
+from ..observability import faults as _faults
+from ..observability import tracing as _tracing
+from ..observability import watchdog as _watchdog
 from ..profiler import metrics as _metrics
 from ..tensor.tensor import Tensor
 from .collective import Group, ReduceOp, get_default_group
@@ -173,6 +176,58 @@ def record_collective_traffic(op_name, nranks, nbytes, t0=None, phase="eager"):
 
 def _record_collective(op_name, g, v, t0=None, phase="eager"):
     record_collective_traffic(op_name, g.nranks, _nbytes(v), t0, phase)
+    if phase == "traced" and _tracing._ACTIVE:
+        # point event in the CURRENT trace context: traced collectives fire
+        # once per program build, inside the enclosing TrainStep/to_static
+        # span, so the trace id threads from the step into its collectives
+        _tracing.event(f"collective.{op_name}", phase="traced",
+                       group=g.id, nranks=g.nranks, bytes=_nbytes(v))
+
+
+def _eager_collective(op_name, g, v, op=ReduceOp.SUM, *, _kind=None,
+                      _block=False, **kw):
+    """THE eager dispatch path: every stacked-layout collective runs its
+    jitted shard_map program through here so the forensics hooks bracket
+    it exactly once — a collective-watchdog entry/exit (one global read
+    when no watchdog is armed), the ``collective_hang`` fault-injection
+    site, an optional tracing span, and the PR-1 traffic accounting.
+
+    ``_block`` (barrier) blocks on the result INSIDE the measured bracket
+    so its latency histogram keeps covering the sync wait; with a
+    watchdog armed every op blocks before exit is recorded, so the
+    bracket covers device execution, not just enqueue (a hung ICI
+    collective is caught here, not at some later sync).
+
+    First dispatch of a (program, shape) signature pays jax trace + XLA
+    compile inside this bracket — a legitimately slow step, not a hang —
+    so that call is NOT registered with the watchdog (mirrors the
+    serving engine's ``_compiling`` suppression)."""
+    t0 = perf_counter()
+    sig = (g.mesh, g.axis_name, _kind or op_name, op,
+           tuple(sorted(kw.items())), tuple(v.shape), str(v.dtype))
+    first_dispatch = sig not in _COMPILED_SIGS
+    cm = _tracing.span(f"collective.{op_name}", group=g.id,
+                       nranks=g.nranks, bytes=_nbytes(v)) \
+        if _tracing._ACTIVE else _tracing.NOOP
+    token = None if first_dispatch \
+        else _watchdog.collective_begin(op_name, g)
+    try:
+        with cm:
+            _faults.maybe("collective_hang")
+            out = _jitted(g, _kind or op_name, op, **kw)(
+                _to_group_sharded(v, g))
+            if _block or token is not None:
+                jax.block_until_ready(out)
+    finally:
+        _watchdog.collective_end(token)
+    _COMPILED_SIGS.add(sig)  # on success only: a crashed compile retries
+    _record_collective(op_name, g, v, t0)
+    return out
+
+
+# (program, shape, dtype) signatures whose XLA compile already happened —
+# grows with the same cardinality as the _jitted lru_cache x input shapes
+_COMPILED_SIGS: set = set()
 
 
 # ------------------------------------------------------------------ public API
@@ -183,9 +238,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_strea
         out = _reduce_traced(v, op, g.axis_name)
         _record_collective("all_reduce", g, v, phase="traced")
     elif _stacked(v, g):
-        t0 = perf_counter()
-        out = _jitted(g, "all_reduce", op)(_to_group_sharded(v, g))
-        _record_collective("all_reduce", g, v, t0)
+        out = _eager_collective("all_reduce", g, v, op)
     else:  # replicated single-controller value
         n = g.nranks
         out = {ReduceOp.SUM: v * n, ReduceOp.PROD: v ** n}.get(op, v)
@@ -202,10 +255,9 @@ def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
         out = _reduce_traced(v, op, g.axis_name)
         _record_collective("reduce", g, v, phase="traced")
     elif _stacked(v, g):
-        t0 = perf_counter()
-        out = _jitted(g, "reduce", op, dst=g.get_group_rank(dst) if dst in g.ranks else dst)(
-            _to_group_sharded(v, g))
-        _record_collective("reduce", g, v, t0)
+        out = _eager_collective(
+            "reduce", g, v, op,
+            dst=g.get_group_rank(dst) if dst in g.ranks else dst)
     else:
         n = g.nranks
         out = {ReduceOp.SUM: v * n, ReduceOp.PROD: v ** n}.get(op, v)
@@ -227,9 +279,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
             tensor_list.extend(Tensor(out[i]) for i in range(g.nranks))
         return Tensor(out)
     if _stacked(v, g):
-        t0 = perf_counter()
-        full = _jitted(g, "all_gather")(_to_group_sharded(v, g))
-        _record_collective("all_gather", g, v, t0)
+        full = _eager_collective("all_gather", g, v)
     else:
         full = jnp.stack([v] * g.nranks)
     if tensor_list is not None:
@@ -294,9 +344,7 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
                                            keepdims=False)
         _record_collective("reduce_scatter", g, v, phase="traced")
     elif v.ndim >= 2 and v.shape[0] == g.nranks and v.shape[1] == g.nranks:
-        t0 = perf_counter()
-        out = _jitted(g, "reduce_scatter", op)(_to_group_sharded(v, g))
-        _record_collective("reduce_scatter", g, v, t0)
+        out = _eager_collective("reduce_scatter", g, v, op)
     else:
         out = v
     if isinstance(tensor, Tensor):
@@ -314,9 +362,7 @@ def broadcast(tensor, src, group=None, sync_op=True):
         out = full[src_local]
         _record_collective("broadcast", g, v, phase="traced")
     elif _stacked(v, g):
-        t0 = perf_counter()
-        out = _jitted(g, "broadcast", src=src_local)(_to_group_sharded(v, g))
-        _record_collective("broadcast", g, v, t0)
+        out = _eager_collective("broadcast", g, v, src=src_local)
     else:
         out = v
     if isinstance(tensor, Tensor):
@@ -352,9 +398,7 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         out = lax.all_to_all(v, g.axis_name, split_axis=0, concat_axis=0, tiled=True)
         _record_collective("alltoall", g, v, phase="traced")
     elif v.ndim >= 2 and v.shape[0] == g.nranks and v.shape[1] == g.nranks:
-        t0 = perf_counter()
-        out = _jitted(g, "alltoall")(_to_group_sharded(v, g))
-        _record_collective("alltoall", g, v, t0)
+        out = _eager_collective("alltoall", g, v)
     else:
         out = v
     if isinstance(out_tensor_list, list):
@@ -372,10 +416,9 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
         _record_collective("alltoall_single", g, v, phase="traced")
     elif v.ndim >= 1 and v.shape[0] == n * n:
         # stacked layout [n*n, ...]: rank j holds rows [j*n, (j+1)*n)
-        t0 = perf_counter()
         v2 = v.reshape((n, n) + tuple(v.shape[1:]))
-        out = _jitted(g, "alltoall")(_to_group_sharded(v2, g)).reshape(v.shape)
-        _record_collective("alltoall_single", g, v, t0)
+        out = _eager_collective("alltoall_single", g, v2,
+                                _kind="alltoall").reshape(v.shape)
     else:
         out = v
     if isinstance(out_tensor, Tensor):
@@ -452,11 +495,9 @@ def barrier(group=None):
     g = _group(group)
     if g.nranks <= 1:
         return
-    t0 = perf_counter()
     one = jnp.ones((g.nranks,), jnp.int32)
-    out = _jitted(g, "all_reduce", ReduceOp.SUM)(_to_group_sharded(one, g))
-    jax.block_until_ready(out)
-    _record_collective("barrier", g, one, t0)
+    _eager_collective("barrier", g, one, ReduceOp.SUM, _kind="all_reduce",
+                      _block=True)
 
 
 class stream:

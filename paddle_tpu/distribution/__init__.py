@@ -605,13 +605,28 @@ class Poisson(Distribution):
         return _apply(lambda v, r: xlogy(v, r) - r - gammaln(v + 1),
                       _t(value), self.rate, op_name="poisson_log_prob")
 
-    def entropy(self):
+    def entropy(self, kmax=None):
         """No closed form: enumerate the truncated support (mass beyond
-        rate + 10*sqrt(rate) + 20 is negligible for any practical rate)."""
+        rate + 10*sqrt(rate) + 20 is negligible for any practical rate).
+
+        The truncation bound is a STATIC shape: with a concrete rate it is
+        derived eagerly; under jit/trace pass ``kmax=`` explicitly (the
+        other methods are trace-safe, and silently concretizing the rate
+        here would be a hidden trace break — ADVICE r5)."""
+        import numpy as np
         from jax.scipy.special import gammaln, xlogy
 
         r = self.rate._value
-        kmax = int(jnp.max(jnp.ceil(r + 10 * jnp.sqrt(r) + 20)))
+        if kmax is None:
+            if isinstance(r, jax.core.Tracer):
+                raise ValueError(
+                    "Poisson.entropy() under jit traces a data-dependent "
+                    "support bound; pass a static kmax=... (an int >= "
+                    "rate + 10*sqrt(rate) + 20 covers the mass) or call "
+                    "it eagerly")
+            rc = np.asarray(r)
+            kmax = int(np.max(np.ceil(rc + 10 * np.sqrt(rc) + 20)))
+        kmax = int(kmax)
 
         def fn(rate):
             k = jnp.arange(kmax + 1, dtype=jnp.float32)

@@ -24,6 +24,7 @@ import os
 import threading
 
 import jax
+import numpy as _np
 
 # PRNG implementation: 'rbg' by default — it lowers to the XLA
 # RngBitGenerator op, which TPUs execute natively.  Measured on the r5
@@ -42,16 +43,22 @@ _IMPL = os.environ.get("PADDLE_TPU_PRNG_IMPL", "rbg")
 _lock = threading.Lock()
 _global_key = jax.random.key(0, impl=_IMPL)
 _seed_value = 0
+# host-side stream for draws that must be CONCRETE Python floats even
+# inside a jit trace (static shape/layout decisions): under omnistaging
+# every jax op gets staged regardless of input concreteness, so these
+# draws ride a numpy Generator, reseeded by paddle.seed alongside the key
+_host_rng = _np.random.default_rng(0)
 
 _scope = threading.local()
 
 
 def seed(s: int):
     """Set the global seed (paddle.seed equivalent). Returns None."""
-    global _global_key, _seed_value
+    global _global_key, _seed_value, _host_rng
     with _lock:
         _seed_value = int(s)
         _global_key = jax.random.key(int(s), impl=_IMPL)
+        _host_rng = _np.random.default_rng(int(s))
 
 
 def get_seed() -> int:
@@ -74,6 +81,16 @@ def next_key():
     with _lock:
         _global_key, sub = jax.random.split(_global_key)
     return sub
+
+
+def host_uniform() -> float:
+    """One uniform [0, 1) draw as a CONCRETE Python float, valid anywhere
+    — including inside a jit trace, where any jax.random op would be
+    staged (omnistaging) and ``float()`` of it would be a concretization
+    error.  Seeded by :func:`seed`; used for static shape/layout
+    decisions like fractional pooling region offsets."""
+    with _lock:
+        return float(_host_rng.random())
 
 
 @contextlib.contextmanager

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 
 from ..framework import random as _rng
 from ..framework.state import no_grad_ctx
+from ..observability import tracing as _tracing
 from ..optimizer.lr import LRScheduler
 from ..profiler import events as _prof_events
 from ..profiler import metrics as _metrics
@@ -213,9 +214,11 @@ class TrainStep:
             fn = self._build(treedef, bool(self.model.training))
             self._compiled[avals] = fn
         # avals only, for dist_main_program re-lowering: holding the real
-        # arrays would pin a full batch of HBM for the TrainStep's lifetime
+        # arrays would pin a full batch of HBM for the TrainStep's lifetime.
+        # _last_fn is the variant those avals belong to — they move together
         self._last_batch_vals = [jax.ShapeDtypeStruct(v.shape, v.dtype)
                                  for v in vals]
+        self._last_fn = fn
         call_args = (self._diff_params, self._opt_state, self._buffers,
                      self._frozen_params, self._lr_dev, self._rng_carry)
         if self._scaler_state is not None:
@@ -233,11 +236,18 @@ class TrainStep:
                 if peak:
                     self._m_mfu.set(achieved / peak)
         self._last_call_t = t_call
-        if _prof_events._ACTIVE:
-            with _prof_events.record("TrainStep"):
+        # span per fused step: traced-phase collective events recorded
+        # while a new variant traces inherit this trace id, so a step and
+        # its collectives correlate in the merged cross-rank timeline
+        cm = _tracing.span("jit.train_step", step=self._step_count,
+                           new_variant=new_variant) \
+            if _tracing._ACTIVE else _tracing.NOOP
+        with cm:
+            if _prof_events._ACTIVE:
+                with _prof_events.record("TrainStep"):
+                    out = fn(*call_args, *vals)
+            else:
                 out = fn(*call_args, *vals)
-        else:
-            out = fn(*call_args, *vals)
         if new_variant:
             # first dispatch of a variant = trace + XLA compile (+ async
             # enqueue); record it and refresh the donation footprint
@@ -287,7 +297,12 @@ class TrainStep:
         compile when PADDLE_TRAINSTEP_COST=1 or a Profiler is recording
         (it re-lowers and compiles the program once more, so it is not free
         — hence the gate); callable explicitly any time after step one."""
-        fn = _fn if _fn is not None else next(iter(self._compiled.values()), None)
+        # default to the variant that produced _last_batch_vals — pairing
+        # an older variant with the newest avals lowers a mismatched
+        # program (same defect dist_main_program had)
+        fn = _fn if _fn is not None else getattr(
+            self, "_last_fn", None) or next(iter(self._compiled.values()),
+                                            None)
         if fn is None or getattr(self, "_last_batch_vals", None) is None:
             return None
         try:

@@ -362,12 +362,26 @@ def _fractional_pool_axis(v, axis, in_size, out_size, u):
     return jnp.moveaxis(pooled, 0, axis)
 
 
+def _draw_fractional_u():
+    """Pseudo-random region offset u in (0, 1) from the FRAMEWORK stream
+    (seeded by ``paddle.seed``) — Python's module-level ``random`` ignores
+    the framework seed, so runs were unreproducible (ADVICE r5).
+
+    Drawn via ``host_uniform`` (a numpy stream reseeded by ``paddle.seed``):
+    region boundaries are STATIC shape decisions computed on the host, and
+    any jax.random draw would be STAGED inside a jit trace (omnistaging),
+    making ``float()`` a concretization error."""
+    from ...framework import random as _rng
+
+    u = _rng.host_uniform()
+    # the draw is [0, 1); the boundary formula needs the OPEN interval
+    return min(max(u, 1e-6), 1.0 - 1e-6)
+
+
 def _fractional_max_pool(x, output_size, n, random_u, name):
     v = unwrap(x)
     if random_u is None:
-        import random as _pyrand
-
-        random_u = _pyrand.random()
+        random_u = _draw_fractional_u()
     if not 0 < float(random_u) < 1:
         raise ValueError(f"random_u must be in (0, 1), got {random_u}")
     out_sp = _norm_tuple(output_size, n)

@@ -348,7 +348,23 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
 
     def forward(self, input, label):
         from ...tensor.dispatch import apply
+        import jax
         import jax.numpy as jnp
+        import numpy as np
+        from ...tensor.tensor import Tensor as _T
+
+        lv = label._value if isinstance(label, _T) else label
+        if not isinstance(lv, jax.core.Tracer):
+            # eager path: out-of-range labels used to be silently masked to
+            # zero loss (ADVICE r5) — fail loudly instead.  Traced labels
+            # can't be inspected; the masked arithmetic below stays the
+            # compiled-path behavior.
+            arr = np.asarray(lv)
+            if arr.size and (arr.min() < 0 or arr.max() >= self.n_classes):
+                raise ValueError(
+                    "AdaptiveLogSoftmaxWithLoss: labels must be in "
+                    f"[0, {self.n_classes}), got range "
+                    f"[{int(arr.min())}, {int(arr.max())}]")
 
         head_lp = self._head_logprob(input)
         tail_lps = [F.log_softmax(out(proj(input)), axis=-1)

@@ -174,14 +174,16 @@ class FractionalMaxPool2D(Layer):
     def __init__(self, output_size, kernel_size=None, random_u=None,
                  return_mask=False, name=None):
         super().__init__()
-        import random as _pyrand
+        from ..functional.pooling import _draw_fractional_u
 
         self.output_size = output_size
         self.kernel_size = kernel_size
         self.return_mask = return_mask
         # one draw per LAYER (reference: the region layout is fixed at
-        # construction when random_u is None)
-        self.random_u = random_u if random_u is not None else _pyrand.random()
+        # construction when random_u is None), from the paddle.seed-seeded
+        # framework stream so construction is reproducible
+        self.random_u = random_u if random_u is not None \
+            else _draw_fractional_u()
 
     def forward(self, x):
         return F.fractional_max_pool2d(x, self.output_size, self.kernel_size,
@@ -194,12 +196,13 @@ class FractionalMaxPool3D(Layer):
     def __init__(self, output_size, kernel_size=None, random_u=None,
                  return_mask=False, name=None):
         super().__init__()
-        import random as _pyrand
+        from ..functional.pooling import _draw_fractional_u
 
         self.output_size = output_size
         self.kernel_size = kernel_size
         self.return_mask = return_mask
-        self.random_u = random_u if random_u is not None else _pyrand.random()
+        self.random_u = random_u if random_u is not None \
+            else _draw_fractional_u()
 
     def forward(self, x):
         return F.fractional_max_pool3d(x, self.output_size, self.kernel_size,

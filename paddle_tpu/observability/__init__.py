@@ -1,0 +1,54 @@
+"""paddle_tpu.observability — distributed tracing + forensics (this PR's
+tentpole; ROADMAP: the first thing a real multi-host deployment needs).
+
+Three pillars over the PR-1 profiler/metrics layer:
+
+- :mod:`.tracing` — ``span()`` with OTLP-convention trace/span ids,
+  per-rank :class:`Tracer` collection wrapping the RecordEvent tree,
+  chrome-trace + OTLP-JSON export, and :func:`merge_rank_traces` to fold
+  per-rank files into one clock-aligned timeline.  Trace ids propagate
+  from ``ServingEngine.submit()`` through prefill/decode iterations and
+  from ``jit.TrainStep`` through the collective wrappers.
+- :mod:`.flight_recorder` + :mod:`.watchdog` — a fixed-size ring of recent
+  spans/events that dumps to ``PADDLE_FLIGHT_DIR`` on SIGTERM/SIGABRT,
+  unhandled exceptions and watchdog triggers; a
+  :class:`~.watchdog.CollectiveWatchdog` bracketing every eager collective
+  and a :class:`~.watchdog.ServingWatchdog` catching a wedged scheduler
+  thread.  :mod:`.faults` provides the injection hooks the tests use to
+  trip both.
+- :mod:`.telemetry` — ``observability.serve(port)``: a stdlib HTTP thread
+  exposing ``/metrics`` (Prometheus text), ``/healthz`` and ``/statusz``
+  (engine occupancy, queue depth, slot table, page-pool utilization,
+  in-flight spans, last flight record).  Also armed by
+  ``PADDLE_TELEMETRY_PORT`` via ``ServingEngine.start()``.
+
+Env flags (README "Distributed tracing & forensics"):
+``PADDLE_FLIGHT_DIR``, ``PADDLE_TELEMETRY_PORT``,
+``PADDLE_COLLECTIVE_TIMEOUT_S``, ``PADDLE_SERVING_WATCHDOG_S``.
+"""
+
+from __future__ import annotations
+
+from . import faults, flight_recorder, telemetry, tracing, watchdog  # noqa: F401
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder, get_flight_recorder, install_crash_handlers,
+)
+from .telemetry import TelemetryServer, add_status_provider, serve  # noqa: F401
+from .tracing import (  # noqa: F401
+    Span, Tracer, current_trace_id, event, merge_rank_traces, new_trace_id,
+    open_spans, span,
+)
+from .watchdog import CollectiveWatchdog, ServingWatchdog  # noqa: F401
+
+__all__ = [
+    "tracing", "flight_recorder", "watchdog", "telemetry", "faults",
+    "Span", "Tracer", "span", "event", "new_trace_id", "current_trace_id",
+    "open_spans", "merge_rank_traces",
+    "FlightRecorder", "get_flight_recorder", "install_crash_handlers",
+    "CollectiveWatchdog", "ServingWatchdog",
+    "TelemetryServer", "serve", "add_status_provider",
+]
+
+# production spelling: export PADDLE_FLIGHT_DIR=/some/dir and importing any
+# instrumented module arms the crash ring + signal/exception dumps
+flight_recorder.maybe_enable_from_env()

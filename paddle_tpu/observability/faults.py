@@ -1,0 +1,91 @@
+"""Fault-injection hooks for the forensics tests.
+
+The watchdogs (:mod:`.watchdog`) are exercised by ARMING a named fault and
+driving the real code path: instrumented sites call :func:`maybe` with
+their site name and, when a matching fault is armed, hang there (a sleep
+that releases early when the fault is cleared) or run an injected callable.
+Disarmed, :func:`maybe` is one module-flag check — the hooks are free in
+production.
+
+Sites wired in this PR:
+
+- ``collective_hang`` — inside every eager collective's watchdog bracket
+  (:mod:`paddle_tpu.distributed.communication`);
+- ``serving.scheduler_wedge`` — top of the serving scheduler loop
+  (:meth:`paddle_tpu.serving.engine.ServingEngine._loop`).
+"""
+
+from __future__ import annotations
+
+import threading
+from time import monotonic, sleep
+
+_ARMED = False  # fast-path flag, mirrors bool(_FAULTS)
+_FAULTS: dict[str, dict] = {}
+# specs popped by times= exhaustion whose sleep may still be in flight —
+# clear() must be able to cancel these too (one entry per name, bounded)
+_EXHAUSTED: dict[str, dict] = {}
+_LOCK = threading.Lock()
+
+
+def inject(name, seconds=None, fn=None, times=None):
+    """Arm fault ``name``: a hang of ``seconds`` (released early by
+    :func:`clear`) and/or a callable ``fn``.  ``times`` bounds how many
+    trips before self-disarm (None = until cleared)."""
+    global _ARMED
+    with _LOCK:
+        _FAULTS[name] = {"seconds": seconds, "fn": fn, "times": times,
+                         "trips": 0, "cancelled": False}
+        _ARMED = True
+
+
+def clear(name=None):
+    """Disarm one fault (or all).  A site currently hanging in it wakes up
+    within one poll tick."""
+    global _ARMED
+    with _LOCK:
+        if name is None:
+            for spec in _FAULTS.values():
+                spec["cancelled"] = True
+            for spec in _EXHAUSTED.values():
+                spec["cancelled"] = True
+            _FAULTS.clear()
+            _EXHAUSTED.clear()
+        else:
+            for spec in (_FAULTS.pop(name, None),
+                         _EXHAUSTED.pop(name, None)):
+                if spec is not None:
+                    spec["cancelled"] = True
+        _ARMED = bool(_FAULTS)
+
+
+def armed(name) -> bool:
+    return name in _FAULTS
+
+
+def trip_count(name) -> int:
+    spec = _FAULTS.get(name)
+    return spec["trips"] if spec else 0
+
+
+def maybe(name):
+    """Trip fault ``name`` if armed (called by instrumented sites)."""
+    global _ARMED
+    if not _ARMED:
+        return
+    with _LOCK:
+        spec = _FAULTS.get(name)
+        if spec is None:
+            return
+        spec["trips"] += 1
+        if spec["times"] is not None and spec["trips"] >= spec["times"]:
+            _FAULTS.pop(name, None)
+            _EXHAUSTED[name] = spec  # clear() can still cancel the sleep
+            _ARMED = bool(_FAULTS)
+    if spec["fn"] is not None:
+        spec["fn"]()
+    if spec["seconds"]:
+        end = monotonic() + float(spec["seconds"])
+        # poll so clear() releases a hanging site promptly
+        while monotonic() < end and not spec["cancelled"]:
+            sleep(0.01)

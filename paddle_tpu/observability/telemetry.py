@@ -1,0 +1,207 @@
+"""Live telemetry endpoint: an opt-in stdlib HTTP thread per process.
+
+What any real multi-host deployment scrapes first:
+
+- ``/metrics`` — the PR-1 registry rendered in Prometheus text exposition
+  format (the existing ``MetricsRegistry.to_prometheus``);
+- ``/healthz`` — liveness: ``{"status": "ok", "uptime_s": …, "rank": …}``;
+- ``/statusz`` — the human page: engine occupancy / queue depth / slot
+  table / page-pool utilization (via registered status providers),
+  in-flight spans, watchdog state, last flight-record path.
+
+Opt-in spellings: ``observability.serve(port)`` from code, or set
+``PADDLE_TELEMETRY_PORT`` and let :class:`ServingEngine.start` wire it
+(port 0 binds an ephemeral port, reported on ``TelemetryServer.port``).
+Pure stdlib ``http.server`` on a daemon thread — no new dependencies, no
+effect on the hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from time import time as _wall
+
+from ..profiler import metrics as _metrics
+from . import flight_recorder as _flight
+from . import tracing as _tracing
+from . import watchdog as _watchdog
+
+_SERVER: "TelemetryServer | None" = None
+_LOCK = threading.Lock()
+# providers registered before/independently of any server instance so the
+# engine can register itself whether or not serve() already ran
+_PROVIDERS: dict[str, object] = {}
+
+
+def add_status_provider(name, fn):
+    """Register ``fn() -> json-able`` under ``/statusz``'s ``name`` key."""
+    _PROVIDERS[name] = fn
+
+
+def remove_status_provider(name):
+    _PROVIDERS.pop(name, None)
+
+
+class TelemetryServer:
+    """One HTTP thread serving /metrics, /healthz and /statusz."""
+
+    def __init__(self, port=0, host="127.0.0.1", registry=None):
+        self.host = host
+        self._requested_port = int(port)
+        self.port = None  # actual bound port after start()
+        self._registry = registry
+        self._httpd = None
+        self._thread = None
+        self._t0 = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        if self._httpd is not None:
+            return self
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # no stderr chatter per scrape
+                pass
+
+            def _send(self, code, body, ctype):
+                data = body.encode() if isinstance(body, str) else body
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._send(200, server._metrics_text(),
+                                   "text/plain; version=0.0.4; charset=utf-8")
+                    elif path == "/healthz":
+                        self._send(200, json.dumps(server._healthz()),
+                                   "application/json")
+                    elif path == "/statusz":
+                        self._send(200,
+                                   json.dumps(server._statusz(),
+                                              default=repr),
+                                   "application/json")
+                    else:
+                        self._send(404, json.dumps(
+                            {"error": "not found", "endpoints":
+                             ["/metrics", "/healthz", "/statusz"]}),
+                            "application/json")
+                except Exception as e:  # a scrape must never kill the thread
+                    try:
+                        self._send(500, json.dumps({"error": repr(e)}),
+                                   "application/json")
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                          Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._t0 = _wall()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="paddle-telemetry",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}" if self.port else None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------- content
+    def _metrics_text(self):
+        reg = self._registry or _metrics.get_registry()
+        return reg.to_prometheus()
+
+    def _healthz(self):
+        return {"status": "ok", "uptime_s": _wall() - (self._t0 or _wall()),
+                "rank": _tracing.safe_rank(), "pid": os.getpid()}
+
+    def _statusz(self):
+        rec = _flight.get_flight_recorder()
+        wd = _watchdog.get_collective_watchdog()
+        out = {
+            "time": _wall(),
+            "rank": _tracing.safe_rank(),
+            "pid": os.getpid(),
+            "tracing_active": _tracing.enabled(),
+            "in_flight_spans": _tracing.open_spans(),
+            "last_flight_record": rec.last_dump_path,
+            "flight_recorder_armed": _flight.enabled(),
+            "collective_watchdog": ({
+                "deadline_s": wd.deadline_s,
+                "inflight": wd.inflight(),
+                "fires": len(wd.fired),
+            } if wd is not None else None),
+        }
+        for name, fn in list(_PROVIDERS.items()):
+            try:
+                out[name] = fn()
+            except Exception as e:
+                out[name] = {"error": repr(e)}
+        return out
+
+
+def serve(port=None, host=None, registry=None) -> TelemetryServer:
+    """Start (or return) the process telemetry server.  ``port=None`` reads
+    ``PADDLE_TELEMETRY_PORT``; port 0 binds an ephemeral port.
+    ``host=None`` reads ``PADDLE_TELEMETRY_HOST`` (default loopback —
+    bind ``0.0.0.0`` explicitly to let a remote Prometheus scrape this
+    process).  One server per process: a second call returns the existing
+    one, with a loud warning if it asked for a different fixed port
+    (nothing listens there — scrape the running server's ``port``)."""
+    global _SERVER
+    with _LOCK:
+        if host is None:
+            host = os.environ.get("PADDLE_TELEMETRY_HOST", "127.0.0.1")
+        if _SERVER is not None:
+            if port not in (None, 0, _SERVER.port):
+                import warnings
+
+                warnings.warn(
+                    f"observability.serve({port}): telemetry server already "
+                    f"listening on port {_SERVER.port}; the requested port "
+                    "is NOT bound (one server per process) — scrape "
+                    f"{_SERVER.url}", stacklevel=2)
+            return _SERVER
+        if port is None:
+            port = int(os.environ.get("PADDLE_TELEMETRY_PORT", "0"))
+        _SERVER = TelemetryServer(port=port, host=host,
+                                  registry=registry).start()
+        return _SERVER
+
+
+def get_server():
+    return _SERVER
+
+
+def shutdown():
+    global _SERVER
+    with _LOCK:
+        if _SERVER is not None:
+            _SERVER.stop()
+            _SERVER = None

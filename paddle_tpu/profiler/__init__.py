@@ -15,6 +15,14 @@ Env flags: ``PADDLE_PROFILER_DIR`` (trace output dir),
 ``PADDLE_METRICS_DIR`` / ``PADDLE_METRICS_FLUSH_SECS`` (metrics flusher),
 ``PADDLE_TRAINSTEP_COST`` / ``PADDLE_PEAK_FLOPS`` (TrainStep FLOPs/MFU
 accounting) — see README "Observability".
+
+Cross-rank correlation and forensics live one package over in
+:mod:`paddle_tpu.observability`: span tracing with trace-id propagation,
+``merge_rank_traces`` (consumes :meth:`Profiler.export` files via their
+rank + wall-clock anchor metadata), the flight recorder
+(``PADDLE_FLIGHT_DIR``), collective/serving watchdogs, and the live
+``/metrics``/``/healthz``/``/statusz`` endpoint
+(``PADDLE_TELEMETRY_PORT``) — README "Distributed tracing & forensics".
 """
 
 from __future__ import annotations

@@ -158,6 +158,7 @@ class Profiler:
 
         self._t0 = time.time()
         self._origin = perf_counter()
+        self._clock_unix = self._t0  # anchor (t0 advances at step bounds)
         if self._timer_only:
             return self
         state = (self._scheduler(self._step) if self._scheduler is not None
@@ -367,9 +368,17 @@ class Profiler:
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         with open(path, "w") as f:
+            # rank + wall-clock anchor: observability.merge_rank_traces
+            # aligns per-rank exports on these (ts values are relative to
+            # the perf_counter origin; unix_time is that origin's epoch)
             json.dump({"traceEvents": self._trace_events(),
                        "displayTimeUnit": "ms",
-                       "metadata": {"summary": self._summary_dict()}}, f)
+                       "metadata": {"summary": self._summary_dict(),
+                                    "rank": jax.process_index(),
+                                    "clock": {
+                                        "unix_time": getattr(
+                                            self, "_clock_unix", self._t0),
+                                        "perf_counter": self._origin}}}, f)
         return path
 
     def __enter__(self):

@@ -38,6 +38,7 @@ import collections
 import dataclasses
 import functools
 import itertools
+import os
 import queue as _queue
 import threading
 import time
@@ -45,6 +46,8 @@ import time
 import jax
 import numpy as np
 
+from ..observability import faults as _faults
+from ..observability import tracing as _tracing
 from .adapter import GPTAdapter
 from .block_manager import BlockManager
 
@@ -86,6 +89,9 @@ class RequestHandle:
     def __init__(self, request_id, prompt_len):
         self.request_id = request_id
         self.prompt_len = prompt_len
+        # distributed-tracing identity: every span this request touches
+        # (submit -> prefill -> each decode iteration) carries/links it
+        self.trace_id = _tracing.new_trace_id()
         self.token_ids = []            # generated ids (appended by the engine)
         self.status = "queued"
         self.submitted_at = time.time()
@@ -176,7 +182,8 @@ class ServingEngine:
 
     def __init__(self, model, num_slots=4, page_size=16, max_model_len=None,
                  num_pages=None, top_k=0, top_p=1.0, prefix_sharing=False,
-                 max_queue=None, seed=0, adapter=None):
+                 max_queue=None, seed=0, adapter=None, watchdog_s=None,
+                 telemetry_port=None):
         self._model = model
         self._adapter = adapter if adapter is not None \
             else GPTAdapter(model, page_size)
@@ -215,6 +222,14 @@ class ServingEngine:
         self._modes = None
         self._iteration = 0
         self._error = None
+        # observability wiring (PR-3): scheduler heartbeat for the serving
+        # watchdog, plus opt-in watchdog/telemetry (ctor arg or env)
+        self._progress_t = None
+        self._compiling = False  # first dispatch of a program (XLA compile)
+        self._watchdog_s = watchdog_s
+        self._telemetry_port = telemetry_port
+        self._watchdog = None
+        self._status_provider = None
 
         from ..profiler import metrics as _metrics
 
@@ -263,10 +278,12 @@ class ServingEngine:
                        for m in self._model.sublayers(include_self=True)]
         self._model.eval()
         self._stop_evt.clear()
+        self._progress_t = time.monotonic()
         self._thread = threading.Thread(
             target=self._loop, name="paddle-serving-engine", daemon=True)
         self._started = True
         self._thread.start()
+        self._start_observability()
         return self
 
     def stop(self):
@@ -296,7 +313,59 @@ class ServingEngine:
             for m, tr in self._modes:
                 m.training = tr
             self._modes = None
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        if self._status_provider is not None:
+            # unregister OUR provider only (a newer engine may own the key
+            # by now); also frees this engine for GC — the global registry
+            # must not pin model params/pools past stop()
+            from ..observability import telemetry as _telemetry
+
+            if _telemetry._PROVIDERS.get("serving") is self._status_provider:
+                _telemetry.remove_status_provider("serving")
+            self._status_provider = None
         self._started = False
+
+    def _start_observability(self):
+        """Opt-in forensics: flight recorder from PADDLE_FLIGHT_DIR, the
+        /metrics|/healthz|/statusz endpoint from PADDLE_TELEMETRY_PORT (or
+        the ``telemetry_port`` ctor arg; 0 = ephemeral), the wedged-
+        scheduler watchdog from PADDLE_SERVING_WATCHDOG_S (or
+        ``watchdog_s``).  All default to off: an engine with none of them
+        set behaves exactly as before."""
+        from ..observability import flight_recorder as _flight
+        from ..observability import telemetry as _telemetry
+        from ..observability import watchdog as _watchdog
+
+        _flight.maybe_enable_from_env()
+        try:
+            port = self._telemetry_port
+            if port is None:
+                env = os.environ.get("PADDLE_TELEMETRY_PORT")
+                port = int(env) if env else None
+            if port is not None:
+                _telemetry.serve(port)
+                self._status_provider = self._statusz
+                _telemetry.add_status_provider("serving",
+                                               self._status_provider)
+        except Exception as e:
+            # opt-in observability must never take down serving startup
+            # (EADDRINUSE on a shared port, malformed env value, ...)
+            import logging
+
+            logging.getLogger("paddle_tpu.observability").error(
+                "telemetry endpoint not started (%r); serving continues "
+                "without /metrics|/statusz", e)
+        wd = self._watchdog_s
+        if wd is None:
+            env = os.environ.get("PADDLE_SERVING_WATCHDOG_S")
+            wd = float(env) if env else None
+        if not wd or wd <= 0:  # 0 is the natural 'disabled' spelling
+            wd = None
+        if wd is not None and self._watchdog is None:
+            self._watchdog = _watchdog.ServingWatchdog(self, deadline_s=wd)
+        if self._watchdog is not None:
+            self._watchdog.start()
 
     def __enter__(self):
         return self.start()
@@ -328,19 +397,23 @@ class ServingEngine:
                 f"{total} positions; engine caps are "
                 f"{self._bm.num_pages} pages / {self.max_model_len} positions")
         self.start()  # before enqueue: a failed engine rejects loudly
-        with self._cv:
-            if self._max_queue is not None \
-                    and len(self._queue) >= self._max_queue:
-                self._m_requests.inc(status="rejected")
-                raise RequestRejectedError(
-                    f"admission queue full ({self._max_queue})")
-            deadline = time.time() + deadline_s if deadline_s is not None \
-                else None
-            self._queue.append(Request(prompt, int(max_new_tokens), sampling,
-                                       eos_token_id, deadline, handle))
-            self._m_requests.inc(status="submitted")
-            self._m_queue_depth.set(len(self._queue))
-            self._cv.notify_all()
+        with _tracing.span("serving.submit", trace_id=handle.trace_id,
+                           request_id=handle.request_id,
+                           prompt_len=len(prompt)):
+            with self._cv:
+                if self._max_queue is not None \
+                        and len(self._queue) >= self._max_queue:
+                    self._m_requests.inc(status="rejected")
+                    raise RequestRejectedError(
+                        f"admission queue full ({self._max_queue})")
+                deadline = time.time() + deadline_s \
+                    if deadline_s is not None else None
+                self._queue.append(Request(prompt, int(max_new_tokens),
+                                           sampling, eos_token_id, deadline,
+                                           handle))
+                self._m_requests.inc(status="submitted")
+                self._m_queue_depth.set(len(self._queue))
+                self._cv.notify_all()
         return handle
 
     def generate(self, prompt_ids, max_new_tokens=32, timeout=None, **kw):
@@ -428,6 +501,10 @@ class ServingEngine:
     def _loop(self):
         try:
             while not self._stop_evt.is_set():
+                # heartbeat FIRST, fault hook second: a wedge injected here
+                # leaves the stamp stale exactly like a real stuck iteration
+                self._progress_t = time.monotonic()
+                _faults.maybe("serving.scheduler_wedge")
                 self._admit()
                 self._update_gauges()
                 if not any(s is not None for s in self._slots):
@@ -499,11 +576,24 @@ class ServingEngine:
         temps = np.asarray([req.sampling.temperature], np.float32)
         prog, traces = self._prefill_program(s_pad)
         n0 = traces[0]
+        # first dispatch of this program = minutes-long XLA compile: flag it
+        # so the serving watchdog doesn't read a legitimate compile stall
+        # as a wedged scheduler
+        self._compiling = n0 == 0
         t0 = time.perf_counter()
-        tok, kp, vp = prog(self._params, self._bufs, ids, *self._pools,
-                           table, lens, temps, self._next_key())
-        self._pools = (kp, vp)
-        tok = int(np.asarray(tok)[0])
+        try:
+            with _tracing.span("serving.prefill",
+                               trace_id=req.handle.trace_id,
+                               request_id=req.handle.request_id,
+                               slot=slot_idx, prompt_len=S0):
+                tok, kp, vp = prog(self._params, self._bufs, ids,
+                                   *self._pools, table, lens, temps,
+                                   self._next_key())
+                self._pools = (kp, vp)
+                tok = int(np.asarray(tok)[0])
+        finally:
+            self._compiling = False
+            self._progress_t = time.monotonic()
         if traces[0] > n0:
             self._m_prefill_traces.inc(traces[0] - n0)
         self._m_prefill_seconds.observe(time.perf_counter() - t0)
@@ -532,11 +622,28 @@ class ServingEngine:
             table[i, :len(s.table_row)] = s.table_row
         prog, traces = self._step_program()
         n0 = traces[0]
+        if _tracing._ACTIVE:
+            # one span per batched iteration, LINKING every active
+            # request's trace id (a decode step serves many traces at once
+            # — the OTLP links model, not one parent)
+            cm = _tracing.span(
+                "serving.decode_step", iteration=self._iteration,
+                batch=len(active),
+                links=[self._slots[i].handle.trace_id for i in active])
+        else:  # hot path: one flag read, no span/link-list construction
+            cm = _tracing.NOOP
+        self._compiling = n0 == 0  # first decode dispatch = XLA compile
         t0 = time.perf_counter()
-        tok, kp, vp = prog(self._params, self._bufs, last, *self._pools,
-                           table, lens, temps, self._next_key())
-        self._pools = (kp, vp)
-        tok = np.asarray(tok)
+        try:
+            with cm:
+                tok, kp, vp = prog(self._params, self._bufs, last,
+                                   *self._pools, table, lens, temps,
+                                   self._next_key())
+                self._pools = (kp, vp)
+                tok = np.asarray(tok)
+        finally:
+            self._compiling = False
+            self._progress_t = time.monotonic()
         if traces[0] > n0:
             self._m_step_traces.inc(traces[0] - n0)
         self._m_step_seconds.observe(time.perf_counter() - t0)
@@ -615,3 +722,24 @@ class ServingEngine:
             "page_utilization": self._bm.utilization(),
             "step_traces": self.step_traces,
         }
+
+    def _statusz(self):
+        """/statusz provider: stats + the live slot table (diagnostic
+        snapshot — reads race the scheduler thread benignly)."""
+        st = self.stats()
+        st["started"] = self._started
+        st["error"] = repr(self._error) if self._error is not None else None
+        if self._progress_t is not None:
+            st["last_progress_age_s"] = time.monotonic() - self._progress_t
+        slots = []
+        for i, s in enumerate(self._slots):
+            if s is None:
+                slots.append(None)
+                continue
+            slots.append({"slot": i, "request_id": s.handle.request_id,
+                          "trace_id": s.handle.trace_id,
+                          "status": s.handle.status, "length": s.length,
+                          "produced": s.produced, "max_new": s.max_new,
+                          "pages": len(s.table_row)})
+        st["slots"] = slots
+        return st

@@ -3,6 +3,7 @@ reversed-RNN masking, conv_transpose output_size, per-group functional
 update, OneCycleLR three_phase, bicubic align_corners)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
@@ -310,3 +311,104 @@ def test_asp_conv_mask_groups_reduction_tail():
     ml = calculate_mask(wl, 2, 4)
     gl = np.asarray((wl * ml)).T.reshape(6, 2, 4)
     assert ((gl != 0).sum(-1) <= 2).all()
+
+
+# ---------------------------------------------------------------- PR-3 fixes
+def test_fractional_max_pool_hand_computed_boundaries():
+    """Non-self-referential oracle: in=5, out=3, u=0.5 gives boundaries
+    b_i = ceil(5/3 * (i + 0.5)) -> regions [0,1), [1,3), [3,5) per axis."""
+    x = paddle.to_tensor(np.arange(25, dtype="float32").reshape(1, 1, 5, 5))
+    out = F.fractional_max_pool2d(x, 3, random_u=0.5)
+    np.testing.assert_array_equal(
+        out.numpy()[0, 0],
+        [[0.0, 2.0, 4.0], [10.0, 12.0, 14.0], [20.0, 22.0, 24.0]])
+
+
+def test_fractional_max_pool_seeded_determinism():
+    """random_u=None draws from the framework stream (paddle.seed), not
+    Python's unseeded random — same seed, same regions."""
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(1, 2, 7, 7).astype("float32"))
+    paddle.seed(1234)
+    a = F.fractional_max_pool2d(x, 3).numpy()
+    paddle.seed(1234)
+    b = F.fractional_max_pool2d(x, 3).numpy()
+    np.testing.assert_array_equal(a, b)
+    paddle.seed(77)
+    l1 = nn.FractionalMaxPool2D(4)
+    paddle.seed(77)
+    l2 = nn.FractionalMaxPool2D(4)
+    assert l1.random_u == l2.random_u and 0.0 < l1.random_u < 1.0
+    paddle.seed(77)
+    l3 = nn.FractionalMaxPool3D(2)
+    assert l3.random_u == l1.random_u  # same stream position
+
+
+def test_poisson_entropy_static_kmax_and_trace_safety():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distribution import Poisson
+
+    p = Poisson(paddle.to_tensor([2.0, 5.0]))
+    eager = p.entropy().numpy()
+    np.testing.assert_allclose(p.entropy(kmax=80).numpy(), eager, atol=1e-5)
+
+    with pytest.raises(ValueError, match="kmax"):
+        jax.jit(lambda r: Poisson(paddle.Tensor(r)).entropy()._value)(
+            jnp.asarray([2.0]))
+    traced = jax.jit(
+        lambda r: Poisson(paddle.Tensor(r)).entropy(kmax=80)._value)(
+        jnp.asarray([2.0, 5.0]))
+    np.testing.assert_allclose(np.asarray(traced), eager, atol=1e-5)
+
+
+def test_adaptive_log_softmax_rejects_out_of_range_labels():
+    paddle.seed(0)
+    m = nn.AdaptiveLogSoftmaxWithLoss(8, 10, [4])
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype("float32"))
+    out, loss = m(x, paddle.to_tensor(np.asarray([0, 3, 5, 9], "int64")))
+    assert np.isfinite(float(loss))
+    with pytest.raises(ValueError, match="labels must be in"):
+        m(x, paddle.to_tensor(np.asarray([0, 3, 5, 10], "int64")))
+    with pytest.raises(ValueError, match="labels must be in"):
+        m(x, paddle.to_tensor(np.asarray([-1, 3, 5, 9], "int64")))
+
+
+def test_dist_main_program_lowers_amp_scaled_step():
+    """dist_main_program must include the scaler carry for AMP-scaled
+    TrainSteps and re-lower the variant that produced the last batch."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.amp import GradScaler
+    from paddle_tpu.distributed.auto_parallel import DistModel
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    o = opt.Momentum(learning_rate=0.01, momentum=0.9,
+                     parameters=m.parameters())
+    step = paddle.jit.TrainStep(
+        m, o, loss_fn=nn.CrossEntropyLoss(), amp_level="O1",
+        amp_dtype="float16", scaler=GradScaler(init_loss_scaling=2.0**10))
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype("float32"))
+    y = paddle.to_tensor(np.asarray([0, 1, 2, 3], "int64"))
+    step(x, y)
+    dm = DistModel.__new__(DistModel)
+    dm._train_step = step
+    txt = DistModel.dist_main_program(dm)
+    assert isinstance(txt, str) and len(txt) > 100
+    assert step._last_fn is step._compiled[next(iter(step._compiled))]
+
+
+def test_fractional_max_pool_trace_safe_inside_rng_scope():
+    """random_u=None must stay usable under jit: the draw comes from the
+    host-side global stream, never a traced rng_scope key."""
+    import jax
+    from paddle_tpu.framework import random as fr
+
+    def f(x, key):
+        with fr.rng_scope(key):  # key is a TRACED value inside jit
+            return F.fractional_max_pool2d(paddle.Tensor(x), 2)._value
+
+    paddle.seed(5)
+    out = jax.jit(f)(np.arange(16, dtype="float32").reshape(1, 1, 4, 4),
+                     jax.random.key(1, impl="rbg"))
+    assert out.shape == (1, 1, 2, 2)
