@@ -344,6 +344,10 @@ def _run_section(name):
         return _measure_serving()
     if name == "tracing_overhead":
         return _measure_tracing_overhead()
+    if name == "chaos_smoke":
+        from paddle_tpu.resilience.chaos import run_smoke
+
+        return run_smoke()
     if name == "allreduce":
         bw, n = micro.allreduce_bus_bw()
         return {"bw": bw, "n": n}
@@ -400,6 +404,20 @@ def main():
         print(json.dumps(out))
         if "--emit-metrics" in sys.argv:
             emit_metrics(out, out_dir=_metrics_dir_from_argv())
+        return
+
+    if "--chaos-smoke" in sys.argv:
+        # resilience acceptance smoke: a short fault-plan training run
+        # (injected transient collective timeout + corrupted newest
+        # checkpoint) that must recover end-to-end; raises on any broken
+        # recovery invariant, so a red resilience stack fails the bench
+        out = {"chaos_smoke": _section("chaos_smoke")}
+        print(json.dumps(out))
+        if "--emit-metrics" in sys.argv:
+            path = emit_metrics(out, out_dir=_metrics_dir_from_argv())
+            if path is None:
+                print("--emit-metrics: no --metrics-dir/PADDLE_METRICS_DIR "
+                      "set; nothing written", file=sys.stderr)
         return
 
     if "--serving" in sys.argv:

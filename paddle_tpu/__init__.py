@@ -68,7 +68,7 @@ _LAZY = ("nn", "optimizer", "amp", "io", "metric", "jit", "static", "vision",
          "incubate", "utils", "sparse", "signal", "fft", "text", "ops",
          "distribution", "regularizer", "callbacks", "inference",
          "audio", "version", "quantization", "geometric", "hub", "serving",
-         "observability")
+         "observability", "resilience")
 
 
 def __getattr__(name):

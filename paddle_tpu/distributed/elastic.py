@@ -18,22 +18,60 @@ class ElasticSupervisor:
 
     ``train_fn(start_step, state) -> None`` should checkpoint through the
     given CheckpointManager; on crash the supervisor reloads the latest
-    checkpoint and calls it again.
+    checkpoint and calls it again.  Backoff is exponential with a
+    ``max_backoff_seconds`` cap and seeded jitter (decorrelates a pod of
+    hosts restarting together; ``seed`` pins it for tests), and every
+    restart lands in the metrics registry as
+    ``resilience.restarts{supervisor="elastic"}`` +
+    ``resilience.backoff_seconds``.
+
+    For failure *classification* (transient vs fatal) and corrupt-
+    checkpoint fallback, use
+    :class:`paddle_tpu.resilience.RecoverySupervisor`.
     """
 
-    def __init__(self, checkpoint_manager, max_restarts=3, backoff_seconds=1.0):
+    def __init__(self, checkpoint_manager, max_restarts=3, backoff_seconds=1.0,
+                 max_backoff_seconds=30.0, jitter=0.5, seed=None):
+        from ..resilience.retry import RetryPolicy
+
         self.manager = checkpoint_manager
         self.max_restarts = max_restarts
-        self.backoff = backoff_seconds
+        self.policy = RetryPolicy(base_delay=backoff_seconds,
+                                  max_delay=max_backoff_seconds,
+                                  jitter=jitter, seed=seed)
+
+    def _load(self, template):
+        # the resilience AsyncCheckpointManager quarantines corrupt steps
+        # and falls back to the previous valid one; template= only for
+        # managers that take one (orbax)
+        if template is None and hasattr(self.manager, "restore_latest_valid"):
+            return self.manager.restore_latest_valid()
+        step = self.manager.latest_step()
+        state = None
+        if step is not None:
+            state = self.manager.restore(step, template=template) \
+                if template is not None else self.manager.restore(step)
+        return step, state
 
     def run(self, train_fn, template=None):
+        from ..resilience.supervisor import restart_metrics
+
+        if template is not None \
+                and hasattr(self.manager, "restore_latest_valid"):
+            # fail NOW with the real cause, not after burning the whole
+            # restart budget on the same TypeError from restore()
+            raise TypeError(
+                "template= is an orbax CheckpointManager feature; "
+                "AsyncCheckpointManager restores structure-free — drop "
+                "template")
+        m_restarts, m_backoff = restart_metrics()
         restarts = 0
         while True:
-            step = self.manager.latest_step()
-            state = None
-            if step is not None:
-                state = self.manager.restore(step, template=template)
             try:
+                # restore INSIDE the retry loop: a corrupt newest
+                # checkpoint burns a restart (and, with the resilience
+                # manager, falls back a step) instead of killing run()
+                step, state = self._load(template)
                 return train_fn((step or 0), state)
             except KeyboardInterrupt:
                 raise
@@ -42,9 +80,16 @@ class ElasticSupervisor:
                 if restarts > self.max_restarts:
                     raise
                 traceback.print_exc()
+                delay = self.policy.delay(restarts)
+                # same label schema as RecoverySupervisor (one metric
+                # family must not mix label sets); this supervisor does
+                # not classify, hence kind="unclassified"
+                m_restarts.inc(kind="unclassified", supervisor="elastic")
+                m_backoff.observe(delay)
                 print(f"[elastic] restart {restarts}/{self.max_restarts} "
-                      f"from step {self.manager.latest_step()}")
-                time.sleep(self.backoff * restarts)
+                      f"from step {self.manager.latest_step()} "
+                      f"(backoff {delay:.2f}s)")
+                time.sleep(delay)
 
 
 class PodSupervisor:

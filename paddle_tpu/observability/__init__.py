@@ -30,23 +30,30 @@ Env flags (README "Distributed tracing & forensics"):
 from __future__ import annotations
 
 from . import faults, flight_recorder, telemetry, tracing, watchdog  # noqa: F401
+from .faults import FaultPlan  # noqa: F401
 from .flight_recorder import (  # noqa: F401
     FlightRecorder, get_flight_recorder, install_crash_handlers,
 )
-from .telemetry import TelemetryServer, add_status_provider, serve  # noqa: F401
+from .telemetry import (  # noqa: F401
+    TelemetryServer, add_health_provider, add_status_provider, serve,
+)
 from .tracing import (  # noqa: F401
     Span, Tracer, current_trace_id, event, merge_rank_traces, new_trace_id,
     open_spans, span,
 )
-from .watchdog import CollectiveWatchdog, ServingWatchdog  # noqa: F401
+from .watchdog import (  # noqa: F401
+    CollectiveWatchdog, ServingWatchdog, add_fire_listener,
+    remove_fire_listener,
+)
 
 __all__ = [
     "tracing", "flight_recorder", "watchdog", "telemetry", "faults",
     "Span", "Tracer", "span", "event", "new_trace_id", "current_trace_id",
     "open_spans", "merge_rank_traces",
     "FlightRecorder", "get_flight_recorder", "install_crash_handlers",
-    "CollectiveWatchdog", "ServingWatchdog",
-    "TelemetryServer", "serve", "add_status_provider",
+    "CollectiveWatchdog", "ServingWatchdog", "add_fire_listener",
+    "remove_fire_listener", "FaultPlan",
+    "TelemetryServer", "serve", "add_status_provider", "add_health_provider",
 ]
 
 # production spelling: export PADDLE_FLIGHT_DIR=/some/dir and importing any

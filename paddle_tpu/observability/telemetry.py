@@ -25,6 +25,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from time import time as _wall
 
 from ..profiler import metrics as _metrics
+from . import faults as _faults
 from . import flight_recorder as _flight
 from . import tracing as _tracing
 from . import watchdog as _watchdog
@@ -34,6 +35,12 @@ _LOCK = threading.Lock()
 # providers registered before/independently of any server instance so the
 # engine can register itself whether or not serve() already ran
 _PROVIDERS: dict[str, object] = {}
+# health providers: fn() -> {"state": "healthy|degraded|draining|...",
+# "reasons": [...]}.  /healthz aggregates the WORST component state so a
+# load balancer sees one answer (and a 503 once anything is draining).
+_HEALTH_PROVIDERS: dict[str, object] = {}
+_HEALTH_ORDER = {"ok": 0, "healthy": 0, "degraded": 1, "stopped": 2,
+                 "draining": 2, "error": 3}
 
 
 def add_status_provider(name, fn):
@@ -43,6 +50,17 @@ def add_status_provider(name, fn):
 
 def remove_status_provider(name):
     _PROVIDERS.pop(name, None)
+
+
+def add_health_provider(name, fn):
+    """Register ``fn() -> {"state": ..., "reasons": [...]}`` folded into
+    ``/healthz`` (worst state wins; draining/error answer 503 so load
+    balancers stop routing here)."""
+    _HEALTH_PROVIDERS[name] = fn
+
+
+def remove_health_provider(name):
+    _HEALTH_PROVIDERS.pop(name, None)
 
 
 class TelemetryServer:
@@ -82,7 +100,8 @@ class TelemetryServer:
                         self._send(200, server._metrics_text(),
                                    "text/plain; version=0.0.4; charset=utf-8")
                     elif path == "/healthz":
-                        self._send(200, json.dumps(server._healthz()),
+                        code, doc = server._healthz()
+                        self._send(code, json.dumps(doc),
                                    "application/json")
                     elif path == "/statusz":
                         self._send(200,
@@ -138,8 +157,28 @@ class TelemetryServer:
         return reg.to_prometheus()
 
     def _healthz(self):
-        return {"status": "ok", "uptime_s": _wall() - (self._t0 or _wall()),
-                "rank": _tracing.safe_rank(), "pid": os.getpid()}
+        """(http_code, doc): worst registered component state wins.  No
+        components = plain liveness (the PR-3 behavior, status "ok")."""
+        doc = {"status": "ok", "uptime_s": _wall() - (self._t0 or _wall()),
+               "rank": _tracing.safe_rank(), "pid": os.getpid()}
+        worst = "ok"
+        components = {}
+        for name, fn in list(_HEALTH_PROVIDERS.items()):
+            try:
+                st = fn()
+            except Exception as e:
+                st = {"state": "error", "reasons": [repr(e)]}
+            if not isinstance(st, dict):
+                st = {"state": str(st), "reasons": []}
+            components[name] = st
+            s = str(st.get("state", "ok"))
+            if _HEALTH_ORDER.get(s, 1) > _HEALTH_ORDER.get(worst, 0):
+                worst = s
+        if components:
+            doc["components"] = components
+            doc["status"] = "ok" if worst in ("ok", "healthy") else worst
+        code = 503 if _HEALTH_ORDER.get(doc["status"], 0) >= 2 else 200
+        return code, doc
 
     def _statusz(self):
         rec = _flight.get_flight_recorder()
@@ -152,6 +191,10 @@ class TelemetryServer:
             "in_flight_spans": _tracing.open_spans(),
             "last_flight_record": rec.last_dump_path,
             "flight_recorder_armed": _flight.enabled(),
+            # chaos visibility: which fault hooks are armed RIGHT NOW (an
+            # operator staring at a wedged /statusz should immediately see
+            # a forgotten fault plan)
+            "faults": _faults.describe(),
             "collective_watchdog": ({
                 "deadline_s": wd.deadline_s,
                 "inflight": wd.inflight(),

@@ -43,6 +43,35 @@ def _fires_counter():
         "observability.watchdog_fires", "watchdog triggers by kind/op")
 
 
+# Fire listeners: detection-to-recovery wiring.  The resilience layer
+# (paddle_tpu.resilience.emergency) registers here so a watchdog fire can
+# trigger an emergency checkpoint, not just a dump.  Listeners run on the
+# monitor thread and must never raise into the fire path.
+_FIRE_LISTENERS: list = []
+
+
+def add_fire_listener(fn):
+    """Register ``fn(kind, record)`` called on every watchdog fire
+    (``kind`` is ``"collective"`` or ``"serving"``)."""
+    if fn not in _FIRE_LISTENERS:
+        _FIRE_LISTENERS.append(fn)
+
+
+def remove_fire_listener(fn):
+    try:
+        _FIRE_LISTENERS.remove(fn)
+    except ValueError:
+        pass
+
+
+def _notify_fire(kind, record):
+    for fn in list(_FIRE_LISTENERS):
+        try:
+            fn(kind, record)
+        except Exception:
+            logger.exception("watchdog fire listener failed (kind=%s)", kind)
+
+
 class CollectiveWatchdog:
     """Deadline monitor over in-flight eager collectives."""
 
@@ -138,6 +167,7 @@ class CollectiveWatchdog:
         record["dump_path"] = rec.dump("collective_watchdog", extra=record)
         self._m_fires.inc(kind="collective", op=t["op"])
         self.fired.append(record)
+        _notify_fire("collective", record)
 
 
 # Module-level bracket: ONE global read when no watchdog is armed — the
@@ -245,3 +275,4 @@ class ServingWatchdog:
         record["dump_path"] = rec.dump("serving_watchdog", extra=record)
         self._m_fires.inc(kind="serving", op="scheduler_wedge")
         self.fired.append(record)
+        _notify_fire("serving", record)

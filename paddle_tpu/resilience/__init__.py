@@ -1,0 +1,52 @@
+"""paddle_tpu.resilience — close the loop from detected failure to
+recovery (this PR's tentpole; PR-3 gave the system eyes, this gives it
+reflexes).
+
+Four pillars:
+
+- :mod:`.checkpoint` — :class:`AsyncCheckpointManager`: background save
+  thread, atomic directory commit with a sha256 manifest
+  (:func:`paddle_tpu.io.checkpoint.write_manifest`), partial-save garbage
+  collection, quarantine-and-fall-back restore
+  (:meth:`~.checkpoint.AsyncCheckpointManager.restore_latest_valid`);
+- :mod:`.retry` + :mod:`.supervisor` — failure classification
+  (transient preemption/collective-timeout vs fatal traced error),
+  capped + jittered exponential backoff with a retry budget, and
+  :class:`RecoverySupervisor` resuming from the newest *valid* checkpoint;
+- :mod:`.emergency` — :func:`arm_emergency_checkpoint`: synchronous
+  save triggered by SIGTERM (preemption notice) or a PR-3 watchdog fire;
+- :mod:`.chaos` — the chaos harness: :func:`corrupt_checkpoint` (real
+  on-disk damage for the manifest fallback path) and :func:`run_smoke`
+  (the ``bench.py --chaos-smoke`` run), driving
+  :class:`paddle_tpu.observability.faults.FaultPlan` fault plans.
+
+Serving-side resilience (health state machine, load shedding, engine
+auto-restart with in-flight requeue) lives in
+:mod:`paddle_tpu.serving.engine` and reuses :mod:`.retry`'s
+classification.  Metrics: ``resilience.restarts``,
+``resilience.backoff_seconds``, ``resilience.checkpoint_saves``,
+``resilience.checkpoint_corruptions``, ``resilience.emergency_saves``.
+"""
+
+from __future__ import annotations
+
+from . import chaos, checkpoint, emergency, retry, supervisor  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    AsyncCheckpointManager, CheckpointCorruptionError,
+)
+from .chaos import corrupt_checkpoint, run_smoke  # noqa: F401
+from .emergency import arm_emergency_checkpoint  # noqa: F401
+from .retry import (  # noqa: F401
+    CollectiveTimeoutError, EngineStoppedError, PreemptionError, RetryPolicy,
+    TransientError, classify_failure,
+)
+from .supervisor import RecoverySupervisor  # noqa: F401
+
+__all__ = [
+    "checkpoint", "retry", "supervisor", "emergency", "chaos",
+    "AsyncCheckpointManager", "CheckpointCorruptionError",
+    "RecoverySupervisor", "RetryPolicy", "classify_failure",
+    "TransientError", "PreemptionError", "CollectiveTimeoutError",
+    "EngineStoppedError", "arm_emergency_checkpoint", "corrupt_checkpoint",
+    "run_smoke",
+]

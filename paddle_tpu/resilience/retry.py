@@ -1,0 +1,111 @@
+"""Failure classification + retry backoff (the recovery supervisor's policy
+layer).
+
+Large TPU deployments live with two very different failure populations:
+
+- **transient** — a preempted host, a collective that timed out because a
+  neighbor was being rescheduled, a dropped coordination-service socket.
+  The correct response is restart-from-checkpoint with backoff; the job is
+  healthy, the world briefly wasn't.
+- **fatal** — a traced shape error, a NaN guard, an assertion in user
+  code.  Restarting replays the same crash forever; the correct response
+  is to surface it immediately.
+
+:func:`classify_failure` encodes that split (type-based for our own error
+hierarchy, message-pattern-based for errors that bubble out of the jax
+runtime), and :class:`RetryPolicy` is exponential backoff with a max-delay
+cap and seeded jitter — deterministic under test, decorrelated in a real
+pod where every host restarting on the same beat would thundering-herd the
+coordination service.
+"""
+
+from __future__ import annotations
+
+import random
+import zlib
+
+
+class TransientError(RuntimeError):
+    """Base for failures worth an automatic restart (preemption, flaky
+    host, collective timeout).  Raise (or wrap into) one of these to tell
+    the supervisors a retry is expected to succeed."""
+
+
+class PreemptionError(TransientError):
+    """The scheduler is taking the host/slice back (SIGTERM with notice,
+    maintenance event)."""
+
+
+class CollectiveTimeoutError(TransientError):
+    """A collective exceeded its deadline — the canonical symptom of one
+    rank dying mid-allreduce (the watchdog names the op; this error is what
+    recovery acts on)."""
+
+
+class EngineStoppedError(RuntimeError):
+    """A serving request failed because its engine was stopped with the
+    request still in flight (``ServingEngine.stop()`` without drain)."""
+
+
+# substrings (lowercased) in errors from the jax/XLA runtime and the
+# coordination service that indicate the WORLD failed, not the program
+_TRANSIENT_PATTERNS = (
+    "deadline exceeded",
+    "preempt",
+    "unavailable",
+    "socket closed",
+    "connection reset",
+    "connection refused",
+    "broken pipe",
+    "coordination service",
+    "heartbeat",
+    "barrier timed out",
+    "peer down",
+)
+
+_TRANSIENT_TYPES = (TransientError, TimeoutError, ConnectionError,
+                    BrokenPipeError)
+
+
+def classify_failure(exc) -> str:
+    """``"transient"`` (restart-worthy) or ``"fatal"`` (surface it)."""
+    if isinstance(exc, _TRANSIENT_TYPES):
+        return "transient"
+    msg = str(exc).lower()
+    if any(p in msg for p in _TRANSIENT_PATTERNS):
+        return "transient"
+    return "fatal"
+
+
+class RetryPolicy:
+    """Exponential backoff with a cap and seeded jitter.
+
+    ``delay(attempt)`` for attempt 1, 2, 3, … is
+    ``min(base * 2**(attempt-1), max_delay)`` scaled by a uniform jitter in
+    ``[1-jitter, 1+jitter]`` and re-capped — so delays grow, never exceed
+    the cap, and don't synchronize across hosts.  A given ``seed`` makes
+    the jitter stream reproducible (the chaos tests assert exact delays).
+    """
+
+    def __init__(self, base_delay=1.0, max_delay=30.0, jitter=0.5,
+                 seed=None):
+        if not 0.0 <= float(jitter) <= 1.0:
+            raise ValueError(f"jitter must be in [0, 1], got {jitter}")
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.jitter = float(jitter)
+        self._rng = random.Random(seed)
+
+    def delay(self, attempt) -> float:
+        d = min(self.base_delay * (2.0 ** max(int(attempt) - 1, 0)),
+                self.max_delay)
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(min(d, self.max_delay), 0.0)
+
+
+def derive_seed(*parts) -> int:
+    """Stable small seed from arbitrary parts (fault plans, per-site rngs):
+    crc32 of the repr-joined parts — reproducible across processes, unlike
+    ``hash()`` under PYTHONHASHSEED randomization."""
+    return zlib.crc32(":".join(repr(p) for p in parts).encode())

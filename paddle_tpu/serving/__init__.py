@@ -20,12 +20,12 @@ from .adapter import GPTAdapter  # noqa: F401
 from .api import ContinuousBatchingPredictor  # noqa: F401
 from .block_manager import BlockManager, PageAllocation  # noqa: F401
 from .engine import (  # noqa: F401
-    Request, RequestHandle, RequestRejectedError, SamplingParams,
-    ServingEngine,
+    EngineStoppedError, Request, RequestHandle, RequestRejectedError,
+    SamplingParams, ServingEngine,
 )
 
 __all__ = [
     "ServingEngine", "Request", "RequestHandle", "RequestRejectedError",
-    "SamplingParams", "BlockManager", "PageAllocation", "GPTAdapter",
-    "ContinuousBatchingPredictor",
+    "EngineStoppedError", "SamplingParams", "BlockManager", "PageAllocation",
+    "GPTAdapter", "ContinuousBatchingPredictor",
 ]

@@ -30,6 +30,18 @@ Observability (PR-1 metrics registry): ``serving.ttft_seconds``,
 ``serving.requests{status=...}``, ``serving.tokens_generated``,
 ``serving.admissions_blocked``, ``serving.preemptions``,
 ``serving.step_traces``, ``serving.prefill_traces`` counters.
+
+Resilience (PR-4, README "Resilience & fault tolerance"): a health state
+machine (healthy → degraded → draining) surfaced on /healthz and /statusz;
+deadline-aware load shedding at submit with distinct rejection reasons
+(``RequestRejectedError.reason``); transient scheduler failures trigger an
+engine auto-restart that rebuilds the page pools and transparently
+re-queues in-flight requests (prompt + tokens-so-far, remaining budget)
+instead of failing their handles; ``stop()`` without ``drain=True`` fails
+in-flight handles fast with :class:`EngineStoppedError`; ``stop(drain=
+True)`` finishes all in-flight work first.  Extra metrics:
+``serving.load_shed{reason=}``, ``serving.engine_restarts``,
+``serving.requests_requeued``, ``serving.health_state``.
 """
 
 from __future__ import annotations
@@ -38,6 +50,7 @@ import collections
 import dataclasses
 import functools
 import itertools
+import logging
 import os
 import queue as _queue
 import threading
@@ -48,13 +61,27 @@ import numpy as np
 
 from ..observability import faults as _faults
 from ..observability import tracing as _tracing
+from ..resilience.retry import EngineStoppedError, classify_failure  # noqa: F401 — re-exported
 from .adapter import GPTAdapter
 from .block_manager import BlockManager
+
+_logger = logging.getLogger("paddle_tpu.serving")
+
+_HEALTH_CODE = {"healthy": 0, "degraded": 1, "draining": 2, "stopped": 3,
+                "error": 4}
 
 
 class RequestRejectedError(RuntimeError):
     """Raised by submit() for requests the engine can never serve (too long
-    for the model/page pool) or when the admission queue is full."""
+    for the model/page pool) or that are load-shed.  ``reason`` is the
+    machine-readable rejection class: ``unservable`` (exceeds model/pool
+    caps), ``queue_full``, ``deadline_unmeetable`` (the request's deadline
+    cannot be met given current queue/stall state), or ``draining`` (the
+    engine is shutting down gracefully)."""
+
+    def __init__(self, message, reason="rejected"):
+        super().__init__(message)
+        self.reason = reason
 
 
 @dataclasses.dataclass
@@ -122,6 +149,8 @@ class RequestHandle:
             raise TimeoutError(
                 f"request {self.request_id} not finished after {timeout}s")
         if self._error is not None:
+            if isinstance(self._error, EngineStoppedError):
+                raise self._error
             raise RuntimeError("serving engine failed") from self._error
         return list(self.token_ids)
 
@@ -136,6 +165,8 @@ class RequestHandle:
                 else:
                     break
             if self._error is not None:
+                if isinstance(self._error, EngineStoppedError):
+                    raise self._error
                 raise RuntimeError("serving engine failed") from self._error
         finally:
             if not self._done.is_set():
@@ -183,7 +214,8 @@ class ServingEngine:
     def __init__(self, model, num_slots=4, page_size=16, max_model_len=None,
                  num_pages=None, top_k=0, top_p=1.0, prefix_sharing=False,
                  max_queue=None, seed=0, adapter=None, watchdog_s=None,
-                 telemetry_port=None):
+                 telemetry_port=None, max_engine_restarts=3,
+                 degraded_stall_s=2.0, restart_cooldown_s=10.0):
         self._model = model
         self._adapter = adapter if adapter is not None \
             else GPTAdapter(model, page_size)
@@ -195,6 +227,8 @@ class ServingEngine:
         self.table_width = -(-self.max_model_len // self.page_size)  # NP
         if num_pages is None:
             num_pages = self.num_slots * self.table_width  # full residency
+        self._num_pages = int(num_pages)
+        self._prefix_sharing = bool(prefix_sharing)
         self._bm = BlockManager(num_pages, self.page_size,
                                 prefix_sharing=prefix_sharing)
         # pool row num_pages is the SCRATCH page: inactive decode slots and
@@ -230,6 +264,17 @@ class ServingEngine:
         self._telemetry_port = telemetry_port
         self._watchdog = None
         self._status_provider = None
+        self._health_provider = None
+        # resilience wiring (PR-4): health state machine, load shedding,
+        # transient-failure auto-restart with in-flight requeue
+        self._draining = False
+        self._max_engine_restarts = int(max_engine_restarts)
+        self._degraded_stall_s = float(degraded_stall_s)
+        self._restart_cooldown_s = float(restart_cooldown_s)
+        self._engine_restarts = 0
+        self._last_restart_t = None
+        self._ema_request_s = None   # EMA of completed request durations
+        self._admitting = None       # request popped but not yet slotted
 
         from ..profiler import metrics as _metrics
 
@@ -264,6 +309,17 @@ class ServingEngine:
             "serving.step_traces", "decode-step program traces")
         self._m_prefill_traces = _metrics.counter(
             "serving.prefill_traces", "prefill program traces")
+        self._m_shed = _metrics.counter(
+            "serving.load_shed", "requests shed at submit, by reason")
+        self._m_engine_restarts = _metrics.counter(
+            "serving.engine_restarts",
+            "scheduler auto-restarts after transient failures")
+        self._m_requeued = _metrics.counter(
+            "serving.requests_requeued",
+            "in-flight requests transparently re-queued across a restart")
+        self._m_health = _metrics.gauge(
+            "serving.health_state",
+            "0 healthy, 1 degraded, 2 draining, 3 stopped, 4 error")
 
     # ------------------------------------------------------------ lifecycle
     def start(self):
@@ -278,6 +334,8 @@ class ServingEngine:
                        for m in self._model.sublayers(include_self=True)]
         self._model.eval()
         self._stop_evt.clear()
+        self._draining = False
+        self._engine_restarts = 0   # a fresh start() is a fresh budget
         self._progress_t = time.monotonic()
         self._thread = threading.Thread(
             target=self._loop, name="paddle-serving-engine", daemon=True)
@@ -286,9 +344,36 @@ class ServingEngine:
         self._start_observability()
         return self
 
-    def stop(self):
+    def drain(self, timeout=600):
+        """Graceful rundown: stop admitting (submits reject with reason
+        ``draining``, /healthz answers 503) and wait for the queue and
+        every slot to empty.  Returns True once nothing is in flight;
+        raises TimeoutError if work remains after ``timeout``."""
+        self._draining = True
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            if self._error is not None or not self._started:
+                return True  # aborted/stopped: nothing left in flight
+            with self._lock:
+                empty = not self._queue \
+                    and all(s is None for s in self._slots) \
+                    and self._admitting is None
+            if empty:
+                return True
+            time.sleep(0.01)
+        raise TimeoutError(f"engine did not drain within {timeout}s: "
+                           f"{self.stats()}")
+
+    def stop(self, drain=False, drain_timeout=600):
+        """Stop the scheduler.  ``drain=True`` first finishes all in-flight
+        work (no request ever left hanging); without it, in-flight and
+        queued requests FAIL FAST — their handles raise a clear
+        :class:`EngineStoppedError` from ``result()``/``stream()`` instead
+        of blocking until the caller's timeout."""
         if not self._started:
             return
+        if drain:
+            self.drain(timeout=drain_timeout)
         self._stop_evt.set()
         with self._cv:
             self._cv.notify_all()
@@ -305,18 +390,19 @@ class ServingEngine:
             if s is not None:
                 self._bm.free(s.alloc)
                 self._slots[i] = None
-                self._finish(s.handle, "cancelled")
+                self._fail_stopped(s.handle)
         with self._lock:
             while self._queue:
-                self._finish(self._queue.popleft().handle, "cancelled")
+                self._fail_stopped(self._queue.popleft().handle)
+        self._draining = False
         if self._modes is not None:
             for m, tr in self._modes:
                 m.training = tr
             self._modes = None
         if self._watchdog is not None:
             self._watchdog.stop()
-        if self._status_provider is not None:
-            # unregister OUR provider only (a newer engine may own the key
+        if self._status_provider is not None or self._health_provider is not None:
+            # unregister OUR providers only (a newer engine may own the key
             # by now); also frees this engine for GC — the global registry
             # must not pin model params/pools past stop()
             from ..observability import telemetry as _telemetry
@@ -324,7 +410,22 @@ class ServingEngine:
             if _telemetry._PROVIDERS.get("serving") is self._status_provider:
                 _telemetry.remove_status_provider("serving")
             self._status_provider = None
+            if _telemetry._HEALTH_PROVIDERS.get("serving") \
+                    is self._health_provider:
+                _telemetry.remove_health_provider("serving")
+            self._health_provider = None
         self._started = False
+
+    def _fail_stopped(self, handle):
+        """A request in flight at (non-drain) stop(): fail its handle
+        loudly rather than leaving result() to block until timeout."""
+        if handle.cancelled:
+            self._finish(handle, "cancelled")
+            return
+        handle._error = EngineStoppedError(
+            f"request {handle.request_id} was still in flight when the "
+            "engine stopped; use stop(drain=True) to finish in-flight work")
+        self._finish(handle, "stopped")
 
     def _start_observability(self):
         """Opt-in forensics: flight recorder from PADDLE_FLIGHT_DIR, the
@@ -348,6 +449,9 @@ class ServingEngine:
                 self._status_provider = self._statusz
                 _telemetry.add_status_provider("serving",
                                                self._status_provider)
+                self._health_provider = self.health_state
+                _telemetry.add_health_provider("serving",
+                                               self._health_provider)
         except Exception as e:
             # opt-in observability must never take down serving startup
             # (EADDRINUSE on a shared port, malformed env value, ...)
@@ -395,17 +499,22 @@ class ServingEngine:
                 f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
                 f"needs {self._bm.pages_for(total)} pages / "
                 f"{total} positions; engine caps are "
-                f"{self._bm.num_pages} pages / {self.max_model_len} positions")
+                f"{self._bm.num_pages} pages / {self.max_model_len} positions",
+                reason="unservable")
         self.start()  # before enqueue: a failed engine rejects loudly
         with _tracing.span("serving.submit", trace_id=handle.trace_id,
                            request_id=handle.request_id,
                            prompt_len=len(prompt)):
             with self._cv:
+                if self._draining:
+                    self._shed("draining",
+                               "engine is draining; not admitting new work")
                 if self._max_queue is not None \
                         and len(self._queue) >= self._max_queue:
-                    self._m_requests.inc(status="rejected")
-                    raise RequestRejectedError(
-                        f"admission queue full ({self._max_queue})")
+                    self._shed("queue_full",
+                               f"admission queue full ({self._max_queue})")
+                if deadline_s is not None:
+                    self._check_deadline_meetable(float(deadline_s))
                 deadline = time.time() + deadline_s \
                     if deadline_s is not None else None
                 self._queue.append(Request(prompt, int(max_new_tokens),
@@ -415,6 +524,38 @@ class ServingEngine:
                 self._m_queue_depth.set(len(self._queue))
                 self._cv.notify_all()
         return handle
+
+    def _shed(self, reason, message):
+        """Reject at admission with a distinct, machine-readable reason
+        (load shedding under pressure beats timing out after queueing)."""
+        self._m_shed.inc(reason=reason)
+        self._m_requests.inc(status="rejected")
+        raise RequestRejectedError(message, reason=reason)
+
+    def _check_deadline_meetable(self, deadline_s):
+        """Deadline-aware admission (called under the cv lock): shed NOW if
+        the scheduler has been stalled longer than the whole deadline
+        budget, or if the queue-position estimate (queue depth over slots
+        times the completed-request duration EMA) already exceeds it —
+        rejecting in microseconds beats returning 'expired' after the
+        deadline burned queue and pages."""
+        stamp = self._progress_t
+        if stamp is not None and not self._compiling:
+            stall = time.monotonic() - stamp
+            if stall > max(self._degraded_stall_s, deadline_s):
+                self._shed("deadline_unmeetable",
+                           f"scheduler stalled for {stall:.2f}s, longer "
+                           f"than the {deadline_s:.2f}s deadline")
+        if self._ema_request_s is not None and self._queue:
+            est = (len(self._queue) / max(self.num_slots, 1) + 1.0) \
+                * self._ema_request_s
+            if est > deadline_s:
+                self._shed(
+                    "deadline_unmeetable",
+                    f"estimated completion in {est:.2f}s (queue depth "
+                    f"{len(self._queue)}, typical request "
+                    f"{self._ema_request_s:.2f}s) exceeds the "
+                    f"{deadline_s:.2f}s deadline")
 
     def generate(self, prompt_ids, max_new_tokens=32, timeout=None, **kw):
         """Blocking convenience: submit + wait; returns generated ids."""
@@ -499,8 +640,8 @@ class ServingEngine:
 
     # ---------------------------------------------------------- loop thread
     def _loop(self):
-        try:
-            while not self._stop_evt.is_set():
+        while not self._stop_evt.is_set():
+            try:
                 # heartbeat FIRST, fault hook second: a wedge injected here
                 # leaves the stamp stale exactly like a real stuck iteration
                 self._progress_t = time.monotonic()
@@ -513,11 +654,84 @@ class ServingEngine:
                             self._cv.wait(timeout=0.02)
                     continue
                 self._step_once()
-        except BaseException as e:  # surface to every waiter, don't hang
-            self._error = e
-            self._abort_all(e)
+            except BaseException as e:
+                # the budget is a burst limit, not a lifetime one: a full
+                # cooldown of healthy operation since the last restart
+                # heals it (3 recovered blips spread over weeks must not
+                # arm a kill switch for the 4th)
+                if self._engine_restarts and self._last_restart_t is not None \
+                        and time.monotonic() - self._last_restart_t \
+                        > self._restart_cooldown_s:
+                    self._engine_restarts = 0
+                if classify_failure(e) == "transient" \
+                        and self._engine_restarts < self._max_engine_restarts:
+                    try:
+                        self._recover(e)
+                        continue
+                    except BaseException as e2:  # recovery itself died
+                        e = e2
+                # fatal (or restart budget burned): surface to every
+                # waiter, don't hang
+                self._error = e
+                self._abort_all(e)
+                return
+
+    def _recover(self, exc):
+        """Transient scheduler failure (classified by
+        :func:`paddle_tpu.resilience.retry.classify_failure`): rebuild
+        device state and transparently re-queue every in-flight request
+        instead of failing its handle.  Tokens already emitted stay
+        emitted — each request is re-admitted as prompt + tokens-so-far
+        with the remaining budget, so a greedy request's final ids are the
+        ones an uninterrupted run would have produced."""
+        self._engine_restarts += 1
+        self._last_restart_t = time.monotonic()
+        self._m_engine_restarts.inc()
+        _logger.error(
+            "serving engine auto-restart %d/%d after transient failure %r; "
+            "re-queueing in-flight requests", self._engine_restarts,
+            self._max_engine_restarts, exc)
+        inflight = []
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                self._slots[i] = None
+                inflight.append((s.req, s.produced))
+        pending, self._admitting = self._admitting, None
+        if pending is not None and \
+                all(req.handle is not pending.handle for req, _ in inflight):
+            inflight.append((pending, 0))
+        # fresh device state: the page pools were donated into the crashed
+        # dispatch; re-admission prefills rewrite every sequence's K/V
+        self._bm = BlockManager(self._num_pages, self.page_size,
+                                prefix_sharing=self._prefix_sharing)
+        self._pools = self._adapter.init_pools(self._num_pages + 1)
+        with self._lock:
+            for req, produced in reversed(inflight):
+                h = req.handle
+                if h.done:
+                    continue
+                if h.cancelled:
+                    self._finish(h, "cancelled")
+                    continue
+                remaining = req.max_new_tokens - produced
+                if remaining <= 0:  # had finished, crash beat the retire
+                    self._finish(h, "completed")
+                    continue
+                prompt = list(req.prompt) + \
+                    ([int(t) for t in h.token_ids[-produced:]]
+                     if produced else [])
+                h.status = "queued"
+                self._queue.appendleft(Request(
+                    prompt, remaining, req.sampling, req.eos_token_id,
+                    req.deadline, h))
+                self._m_requeued.inc()
+            self._m_queue_depth.set(len(self._queue))
 
     def _abort_all(self, exc):
+        pending, self._admitting = self._admitting, None
+        if pending is not None and not pending.handle.done:
+            pending.handle._error = exc
+            self._finish(pending.handle, "error")
         for i, s in enumerate(self._slots):
             if s is not None:
                 self._bm.free(s.alloc)
@@ -561,6 +775,9 @@ class ServingEngine:
                     return
                 self._queue.popleft()
                 self._m_queue_depth.set(len(self._queue))
+                # between dequeue and slot assignment the request lives in
+                # _admitting so a crash mid-prefill can still requeue it
+                self._admitting = req
             self._prefill(req, alloc, free_slot)
 
     def _prefill(self, req, alloc, slot_idx):
@@ -602,10 +819,14 @@ class ServingEngine:
         slot.produced = 1
         req.handle.status = "running"
         self._slots[slot_idx] = slot
+        self._admitting = None
         self._emit_token(slot, tok)
         self._retire_if_done(slot_idx)
 
     def _step_once(self):
+        # chaos site: an injected fn raising a TransientError here drives
+        # the auto-restart + requeue path through the real scheduler
+        _faults.maybe("serving.step_crash")
         B = self.num_slots
         last = np.zeros((B, 1), np.int64)
         lens = np.zeros((B,), np.int32)
@@ -694,6 +915,11 @@ class ServingEngine:
         handle.status = status
         handle.finished_at = time.time()
         handle.finished_iteration = self._iteration
+        if status == "completed":
+            # completed-request duration EMA feeds deadline-aware shedding
+            dur = handle.finished_at - handle.submitted_at
+            self._ema_request_s = dur if self._ema_request_s is None \
+                else 0.8 * self._ema_request_s + 0.2 * dur
         self._m_requests.inc(status=status)
         handle._events.put(("done", status))
         handle._done.set()
@@ -705,6 +931,44 @@ class ServingEngine:
         self._m_occupancy.set(n / self.num_slots)
         self._m_page_util.set(self._bm.utilization())
         self._m_pages_used.set(self._bm.used_pages)
+        self._m_health.set(_HEALTH_CODE.get(self.health, 1))
+
+    # --------------------------------------------------------------- health
+    def health_state(self):
+        """The health state machine surfaced on /healthz and /statusz:
+
+        - ``healthy`` — scheduler progressing, queue under pressure limits;
+        - ``degraded`` — serving, but queue pressure, a stalled scheduler,
+          or a recent auto-restart says trouble (reasons list which);
+        - ``draining`` — graceful rundown, no new admissions (503);
+        - ``stopped`` / ``error`` — not serving.
+        """
+        if self._error is not None:
+            return {"state": "error", "reasons": [repr(self._error)]}
+        if self._draining:
+            return {"state": "draining", "reasons": ["drain requested"]}
+        if not self._started:
+            return {"state": "stopped", "reasons": []}
+        reasons = []
+        qd = len(self._queue)
+        if self._max_queue and qd >= max(1, int(0.8 * self._max_queue)):
+            reasons.append(f"queue_pressure:{qd}/{self._max_queue}")
+        stamp = self._progress_t
+        busy = qd or any(s is not None for s in self._slots)
+        if busy and stamp is not None and not self._compiling:
+            age = time.monotonic() - stamp
+            if age > self._degraded_stall_s:
+                reasons.append(f"scheduler_stalled:{age:.2f}s")
+        if self._last_restart_t is not None and \
+                time.monotonic() - self._last_restart_t \
+                < self._restart_cooldown_s:
+            reasons.append(f"recent_restart:{self._engine_restarts}")
+        return {"state": "degraded" if reasons else "healthy",
+                "reasons": reasons}
+
+    @property
+    def health(self):
+        return self.health_state()["state"]
 
     # -------------------------------------------------------------- insight
     @property
@@ -729,6 +993,10 @@ class ServingEngine:
         st = self.stats()
         st["started"] = self._started
         st["error"] = repr(self._error) if self._error is not None else None
+        st["health"] = self.health_state()
+        st["engine_restarts"] = self._engine_restarts
+        st["draining"] = self._draining
+        st["typical_request_s"] = self._ema_request_s
         if self._progress_t is not None:
             st["last_progress_age_s"] = time.monotonic() - self._progress_t
         slots = []
