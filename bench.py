@@ -130,6 +130,15 @@ def _measure_decode(cache_impl, B=8, S0=32, lo=64, hi=320):
     return B * (hi - lo) / max(t_hi - t_lo, 1e-9)
 
 
+def _metric_quantile(name, q):
+    """Reservoir quantile of a registry histogram (None when empty)."""
+    from paddle_tpu.profiler import metrics as _metrics
+
+    h = _metrics.get_registry().get(name)
+    c = h.labels() if h is not None else None
+    return (c.quantile(q) if c is not None and c.count else None)
+
+
 def _measure_serving(n_requests=8, num_slots=4, S0=32, page_size=32,
                      max_news=None, model_kwargs=None, warm_tokens=4):
     """Continuous batching vs sequential generate() on a mixed-length
@@ -191,11 +200,6 @@ def _measure_serving(n_requests=8, num_slots=4, S0=32, page_size=32,
         t_engine = time.time() - t0
         step_traces = engine.step_traces
 
-    def _q(name, q):
-        h = reg.get(name)
-        c = h.labels() if h is not None else None
-        return (c.quantile(q) if c is not None and c.count else None)
-
     ttft_n = ttft_h.count - ttft_n0
     ttft_mean = (ttft_h.sum - ttft_sum0) / ttft_n if ttft_n else None
     return {
@@ -208,13 +212,105 @@ def _measure_serving(n_requests=8, num_slots=4, S0=32, page_size=32,
         "ttft_mean_s": round(ttft_mean, 4) if ttft_mean is not None else None,
         # reservoir quantiles: the handful of warm-up ITL samples are noise
         # against the measured phase's hundreds
-        "itl_p50_s": _q("serving.inter_token_seconds", 0.5),
-        "itl_p95_s": _q("serving.inter_token_seconds", 0.95),
+        "itl_p50_s": _metric_quantile("serving.inter_token_seconds", 0.5),
+        "itl_p95_s": _metric_quantile("serving.inter_token_seconds", 0.95),
         "step_traces": step_traces,
         "note": ("continuous batching over the paged KV pool; sequential "
                  "baseline reuses ONE compiled generate() program pair "
                  "(pinned max_len)"),
     }
+
+
+def _overfit_cyclic_gpt(model_kwargs=None, period=8, train_steps=150,
+                        seq_len=64, batch=8):
+    """A small GPT overfit on a phase-shifted cyclic token stream, so
+    greedy decode emits genuinely repetitive/structured output — the
+    workload speculative decoding exists for.  Phases vary across the
+    batch rows, forcing the model to continue the CONTEXT's cycle rather
+    than memorize absolute positions (which would defeat n-gram drafts on
+    phase-shifted prompts)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.text.models import GPTForCausalLM
+
+    paddle.seed(0)
+    kw = dict(vocab_size=128, hidden_size=128, num_hidden_layers=4,
+              num_attention_heads=4, max_position_embeddings=256)
+    kw.update(model_kwargs or {})
+    m = GPTForCausalLM(**kw)
+    cyc = (np.arange(kw["max_position_embeddings"] + seq_len) % period
+           + 1).astype("int64")
+    o = opt.AdamW(learning_rate=3e-3, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, o, loss_fn=None)
+    ids = paddle.to_tensor(np.stack([cyc[i:i + seq_len]
+                                     for i in range(batch)]))
+    for _ in range(train_steps):
+        step({"input_ids": ids, "labels": ids})
+    return m.eval(), cyc, period
+
+
+def _measure_serving_speculative(spec_k=0, n_requests=8, num_slots=4, S0=32,
+                                 page_size=16, max_new=96, train_steps=150,
+                                 model_kwargs=None):
+    """ONE arm of the speculative-vs-baseline comparison (spec_k=0 is the
+    baseline): decode tokens/sec, ITL p50/p95, acceptance rate, and the
+    full greedy ids so the parent can assert byte-identity across arms.
+    Each arm runs in its own subprocess (fresh metrics registry, fresh
+    device state), mirroring the per-section hygiene of the full bench."""
+    import time
+
+    from paddle_tpu.serving import ServingEngine
+
+    m, cyc, period = _overfit_cyclic_gpt(model_kwargs, train_steps=train_steps)
+    prompts = [cyc[i % period:i % period + S0] for i in range(n_requests)]
+    max_len = S0 + max_new
+
+    engine = ServingEngine(m, num_slots=num_slots, page_size=page_size,
+                           max_model_len=max_len, speculative_k=spec_k)
+    with engine:
+        engine.generate(prompts[0], max_new_tokens=4, timeout=600)  # compile
+        t0 = time.time()
+        handles = [engine.submit(p, max_new_tokens=max_new) for p in prompts]
+        ids = [h.result(timeout=600) for h in handles]
+        dt = time.time() - t0
+        rate = engine.acceptance_rate
+
+    total = n_requests * max_new
+    return {
+        "spec_k": spec_k,
+        "tokens": total,
+        "tokens_per_sec": round(total / dt, 2),
+        "itl_p50_s": _metric_quantile("serving.inter_token_seconds", 0.5),
+        "itl_p95_s": _metric_quantile("serving.inter_token_seconds", 0.95),
+        "acceptance_rate": round(rate, 4) if rate is not None else None,
+        "ids": ids,
+    }
+
+
+def _serving_speculative_report(k, **kwargs):
+    """Both arms (separate subprocesses via _section) + the acceptance
+    criteria: speedup on decode tokens/sec with byte-identical greedy
+    output and the measured acceptance rate."""
+    base = _section("serving_spec", BENCH_SPEC_K="0")
+    spec = _section("serving_spec", BENCH_SPEC_K=str(int(k)))
+    out = {
+        "k": int(k),
+        "tokens": spec["tokens"],
+        "baseline_tokens_per_sec": base["tokens_per_sec"],
+        "speculative_tokens_per_sec": spec["tokens_per_sec"],
+        "speedup": round(spec["tokens_per_sec"]
+                         / max(base["tokens_per_sec"], 1e-9), 3),
+        "acceptance_rate": spec["acceptance_rate"],
+        "greedy_identical": base["ids"] == spec["ids"],
+        "baseline_itl_p50_s": base["itl_p50_s"],
+        "baseline_itl_p95_s": base["itl_p95_s"],
+        "speculative_itl_p50_s": spec["itl_p50_s"],
+        "speculative_itl_p95_s": spec["itl_p95_s"],
+        "note": ("n-gram drafting + multi-token paged verification on a "
+                 "repetitive-suffix workload; greedy_identical asserts "
+                 "byte-equal output vs the non-speculative engine"),
+    }
+    return out
 
 
 def _measure_tracing_overhead(iters=30):
@@ -297,11 +393,11 @@ def _mfu_fields(flops_per_sec, peak, matmul_tflops):
 # poisons the next — observed: the raw BERT step at 457 samples/s alone vs
 # 2.9 samples/s after the framework section ran in the same process.  One
 # process at a time holds the chip; sections run sequentially.
-def _section(name):
+def _section(name, **extra_env):
     import os
     import subprocess
 
-    env = dict(os.environ, BENCH_SECTION=name)
+    env = dict(os.environ, BENCH_SECTION=name, **extra_env)
     r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                        capture_output=True, text=True, env=env,
                        cwd=os.path.dirname(os.path.abspath(__file__)))
@@ -342,6 +438,11 @@ def _run_section(name):
         return {"tps": _measure_decode("paged")}
     if name == "serving":
         return _measure_serving()
+    if name == "serving_spec":
+        import os
+
+        return _measure_serving_speculative(
+            spec_k=int(os.environ.get("BENCH_SPEC_K", "0")))
     if name == "tracing_overhead":
         return _measure_tracing_overhead()
     if name == "chaos_smoke":
@@ -423,7 +524,13 @@ def main():
     if "--serving" in sys.argv:
         # serving micro-benchmark only (own process = fresh device state,
         # same hygiene as the per-section subprocesses of the full run)
-        out = {"serving": _section("serving")}
+        spec_k = _spec_k_from_argv()
+        if spec_k:
+            # --speculative k: n-gram-draft + multi-token-verify engine vs
+            # the non-speculative engine on a repetitive-suffix workload
+            out = {"serving_speculative": _serving_speculative_report(spec_k)}
+        else:
+            out = {"serving": _section("serving")}
         if "--emit-metrics" in sys.argv:
             # the observability contract rides along: tracing on/off delta
             # in the same BENCH json so overhead regressions are visible
@@ -537,6 +644,15 @@ def main():
         if path is None:
             print("--emit-metrics: no --metrics-dir/PADDLE_METRICS_DIR set; "
                   "nothing written", file=sys.stderr)
+
+
+def _spec_k_from_argv():
+    for i, a in enumerate(sys.argv):
+        if a == "--speculative" and i + 1 < len(sys.argv):
+            return int(sys.argv[i + 1])
+        if a.startswith("--speculative="):
+            return int(a.split("=", 1)[1])
+    return None
 
 
 def _metrics_dir_from_argv():

@@ -278,6 +278,57 @@ def paged_table_token_write(pool, tok, table, lens):
     return pool.at[pages, lens % ps].set(tok.astype(pool.dtype))
 
 
+def paged_table_chunk_write(pool, kv, table, lens):
+    """Write a CHUNK of C tokens per slot at positions ``lens[b] ..
+    lens[b]+C-1`` (speculative verify: the last sampled token plus C-1
+    draft tokens land in one call).
+
+    pool: [P, ps, h, d]; kv: [B, C, h, d]; table: [B, NP]; lens: [B] int32.
+    Lanes past the table's reach (pad drafts of a slot near the model cap)
+    are DROPPED, not clamped: a clamp would make the pad lane collide with
+    the chunk's own last real write in the same scatter, and duplicate-
+    index ``.set`` order is undefined — the junk could win and corrupt the
+    final valid position.  In-range junk lanes (rejected drafts) need no
+    undo: they sit past the slot's valid length, invisible to ``seq_lens``
+    masking, and the next step's write at the rolled-back length
+    overwrites them."""
+    B, C, h, d = kv.shape
+    ps = pool.shape[1]
+    NP = table.shape[1]
+    pos = lens.astype(jnp.int32)[:, None] \
+        + jnp.arange(C, dtype=jnp.int32)[None, :]            # [B, C]
+    in_range = pos < jnp.int32(NP * ps)
+    pos_c = jnp.minimum(pos, jnp.int32(NP * ps - 1))
+    pages = jnp.take_along_axis(table.astype(jnp.int32), pos_c // ps, axis=1)
+    pages = jnp.where(in_range, pages, jnp.int32(-1))  # OOB sentinel
+    return pool.at[pages.reshape(-1), (pos_c % ps).reshape(-1)].set(
+        kv.reshape(B * C, h, d).astype(pool.dtype), mode="drop")
+
+
+def paged_chunk_attend(q, k_pages, v_pages, table, lens):
+    """Attend C query positions per slot against the global paged pools:
+    position t of slot b sees tokens ``0 .. lens[b]+t`` (its own K/V
+    included — the chunk is written before attending, and within-chunk
+    causality falls out of the per-position valid lengths).
+
+    One :func:`paged_attention` call over a [B*C]-row expanded batch (each
+    chunk position is its own row sharing slot b's page table with its own
+    length), so the Pallas scalar-prefetch kernel and the dense reference
+    are reused unchanged.
+
+    q: [B, C, H, D] -> [B, C, H, D]."""
+    B, C, H, D = q.shape
+    NP = table.shape[1]
+    ps = k_pages.shape[1]
+    lens2 = lens.astype(jnp.int32)[:, None] + jnp.int32(1) \
+        + jnp.arange(C, dtype=jnp.int32)[None, :]            # [B, C]
+    lens2 = jnp.minimum(lens2, jnp.int32(NP * ps))
+    table2 = jnp.broadcast_to(table[:, None, :], (B, C, NP)).reshape(B * C, NP)
+    out = paged_attention(q.reshape(B * C, H, D), k_pages, v_pages,
+                          table2, lens2.reshape(-1))
+    return out.reshape(B, C, H, D)
+
+
 class PagedKVCache:
     """Block-paged KV cache manager (the allocator side of PagedAttention).
 

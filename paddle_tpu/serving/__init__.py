@@ -10,10 +10,14 @@ cache (ROADMAP north star: "serves heavy traffic from millions of users").
   LM to the prefill/step closures the engine compiles.
 - :mod:`.api` — :class:`ContinuousBatchingPredictor`, the
   ``paddle.inference``-shaped deployment facade.
+- :mod:`.speculative` — :class:`NgramDrafter` (prompt-lookup drafts) +
+  :func:`make_verifier` (multi-token acceptance / rejection sampling) for
+  ``ServingEngine(speculative_k=k)`` draft-and-verify decoding.
 
 Metrics (PR-1 registry, README "Serving"): ``serving.*`` histograms /
 gauges / counters — TTFT, inter-token latency, queue depth, slot
-occupancy, page-pool utilization, admission/preemption/trace counters.
+occupancy, page-pool utilization, admission/preemption/trace counters,
+speculative proposal/acceptance, prefix-cache hit/miss/eviction.
 """
 
 from .adapter import GPTAdapter  # noqa: F401
@@ -23,9 +27,11 @@ from .engine import (  # noqa: F401
     EngineStoppedError, Request, RequestHandle, RequestRejectedError,
     SamplingParams, ServingEngine,
 )
+from .speculative import NgramDrafter, make_verifier  # noqa: F401
 
 __all__ = [
     "ServingEngine", "Request", "RequestHandle", "RequestRejectedError",
     "EngineStoppedError", "SamplingParams", "BlockManager", "PageAllocation",
-    "GPTAdapter", "ContinuousBatchingPredictor",
+    "GPTAdapter", "ContinuousBatchingPredictor", "NgramDrafter",
+    "make_verifier",
 ]

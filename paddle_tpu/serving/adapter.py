@@ -11,9 +11,15 @@ An adapter reduces a causal LM to two closures over explicit jax state
 - ``step(params, bufs, last, kp, vp, table, lens)`` — one decode token per
   slot at each slot's OWN position ``lens[b]`` (iteration-level batching:
   no lock-step scalar pos), attention through the paged kernel.
+- ``verify(params, bufs, ids, kp, vp, table, lens)`` — speculative
+  decoding's multi-token step: C tokens per slot at positions
+  ``lens[b]..lens[b]+C-1`` through the "served_chunk" cache variant,
+  returning logits at EVERY position so the engine can accept/reject the
+  drafted suffix (serving/speculative.py).
 
-Both return ``(logits [B, V] f32, kp, vp)`` with
-``kp/vp: [L, P, ps, h, d]`` stacked per-layer global pools.
+prefill/step return ``(logits [B, V] f32, kp, vp)``, verify
+``(logits [B, C, V] f32, kp, vp)``, with ``kp/vp: [L, P, ps, h, d]``
+stacked per-layer global pools.
 """
 
 from __future__ import annotations
@@ -52,7 +58,8 @@ class GPTAdapter:
         return kp, jnp.zeros_like(kp)
 
     # ------------------------------------------------------------- closures
-    def _run(self, params, bufs, ids, kp, vp, table, lens, pos_ids):
+    def _run(self, params, bufs, ids, kp, vp, table, lens, pos_ids,
+             tag="served"):
         from ..framework import random as _rng
         from ..framework.state import no_grad_ctx
         from ..tensor.tensor import Tensor
@@ -60,7 +67,7 @@ class GPTAdapter:
         gpt = self.gpt
         with no_grad_ctx(), _rng.rng_scope(jax.random.key(0)), \
                 self.model.bind(params, bufs):
-            lc = [("served", Tensor(kp[i]), Tensor(vp[i]), Tensor(table),
+            lc = [(tag, Tensor(kp[i]), Tensor(vp[i]), Tensor(table),
                    Tensor(lens)) for i in range(self.num_layers)]
             x, new_cache = gpt(Tensor(ids), position_ids=Tensor(pos_ids),
                                cache=lc)
@@ -85,4 +92,27 @@ class GPTAdapter:
         x, w, kp, vp = self._run(params, bufs, last, kp, vp, table, lens,
                                  pos_ids)
         logits = x[:, -1].astype(jnp.float32) @ w.T.astype(jnp.float32)
+        return logits, kp, vp
+
+    def verify(self, params, bufs, ids, kp, vp, table, lens):
+        """Multi-token verification step (speculative decoding): run
+        ``ids [B, C]`` — each row the slot's last sampled token followed by
+        C-1 draft tokens — at per-slot positions ``lens[b]..lens[b]+C-1``.
+        All C K/V per slot are written into the global pools and attended
+        against them in ONE call (the "served_chunk" cache variant), and
+        logits come back for EVERY position: ``logits[b, t]`` is the
+        next-token distribution after ``ids[b, :t+1]``, which is exactly
+        what accepting/rejecting draft t+1 needs.
+
+        Returns ``(logits [B, C, V] f32, kp, vp)``."""
+        C = ids.shape[1]
+        pos_ids = lens[:, None].astype(jnp.int64) \
+            + jnp.arange(C, dtype=jnp.int64)[None, :]
+        # clamp: rows shorter than the padded draft may reach past the
+        # position table near the model cap; those positions' logits are
+        # junk the engine never reads (draft lengths are capped host-side)
+        pos_ids = jnp.minimum(pos_ids, self.max_model_len - 1)
+        x, w, kp, vp = self._run(params, bufs, ids, kp, vp, table, lens,
+                                 pos_ids, tag="served_chunk")
+        logits = x.astype(jnp.float32) @ w.T.astype(jnp.float32)
         return logits, kp, vp

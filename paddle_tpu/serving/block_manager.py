@@ -61,6 +61,21 @@ class BlockManager:
         self._free = collections.deque(range(self.num_pages))
         self._active = {}                       # prefix key -> [page, refs]
         self._idle = collections.OrderedDict()  # prefix key -> page (refs 0)
+        # prefix-cache observability: hits = sharable pages whose key was
+        # resident (active refcount bump or idle resurrection), misses =
+        # sharable pages allocated fresh, evictions = idle prefix pages
+        # reclaimed because the free list ran dry
+        from ..profiler import metrics as _metrics
+
+        self._m_hits = _metrics.counter(
+            "serving.prefix_cache_hits",
+            "prefix-sharing pages reused from the active/idle cache")
+        self._m_misses = _metrics.counter(
+            "serving.prefix_cache_misses",
+            "sharable prefix pages that had to be allocated fresh")
+        self._m_evictions = _metrics.counter(
+            "serving.prefix_cache_evictions",
+            "idle prefix pages evicted LRU to refill the free list")
 
     # ------------------------------------------------------------ accounting
     def pages_for(self, num_tokens):
@@ -84,6 +99,7 @@ class BlockManager:
             return self._free.popleft()
         # free list dry: evict the least-recently-idled shared prefix page
         _, page = self._idle.popitem(last=False)
+        self._m_evictions.inc()
         return page
 
     def _prefix_hits(self, prompt_ids, n_sharable):
@@ -131,6 +147,8 @@ class BlockManager:
             return None
         need, n_sharable, hits = plan
         pages, keys = [], []
+        if hits:
+            self._m_hits.inc(len(hits))
         for key in hits:
             ent = self._active.get(key)
             if ent is not None:
@@ -148,8 +166,11 @@ class BlockManager:
             # entry and orphan its page from the pool
             if key is not None and key in self._idle:
                 page = self._idle.pop(key)
+                self._m_hits.inc()   # key was resident: still a cache hit
             else:
                 page = self._pop_free()
+                if key is not None:
+                    self._m_misses.inc()
             pages.append(page)
             if key is not None:  # new shareable prefix page: register it
                 self._active[key] = [page, 1]

@@ -31,6 +31,20 @@ Observability (PR-1 metrics registry): ``serving.ttft_seconds``,
 ``serving.admissions_blocked``, ``serving.preemptions``,
 ``serving.step_traces``, ``serving.prefill_traces`` counters.
 
+Speculative decoding (``speculative_k > 0``, see ``serving/speculative.py``
+and README "Speculative decoding"): each iteration drafts up to k tokens
+per slot by n-gram suffix match over the slot's own context (prompt-lookup
+— no second model) and verifies them in ONE compiled multi-token step (the
+``("verify", k_pad, …)`` program family; K/V for all k+1 positions lands
+in the page pools through ``ops.paged_attention.paged_table_chunk_write``
+/ ``paged_chunk_attend``).  The scheduler consumes the longest accepted
+prefix plus the bonus token — 1..k+1 tokens per dispatch — with EOS /
+deadline / cancel / budget checks per emitted token.  Greedy rows accept
+by exact argmax match, so greedy output is byte-identical to the
+non-speculative engine; temperature rows use standard rejection sampling.
+Extra metrics: ``serving.spec_proposed``, ``serving.spec_accepted``,
+``serving.acceptance_rate`` (also on /statusz), ``serving.verify_traces``.
+
 Resilience (PR-4, README "Resilience & fault tolerance"): a health state
 machine (healthy → degraded → draining) surfaced on /healthz and /statusz;
 deadline-aware load shedding at submit with distinct rejection reasons
@@ -69,6 +83,11 @@ _logger = logging.getLogger("paddle_tpu.serving")
 
 _HEALTH_CODE = {"healthy": 0, "degraded": 1, "draining": 2, "stopped": 3,
                 "error": 4}
+
+# prefill bucketing: prompts up to this many pages compile one prefill
+# program per page count; above it, page counts round up to the next power
+# of two so long-prompt traffic stops minting a program per page increment
+_PREFILL_POW2_PAGES = 4
 
 
 class RequestRejectedError(RuntimeError):
@@ -215,7 +234,8 @@ class ServingEngine:
                  num_pages=None, top_k=0, top_p=1.0, prefix_sharing=False,
                  max_queue=None, seed=0, adapter=None, watchdog_s=None,
                  telemetry_port=None, max_engine_restarts=3,
-                 degraded_stall_s=2.0, restart_cooldown_s=10.0):
+                 degraded_stall_s=2.0, restart_cooldown_s=10.0,
+                 speculative_k=0, draft_max_ngram=3, draft_min_ngram=1):
         self._model = model
         self._adapter = adapter if adapter is not None \
             else GPTAdapter(model, page_size)
@@ -245,10 +265,43 @@ class ServingEngine:
         self._key_counter = itertools.count()
         self._rid_counter = itertools.count()
 
+        # speculative decoding (serving/speculative.py): n-gram drafts are
+        # verified k+1 tokens at a time by ONE compiled multi-token step —
+        # greedy rows accept by exact argmax match (byte-identical output),
+        # temperature rows by rejection sampling
+        self._spec_k = int(speculative_k)
+        if self._spec_k < 0:
+            raise ValueError(f"speculative_k must be >= 0, got {speculative_k}")
+        self._drafter = None
+        self._verifier = None
+        if self._spec_k:
+            from .speculative import NgramDrafter, make_verifier
+
+            self._drafter = NgramDrafter(self._spec_k, draft_max_ngram,
+                                         draft_min_ngram)
+            self._verifier = make_verifier(top_k, top_p)
+        self._spec_proposed_total = 0
+        self._spec_accepted_total = 0
+
         self._queue = collections.deque()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._slots = [None] * self.num_slots
+        # persistent per-step host buffers: rows change on admit/retire and
+        # per-token advances only, so the hot decode dispatch stops
+        # re-allocating and re-filling a fresh [B, NP] table every
+        # iteration (measured per-step host overhead on the paged path)
+        self._h_last = np.zeros((self.num_slots, 1), np.int64)
+        self._h_lens = np.zeros((self.num_slots,), np.int32)
+        self._h_temps = np.zeros((self.num_slots,), np.float32)
+        self._h_table = np.full((self.num_slots, self.table_width),
+                                self._scratch, np.int32)
+        if self._spec_k:
+            self._h_ids = np.zeros((self.num_slots, self._spec_k + 1),
+                                   np.int64)
+            self._h_dlen = np.zeros((self.num_slots,), np.int32)
+        self._n_temp = 0          # live slots with temperature sampling
+        self._gauges_t = 0.0      # last _update_gauges stamp (throttled)
         self._max_queue = max_queue
         self._stop_evt = threading.Event()
         self._thread = None
@@ -320,6 +373,15 @@ class ServingEngine:
         self._m_health = _metrics.gauge(
             "serving.health_state",
             "0 healthy, 1 degraded, 2 draining, 3 stopped, 4 error")
+        self._m_spec_proposed = _metrics.counter(
+            "serving.spec_proposed", "draft tokens submitted to verification")
+        self._m_spec_accepted = _metrics.counter(
+            "serving.spec_accepted", "draft tokens accepted by verification")
+        self._m_accept_rate = _metrics.gauge(
+            "serving.acceptance_rate",
+            "speculative acceptance: spec_accepted / spec_proposed")
+        self._m_verify_traces = _metrics.counter(
+            "serving.verify_traces", "verify-step program traces")
 
     # ------------------------------------------------------------ lifecycle
     def start(self):
@@ -391,6 +453,7 @@ class ServingEngine:
                 self._bm.free(s.alloc)
                 self._slots[i] = None
                 self._fail_stopped(s.handle)
+        self._reset_host_buffers()
         with self._lock:
             while self._queue:
                 self._fail_stopped(self._queue.popleft().handle)
@@ -610,6 +673,48 @@ class ServingEngine:
 
         return self._program(key, build)
 
+    def _verify_program(self):
+        """The compiled multi-token verification step (speculative
+        decoding): the ``("verify", k_pad, …)`` bucket family in the
+        program store — one trace per (k, batch-shape, sampler) tuple,
+        exactly like the plain decode step."""
+        k_pad = self._spec_k
+        key = ("verify", k_pad, self.num_slots, self.table_width,
+               self._pools[0].shape, str(self._pools[0].dtype), self._top)
+
+        def build():
+            traces = [0]
+            adapter, verifier = self._adapter, self._verifier
+
+            @functools.partial(jax.jit, donate_argnums=(3, 4))
+            def verify(params, bufs, ids, kp, vp, table, lens, dlen, temps,
+                       rkey):
+                traces[0] += 1
+                logits, kp, vp = adapter.verify(params, bufs, ids, kp, vp,
+                                                table, lens)
+                targets, accept = verifier(logits, ids[:, 1:], dlen, temps,
+                                           rkey)
+                return targets, accept, kp, vp
+
+            return verify, traces
+
+        return self._program(key, build)
+
+    def _prefill_bucket(self, S0):
+        """Padded prefill width for a prompt of ``S0`` tokens: multiples of
+        page_size up to ``_PREFILL_POW2_PAGES`` pages, then the next
+        power-of-two page count (clamped to the table width) — long-prompt
+        traffic mints O(log max_len) compiled prefill programs instead of
+        one per page-size increment.  Correctness is untouched: the pad
+        region is causally invisible to the logits gather at ``lens-1``,
+        and its junk K/V lands in pages a later real write overwrites
+        before per-slot ``seq_lens`` masking ever exposes them."""
+        ps = self.page_size
+        pages = max(1, -(-int(S0) // ps))
+        if pages > _PREFILL_POW2_PAGES:
+            pages = 1 << (pages - 1).bit_length()
+        return min(pages, self.table_width) * ps
+
     def _prefill_program(self, s_pad):
         key = ("serve_prefill", s_pad, self.table_width,
                self._pools[0].shape, str(self._pools[0].dtype), self._top)
@@ -705,6 +810,7 @@ class ServingEngine:
         self._bm = BlockManager(self._num_pages, self.page_size,
                                 prefix_sharing=self._prefix_sharing)
         self._pools = self._adapter.init_pools(self._num_pages + 1)
+        self._reset_host_buffers()
         with self._lock:
             for req, produced in reversed(inflight):
                 h = req.handle
@@ -738,6 +844,7 @@ class ServingEngine:
                 self._slots[i] = None
                 s.handle._error = exc
                 self._finish(s.handle, "error")
+        self._reset_host_buffers()
         with self._lock:
             while self._queue:
                 req = self._queue.popleft()
@@ -781,9 +888,8 @@ class ServingEngine:
             self._prefill(req, alloc, free_slot)
 
     def _prefill(self, req, alloc, slot_idx):
-        ps = self.page_size
         S0 = len(req.prompt)
-        s_pad = max(ps, -(-S0 // ps) * ps)  # bucket: multiple of page_size
+        s_pad = self._prefill_bucket(S0)
         ids = np.zeros((1, s_pad), np.int64)
         ids[0, :S0] = req.prompt
         table_row = np.asarray(alloc.pages, np.int32)
@@ -820,27 +926,46 @@ class ServingEngine:
         req.handle.status = "running"
         self._slots[slot_idx] = slot
         self._admitting = None
+        # persistent host-buffer row for the decode dispatch (rebuilt here
+        # and on retire only, never per step)
+        i = slot_idx
+        self._h_table[i, :] = self._scratch
+        self._h_table[i, :len(table_row)] = table_row
+        self._h_lens[i] = slot.length
+        self._h_temps[i] = slot.temp
+        self._h_last[i, 0] = tok
+        if slot.temp > 0:
+            self._n_temp += 1
+        if self._drafter is not None:
+            # draft context = prompt + every emitted token (re-admission
+            # after a restart passes prompt+tokens-so-far as the prompt,
+            # so the rebuilt index sees the same stream)
+            self._drafter.register(i, req.prompt)
+            self._drafter.extend(i, [tok])
         self._emit_token(slot, tok)
         self._retire_if_done(slot_idx)
+
+    def _step_key(self):
+        """PRNG key for a decode dispatch.  A batch with no temperature
+        rows never consumes randomness (the batched sampler/verifier
+        returns argmax for ``temps <= 0`` rows), so the hot greedy path
+        skips the per-step ``fold_in`` device dispatch and reuses the base
+        key — one less host->device round trip per step."""
+        return self._next_key() if self._n_temp else self._base_key
 
     def _step_once(self):
         # chaos site: an injected fn raising a TransientError here drives
         # the auto-restart + requeue path through the real scheduler
+        # (covers BOTH the plain decode step and the speculative verify
+        # step — a crash between verifies must requeue with exactly the
+        # accepted-token state)
         _faults.maybe("serving.step_crash")
-        B = self.num_slots
-        last = np.zeros((B, 1), np.int64)
-        lens = np.zeros((B,), np.int32)
-        temps = np.zeros((B,), np.float32)
-        table = np.full((B, self.table_width), self._scratch, np.int32)
-        active = []
-        for i, s in enumerate(self._slots):
-            if s is None:
-                continue
-            active.append(i)
-            last[i, 0] = s.last
-            lens[i] = s.length
-            temps[i] = s.temp
-            table[i, :len(s.table_row)] = s.table_row
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if self._spec_k:
+            return self._verify_once(active)
+        return self._plain_step(active)
+
+    def _plain_step(self, active):
         prog, traces = self._step_program()
         n0 = traces[0]
         if _tracing._ACTIVE:
@@ -857,9 +982,9 @@ class ServingEngine:
         t0 = time.perf_counter()
         try:
             with cm:
-                tok, kp, vp = prog(self._params, self._bufs, last,
-                                   *self._pools, table, lens, temps,
-                                   self._next_key())
+                tok, kp, vp = prog(self._params, self._bufs, self._h_last,
+                                   *self._pools, self._h_table, self._h_lens,
+                                   self._h_temps, self._step_key())
                 self._pools = (kp, vp)
                 tok = np.asarray(tok)
         finally:
@@ -874,8 +999,111 @@ class ServingEngine:
             s.length += 1
             s.produced += 1
             s.last = int(tok[i])
+            self._h_lens[i] = s.length
+            self._h_last[i, 0] = s.last
             self._emit_token(s, s.last)
-            self._retire_if_done(i)
+            if not self._retire_if_done(i) and self._drafter is not None:
+                # a speculative engine can route no-draft iterations through
+                # this path: the drafter's context must keep growing or it
+                # would never find a matching suffix again
+                self._drafter.extend(i, [s.last])
+
+    def _verify_once(self, active):
+        """One speculative iteration: draft up to k tokens per slot from
+        the n-gram index, verify all of them (plus the pending last token)
+        in ONE compiled multi-token dispatch, then consume the longest
+        accepted prefix per slot + the bonus/resample token — 1..k+1
+        tokens per slot per step, with retire/deadline/EOS checks applied
+        per emitted token exactly like the single-token path."""
+        K = self._spec_k
+        drafts = {}
+        for i in active:
+            s = self._slots[i]
+            self._h_ids[i, 0] = s.last
+            self._h_ids[i, 1:] = 0
+            # never draft past the request budget or the position cap: the
+            # bonus token always lands, so at most remaining-1 drafts fit
+            cap = min(K, s.max_new - s.produced - 1,
+                      self.max_model_len - s.length - 1)
+            d = self._drafter.propose(i, cap) if cap > 0 else []
+            if d:
+                self._h_ids[i, 1:1 + len(d)] = d
+            self._h_dlen[i] = len(d)
+            drafts[i] = d
+        if not any(drafts.values()):
+            # nothing drafted anywhere this iteration: the (k+1)-wide
+            # verify dispatch would pay (k+1)x attention/FFN to emit one
+            # token per slot — the plain step is the same result cheaper
+            return self._plain_step(active)
+        prog, traces = self._verify_program()
+        n0 = traces[0]
+        if _tracing._ACTIVE:
+            cm = _tracing.span(
+                "serving.verify_step", iteration=self._iteration,
+                batch=len(active), k=K,
+                drafted=int(sum(len(drafts[i]) for i in active)),
+                links=[self._slots[i].handle.trace_id for i in active])
+        else:
+            cm = _tracing.NOOP
+        self._compiling = n0 == 0
+        t0 = time.perf_counter()
+        try:
+            with cm:
+                targets, accept, kp, vp = prog(
+                    self._params, self._bufs, self._h_ids, *self._pools,
+                    self._h_table, self._h_lens, self._h_dlen,
+                    self._h_temps, self._step_key())
+                self._pools = (kp, vp)
+                targets = np.asarray(targets)
+                accept = np.asarray(accept)
+        finally:
+            self._compiling = False
+            self._progress_t = time.monotonic()
+        if traces[0] > n0:
+            self._m_verify_traces.inc(traces[0] - n0)
+        self._m_step_seconds.observe(time.perf_counter() - t0)
+        self._iteration += 1
+        proposed = accepted = 0
+        for i in active:
+            s = self._slots[i]
+            d = drafts[i]
+            a = 0
+            while a < len(d) and accept[i, a]:
+                a += 1
+            proposed += len(d)
+            emitted = [int(t) for t in d[:a]] + [int(targets[i, a])]
+            # pool state: positions length..length+a now hold the old
+            # `last` + the a accepted drafts; rejected tail K/V sits past
+            # the new length, where seq_lens masking hides it until the
+            # next chunk write overwrites it (rollback = lens stays put)
+            done = False
+            emitted_n = 0
+            for tok in emitted:
+                s.length += 1
+                s.produced += 1
+                s.last = tok
+                self._h_lens[i] = s.length
+                self._h_last[i, 0] = tok
+                self._emit_token(s, tok)
+                emitted_n += 1
+                if self._retire_if_done(i):
+                    done = True
+                    break
+            # accepted = drafts that became OUTPUT tokens: early retirement
+            # (EOS mid-draft, deadline, budget) discards the rest, and the
+            # acceptance-rate gauge must not credit discarded tokens
+            accepted += min(emitted_n, a)
+            if not done:
+                self._drafter.extend(i, emitted)
+        if proposed:
+            self._m_spec_proposed.inc(proposed)
+            self._spec_proposed_total += proposed
+        if accepted:
+            self._m_spec_accepted.inc(accepted)
+            self._spec_accepted_total += accepted
+        if self._spec_proposed_total:
+            self._m_accept_rate.set(
+                self._spec_accepted_total / self._spec_proposed_total)
 
     def _emit_token(self, slot, tok):
         h = slot.handle
@@ -908,8 +1136,38 @@ class ServingEngine:
             return False
         self._bm.free(slot.alloc)
         self._slots[i] = None
+        self._clear_slot_row(i, slot)
         self._finish(h, status)
         return True
+
+    def _clear_slot_row(self, i, slot):
+        """Reset slot ``i``'s persistent host-buffer row (and drafter
+        state) after retirement — the row points at scratch again so the
+        next dispatch treats the lane as inactive."""
+        self._h_table[i, :] = self._scratch
+        self._h_lens[i] = 0
+        self._h_temps[i] = 0.0
+        self._h_last[i, 0] = 0
+        if self._spec_k:
+            self._h_ids[i, :] = 0
+            self._h_dlen[i] = 0
+        if slot.temp > 0:
+            self._n_temp -= 1
+        if self._drafter is not None:
+            self._drafter.release(i)
+
+    def _reset_host_buffers(self):
+        """Full reset (engine restart / stop): every lane inactive."""
+        self._h_table[:] = self._scratch
+        self._h_lens[:] = 0
+        self._h_temps[:] = 0.0
+        self._h_last[:] = 0
+        if self._spec_k:
+            self._h_ids[:] = 0
+            self._h_dlen[:] = 0
+        self._n_temp = 0
+        if self._drafter is not None:
+            self._drafter.reset()
 
     def _finish(self, handle, status):
         handle.status = status
@@ -925,6 +1183,14 @@ class ServingEngine:
         handle._done.set()
 
     def _update_gauges(self):
+        # throttled: gauges are dashboards, not control flow — refreshing
+        # six of them before EVERY decode dispatch was measurable host
+        # overhead on the sub-ms step path (queue_depth is also refreshed
+        # eagerly at submit/admit, where it actually changes)
+        now = time.monotonic()
+        if now - self._gauges_t < 0.05:
+            return
+        self._gauges_t = now
         n = sum(1 for s in self._slots if s is not None)
         self._m_queue_depth.set(len(self._queue))
         self._m_active.set(n)
@@ -975,8 +1241,15 @@ class ServingEngine:
     def block_manager(self):
         return self._bm
 
+    @property
+    def acceptance_rate(self):
+        """Lifetime speculative acceptance (None before any proposal)."""
+        if not self._spec_proposed_total:
+            return None
+        return self._spec_accepted_total / self._spec_proposed_total
+
     def stats(self):
-        return {
+        st = {
             "iteration": self._iteration,
             "queue_depth": len(self._queue),
             "active_slots": sum(1 for s in self._slots if s is not None),
@@ -986,6 +1259,14 @@ class ServingEngine:
             "page_utilization": self._bm.utilization(),
             "step_traces": self.step_traces,
         }
+        if self._spec_k:
+            st["speculative"] = {
+                "k": self._spec_k,
+                "proposed": self._spec_proposed_total,
+                "accepted": self._spec_accepted_total,
+                "acceptance_rate": self.acceptance_rate,
+            }
+        return st
 
     def _statusz(self):
         """/statusz provider: stats + the live slot table (diagnostic

@@ -1,0 +1,178 @@
+"""Speculative decoding for the serving engine: n-gram drafting +
+multi-token paged verification (Leviathan et al., "Fast Inference from
+Transformers via Speculative Decoding"; drafts via prompt-lookup / n-gram
+matching, so there is no second model).
+
+Split of labor:
+
+- :class:`NgramDrafter` (host side) — per-slot suffix-match over the
+  prompt + generated ids.  When the current context's n-token suffix
+  occurred earlier in the context, the tokens that followed it are
+  proposed as the draft (up to ``k``); no match proposes nothing and the
+  slot decodes one token that step, exactly like the non-speculative
+  engine.  Pure Python dict lookups — O(ngram sizes) per proposal,
+  incremental index updates per emitted token.
+
+- :func:`make_verifier` (device side) — given the verification logits
+  ``[B, k+1, V]`` from one compiled multi-token step
+  (:meth:`~.adapter.GPTAdapter.verify`), decide per slot how much of the
+  draft survives and what token follows the surviving prefix:
+
+  * greedy rows (``temps <= 0``): draft token t is accepted iff it equals
+    the argmax after the t-1 prefix — the accepted stream is EXACTLY the
+    token-by-token greedy stream, so greedy outputs stay byte-identical
+    to the non-speculative engine;
+  * temperature rows: standard rejection sampling against the
+    temperature/top-k/top-p-filtered distribution p̃.  The n-gram draft
+    is a point mass q(d)=1, so draft d is accepted with probability
+    p̃(d) and a rejection resamples from the residual
+    ``norm(p̃ with d zeroed)`` — the emitted marginal is p̃ exactly, the
+    same distribution the non-speculative sampler draws from.
+
+The engine consumes the longest accepted prefix per slot plus the bonus /
+resample token, so every verification step yields between 1 and k+1
+tokens.  Rejected tail tokens need no explicit undo: their K/V lands past
+the slot's valid length, where per-slot ``seq_lens`` masking keeps it
+invisible and the next step's chunk write overwrites it (rollback = not
+advancing ``lens``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class NgramDrafter:
+    """Prompt-lookup draft model: per-slot n-gram suffix index over the
+    full context (prompt + generated ids).
+
+    ``propose(sid)`` scans n-gram sizes from ``max_ngram`` down to
+    ``min_ngram``: the first size whose current suffix occurred earlier in
+    the context yields the tokens that followed that earlier occurrence
+    (most recent occurrence wins — recent structure predicts better on
+    structured output).  Returns up to ``k`` tokens; ``[]`` when nothing
+    matches (the k=0 fallback — the engine then decodes a single token for
+    that slot, paying only the cost of an unused pad lane).
+    """
+
+    def __init__(self, k=4, max_ngram=3, min_ngram=1):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(f"need 1 <= min_ngram <= max_ngram, got "
+                             f"{min_ngram}..{max_ngram}")
+        self.k = int(k)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        self._ctx = {}     # sid -> list[int]
+        self._index = {}   # sid -> {n -> {ngram tuple -> start pos}}
+
+    # ---------------------------------------------------------------- slots
+    def register(self, sid, context_ids):
+        """(Re)build slot ``sid``'s index from a full context (admission:
+        the prompt; re-admission after an engine restart: prompt +
+        tokens-so-far)."""
+        self._ctx[sid] = []
+        self._index[sid] = {n: {} for n in
+                            range(self.min_ngram, self.max_ngram + 1)}
+        self.extend(sid, context_ids)
+
+    def extend(self, sid, tokens):
+        """Append newly emitted tokens to slot ``sid``'s context and index.
+
+        An n-gram ending at position i is registered once position i+1
+        exists, so a lookup of the context's own suffix can only ever find
+        a genuinely EARLIER occurrence (overlap with the suffix is fine —
+        that is what makes single-token repetition draftable)."""
+        ctx = self._ctx[sid]
+        idx = self._index[sid]
+        for t in tokens:
+            i = len(ctx)          # position the new token will occupy
+            e = i - 1             # old last position: now safe to index
+            for n in range(self.min_ngram, self.max_ngram + 1):
+                if e - n + 1 >= 0:
+                    idx[n][tuple(ctx[e - n + 1:e + 1])] = e - n + 1
+            ctx.append(int(t))
+
+    def release(self, sid):
+        self._ctx.pop(sid, None)
+        self._index.pop(sid, None)
+
+    def reset(self):
+        self._ctx.clear()
+        self._index.clear()
+
+    # ------------------------------------------------------------- proposal
+    def propose(self, sid, max_tokens=None):
+        """Draft up to ``min(k, max_tokens)`` continuation tokens for slot
+        ``sid`` (``[]`` when no suffix matches or the cap is <= 0)."""
+        cap = self.k if max_tokens is None else min(self.k, int(max_tokens))
+        if cap <= 0:
+            return []
+        ctx = self._ctx.get(sid)
+        if not ctx:
+            return []
+        idx = self._index[sid]
+        L = len(ctx)
+        for n in range(self.max_ngram, self.min_ngram - 1, -1):
+            if L < n + 1:  # need the suffix plus at least one earlier token
+                continue
+            j = idx[n].get(tuple(ctx[L - n:]))
+            if j is not None:
+                return ctx[j + n:j + n + cap]
+        return []
+
+
+def make_verifier(top_k=0, top_p=1.0):
+    """Build the traced acceptance/resample function for the engine's
+    compiled verify step (one per (top_k, top_p) — static, part of the
+    program key, exactly like :func:`.._decode.make_batched_sampler`).
+
+    ``verify(logits, drafts, dlen, temps, key)``:
+
+    - ``logits [B, K+1, V]`` f32 — position t is the next-token
+      distribution after the last sampled token + drafts[:t];
+    - ``drafts [B, K]`` int — proposed tokens (junk past ``dlen[b]``);
+    - ``dlen [B]`` int32 — real draft length per slot (0 = no draft);
+    - ``temps [B]`` f32 — per-slot temperature (<= 0 is greedy);
+
+    returns ``(targets [B, K+1], accept [B, K])``: ``accept[b, t]`` says
+    draft t+1 survives (always False past ``dlen``), and ``targets[b, a]``
+    is the token to emit after accepting ``a`` drafts — the argmax /
+    residual resample on rejection, the full sample when every real draft
+    survived."""
+    from ..text.models._decode import apply_top_k_top_p
+
+    def verify(logits, drafts, dlen, temps, key):
+        B, K1, V = logits.shape
+        K = K1 - 1
+        greedy = jnp.argmax(logits, axis=-1)                     # [B, K1]
+        l = logits / jnp.maximum(temps, jnp.float32(1e-6))[:, None, None]
+        l = apply_top_k_top_p(l.reshape(B * K1, V), top_k, top_p)
+        l = l.reshape(B, K1, V)
+        p = jax.nn.softmax(l, axis=-1)
+        real = jnp.arange(K, dtype=jnp.int32)[None, :] \
+            < dlen.astype(jnp.int32)[:, None]                    # [B, K]
+        d32 = drafts.astype(jnp.int32)
+        pd = jnp.take_along_axis(p[:, :K], d32[..., None],
+                                 axis=-1)[..., 0]                # [B, K]
+        ku, ks = jax.random.split(key)
+        u = jax.random.uniform(ku, (B, K), dtype=jnp.float32)
+        acc_temp = u < pd                       # point-mass q: P(acc)=p̃(d)
+        acc_greedy = d32 == greedy[:, :K].astype(jnp.int32)
+        is_greedy = (temps <= jnp.float32(0.0))[:, None]
+        accept = jnp.where(is_greedy, acc_greedy, acc_temp) & real
+        # residual resample: where a REAL draft was verified, zero it out of
+        # the distribution (rejection-sampling residual); position K — and
+        # short-draft bonus positions — sample the full filtered p̃
+        is_draft = jnp.arange(V, dtype=jnp.int32)[None, None, :] \
+            == d32[..., None]                                    # [B, K, V]
+        lm = jnp.where(is_draft & real[..., None], -jnp.inf, l[:, :K])
+        lr = jnp.concatenate([lm, l[:, K:]], axis=1)             # [B, K1, V]
+        samp = jax.random.categorical(
+            ks, lr.reshape(B * K1, V), axis=-1).reshape(B, K1)
+        targets = jnp.where(is_greedy, greedy, samp)
+        return targets, accept
+
+    return verify

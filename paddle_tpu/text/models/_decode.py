@@ -33,21 +33,33 @@ def program_store(model):
     return store
 
 
+def apply_top_k_top_p(l, top_k, top_p):
+    """Static top-k / top-p (nucleus) filtering on [N, V] logits.
+
+    top_k/top_p are trace-time constants (part of every compiled program's
+    key); filtered entries become -inf.  Shared by the generate() samplers,
+    the serving engine's batched sampler, and the speculative-decoding
+    verifier (serving/speculative.py), so the three paths can never drift
+    on what distribution "temperature + top_k/top_p" means."""
+    if top_k:
+        kk = min(int(top_k), l.shape[-1])
+        kth = jax.lax.top_k(l, kk)[0][:, -1][:, None]
+        l = jnp.where(l < kth, -jnp.inf, l)
+    if top_p < 1.0:  # nucleus: smallest prefix of sorted probs >= top_p
+        srt = jnp.sort(l, axis=-1)[:, ::-1]
+        p = jax.nn.softmax(srt, axis=-1)
+        keep_n = (jnp.cumsum(p, axis=-1) - p < top_p).sum(-1)
+        kth = jnp.take_along_axis(srt, (keep_n - 1)[:, None], axis=-1)
+        l = jnp.where(l < kth, -jnp.inf, l)
+    return l
+
+
 def make_sampler(temperature, top_k, top_p):
     def sample(logits, key):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1)
         l = logits / jnp.float32(max(temperature, 1e-6))
-        if top_k:
-            kk = min(int(top_k), l.shape[-1])
-            kth = jax.lax.top_k(l, kk)[0][:, -1][:, None]
-            l = jnp.where(l < kth, -jnp.inf, l)
-        if top_p < 1.0:  # nucleus: smallest prefix of sorted probs >= top_p
-            srt = jnp.sort(l, axis=-1)[:, ::-1]
-            p = jax.nn.softmax(srt, axis=-1)
-            keep_n = (jnp.cumsum(p, axis=-1) - p < top_p).sum(-1)
-            kth = jnp.take_along_axis(srt, (keep_n - 1)[:, None], axis=-1)
-            l = jnp.where(l < kth, -jnp.inf, l)
+        l = apply_top_k_top_p(l, top_k, top_p)
         return jax.random.categorical(key, l, axis=-1)
 
     return sample
@@ -63,16 +75,7 @@ def make_batched_sampler(top_k=0, top_p=1.0):
     def sample(logits, temps, key):
         greedy = jnp.argmax(logits, axis=-1)
         l = logits / jnp.maximum(temps, jnp.float32(1e-6))[:, None]
-        if top_k:
-            kk = min(int(top_k), l.shape[-1])
-            kth = jax.lax.top_k(l, kk)[0][:, -1][:, None]
-            l = jnp.where(l < kth, -jnp.inf, l)
-        if top_p < 1.0:  # nucleus: smallest prefix of sorted probs >= top_p
-            srt = jnp.sort(l, axis=-1)[:, ::-1]
-            p = jax.nn.softmax(srt, axis=-1)
-            keep_n = (jnp.cumsum(p, axis=-1) - p < top_p).sum(-1)
-            kth = jnp.take_along_axis(srt, (keep_n - 1)[:, None], axis=-1)
-            l = jnp.where(l < kth, -jnp.inf, l)
+        l = apply_top_k_top_p(l, top_k, top_p)
         samp = jax.random.categorical(key, l, axis=-1)
         return jnp.where(temps <= jnp.float32(0.0), greedy, samp)
 
@@ -130,17 +133,22 @@ def decode_loop(model, fwd, ids0, max_new_tokens, init_cache,
     try:
         cache = init_cache()
         base = jax.random.key(seed if seed is not None else 0)
-        nxt, cache = prefill(params, bufs, jnp.asarray(ids0), cache,
-                             jax.random.fold_in(base, 0))
+        key0 = jax.random.fold_in(base, 0)
+        nxt, cache = prefill(params, bufs, jnp.asarray(ids0), cache, key0)
         # tokens stay ON DEVICE across the loop: async dispatch queues every
         # step without a host round-trip (through a tunneled TPU, a per-token
         # np.asarray sync made RTT — not step time — the decode bottleneck),
         # and ONE transfer at the end collects the whole id matrix.
+        # Per-step host work is hoisted off the dispatch path too: greedy
+        # decode never consumes randomness, so it reuses one key instead of
+        # paying a fold_in dispatch per token, and the position scalar is a
+        # host numpy int32 (same aval, no per-step device-array creation).
+        greedy = temperature == 0.0
         out = [nxt[:, None]]
         for t in range(1, max_new_tokens):
             nxt, cache = step(params, bufs, nxt[:, None].astype(jnp.int64),
-                              cache, jnp.int32(S0 + t - 1),
-                              jax.random.fold_in(base, t))
+                              cache, np.int32(S0 + t - 1),
+                              key0 if greedy else jax.random.fold_in(base, t))
             out.append(nxt[:, None])
         new = np.asarray(jnp.concatenate(out, axis=1))
     finally:

@@ -96,6 +96,32 @@ class GPTDecoderLayer(Layer):
         heads_here = qkv.shape[-1] // (3 * self.head_dim)
         qkv = qkv.reshape([B, S, heads_here, 3, self.head_dim])
         q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        if cache is not None and len(cache) == 5 and cache[0] == "served_chunk":
+            # SPECULATIVE VERIFY chunk (paddle_tpu.serving.speculative): the
+            # S tokens of each row are the slot's last sampled token plus
+            # S-1 draft tokens at per-slot positions lens[b]..lens[b]+S-1.
+            # All S K/V land in the global pools through the page table in
+            # one chunk write, then every position attends against the
+            # pools with its OWN valid length — no dense in-chunk fallback;
+            # causality within the chunk comes from the per-position lens
+            # (ops.paged_attention.paged_chunk_attend).
+            from ...ops.paged_attention import (paged_chunk_attend,
+                                                paged_table_chunk_write)
+
+            _, kp, vp, table, lens = cache
+            kp = _apply(paged_table_chunk_write, kp, k, table, lens,
+                        op_name="paged_write")
+            vp = _apply(paged_table_chunk_write, vp, v, table, lens,
+                        op_name="paged_write")
+            attn = _apply(paged_chunk_attend, q, kp, vp, table, lens,
+                          op_name="paged_attention")
+            attn = attn.reshape([B, S, heads_here * self.head_dim])
+            x = residual + self.dropout(self.out_proj(attn))
+            residual = x
+            h = self.ln2(x)
+            h = self.ffn2(self.act(self.ffn1(h)))
+            x = residual + self.dropout(h)
+            return x, ("served_chunk", kp, vp, table, lens)
         if cache is not None and len(cache) == 5 and cache[0] == "served":
             # SERVED cache (continuous-batching engine, paddle_tpu.serving):
             # ONE global page pool [P, ps, h, d] shared by every slot

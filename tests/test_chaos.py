@@ -265,15 +265,23 @@ def test_stop_fails_inflight_with_engine_stopped_error(model):
     eng = ServingEngine(model, num_slots=1, page_size=PS,
                         max_model_len=MAXLEN)
     eng.start()
-    h_run = eng.submit(_prompt(6, 80), max_new_tokens=50)
-    t0 = time.time()
-    while not h_run.token_ids and time.time() - t0 < 120:
-        time.sleep(0.01)
-    assert h_run.token_ids, "request never started decoding"
-    h_queued = eng.submit(_prompt(6, 81), max_new_tokens=4)
-    t0 = time.time()
-    eng.stop()
-    assert time.time() - t0 < 120
+    # pace each decode iteration through the step fault hook so the
+    # request is DETERMINISTICALLY still in flight when stop() lands (the
+    # tiny-model step is sub-ms; without pacing, 50 tokens can finish
+    # inside the submit->stop window and the test races)
+    faults.inject("serving.step_crash", seconds=0.01)
+    try:
+        h_run = eng.submit(_prompt(6, 80), max_new_tokens=50)
+        t0 = time.time()
+        while not h_run.token_ids and time.time() - t0 < 120:
+            time.sleep(0.01)
+        assert h_run.token_ids, "request never started decoding"
+        h_queued = eng.submit(_prompt(6, 81), max_new_tokens=4)
+        t0 = time.time()
+        eng.stop()
+        assert time.time() - t0 < 120
+    finally:
+        faults.clear()
     for h in (h_run, h_queued):
         assert h.done, "zero hung handles after stop()"
         assert h.status == "stopped"

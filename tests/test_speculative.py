@@ -1,0 +1,439 @@
+"""Speculative decoding (serving/speculative.py + engine speculative_k):
+n-gram drafting, multi-token paged verification, greedy byte-parity with
+the non-speculative engine and generate(), rejection-sampling acceptance,
+rollback after fully-rejected drafts, crash-requeue with accepted-token
+state, and the spec metrics/statusz surfaces.  All on the CPU backend
+with tiny GPTs."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.observability import faults
+from paddle_tpu.profiler import metrics as prof_metrics
+from paddle_tpu.serving import BlockManager, NgramDrafter, ServingEngine
+from paddle_tpu.serving.speculative import make_verifier
+from paddle_tpu.text.models.gpt import GPTForCausalLM
+
+PS = 8
+MAXLEN = 64
+
+
+def _tiny_gpt(train_steps=5, seed=0, max_pos=MAXLEN):
+    paddle.seed(seed)
+    m = GPTForCausalLM(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                       num_attention_heads=2, max_position_embeddings=max_pos)
+    if train_steps:
+        o = opt.AdamW(learning_rate=1e-2, parameters=m.parameters())
+        step = paddle.jit.TrainStep(m, o, loss_fn=None)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(1, 96, (8, 20)).astype("int64"))
+        for _ in range(train_steps):
+            step({"input_ids": ids, "labels": ids})
+    return m.eval()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_gpt()
+
+
+@pytest.fixture(scope="module")
+def cyclic_model():
+    """Tiny GPT overfit on a phase-shifted cyclic stream: greedy decode
+    CONTINUES the context's cycle, so n-gram drafts on cyclic prompts are
+    near-always right — the acceptance-rate contrast fixture."""
+    paddle.seed(1)
+    m = GPTForCausalLM(vocab_size=32, hidden_size=48, num_hidden_layers=2,
+                       num_attention_heads=2, max_position_embeddings=128)
+    period = 6
+    cyc = (np.arange(128 + 48) % period + 1).astype("int64")
+    o = opt.AdamW(learning_rate=5e-3, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, o, loss_fn=None)
+    ids = paddle.to_tensor(np.stack([cyc[i:i + 48] for i in range(6)]))
+    for _ in range(150):
+        step({"input_ids": ids, "labels": ids})
+    return m.eval(), cyc
+
+
+def _prompt(n, seed=1, vocab=96):
+    return np.random.RandomState(seed).randint(1, vocab, (n,)).tolist()
+
+
+def _ref_tokens(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray([prompt], "int64"))
+    out = model.generate(ids, max_new_tokens=n, temperature=0.0,
+                         cache_impl="paged", page_size=PS,
+                         max_len=len(prompt) + n)
+    return [int(t) for t in out.numpy()[0, len(prompt):]]
+
+
+# ============================================================== drafter
+def test_ngram_drafter_suffix_match():
+    d = NgramDrafter(k=4, max_ngram=3)
+    d.register(0, [1, 2, 3, 1, 2, 3, 1, 2])
+    # suffix [3,1,2] occurred earlier (at 2); continuation is [3,1,2]
+    assert d.propose(0) == [3, 1, 2]
+    d.extend(0, [3])                       # context now ...1,2,3
+    assert d.propose(0)[:3] == [1, 2, 3]   # cycle keeps matching
+    assert d.propose(0, max_tokens=2) == [1, 2]
+    assert d.propose(0, max_tokens=0) == []
+
+
+def test_ngram_drafter_no_match_and_repetition():
+    d = NgramDrafter(k=3)
+    d.register(0, [10, 20, 30, 40])        # no repeated n-gram
+    assert d.propose(0) == []
+    d.register(1, [5, 5, 5])               # overlap with the suffix is fine
+    assert d.propose(1) == [5]
+    d.release(0)
+    assert d.propose(0) == []              # released slot proposes nothing
+
+
+def test_ngram_drafter_most_recent_occurrence_wins():
+    # [7,1, 7,2, 7] — suffix [7] matched at its MOST RECENT earlier
+    # occurrence (position 2), so the draft starts with 2, not 1
+    d = NgramDrafter(k=2, max_ngram=2)
+    d.register(0, [7, 1, 7, 2, 7])
+    assert d.propose(0) == [2, 7]
+
+
+def test_ngram_drafter_validation():
+    with pytest.raises(ValueError):
+        NgramDrafter(k=0)
+    with pytest.raises(ValueError):
+        NgramDrafter(k=2, max_ngram=1, min_ngram=2)
+
+
+# ============================================================= verifier
+def test_verifier_greedy_exact_match():
+    verify = make_verifier()
+    V = 8
+    logits = np.full((1, 3, V), -5.0, np.float32)
+    logits[0, 0, 3] = 5.0   # argmax after last token: 3
+    logits[0, 1, 4] = 5.0   # after draft 3: 4
+    logits[0, 2, 6] = 5.0   # after draft 4 (wrong draft fed): 6
+    key = __import__("jax").random.key(0)
+    # drafts [3, 5]: first matches argmax, second does not
+    targets, accept = verify(np.asarray(logits),
+                             np.asarray([[3, 5]], np.int64),
+                             np.asarray([2], np.int32),
+                             np.asarray([0.0], np.float32), key)
+    assert list(np.asarray(accept)[0]) == [True, False]
+    assert list(np.asarray(targets)[0]) == [3, 4, 6]
+    # dlen=0: nothing accepted even if the junk draft equals the argmax
+    targets, accept = verify(np.asarray(logits),
+                             np.asarray([[3, 4]], np.int64),
+                             np.asarray([0], np.int32),
+                             np.asarray([0.0], np.float32), key)
+    assert not np.asarray(accept).any()
+
+
+def test_verifier_rejection_sampling_marginals():
+    """Temperature rows: draft d is accepted with probability ~p(d), and a
+    rejection never resamples d (the residual distribution zeroes it)."""
+    import jax
+
+    verify = make_verifier()
+    B, V = 2048, 4
+    # p ~ softmax([2,1,0,-1]) -> p(d=0) ~ 0.644
+    logits = np.tile(np.asarray([[2.0, 1.0, 0.0, -1.0]], np.float32),
+                     (B, 1))[:, None, :]          # [B, 1, V] -> K=0 ... K1=1
+    logits = np.concatenate([logits, logits], axis=1)  # [B, 2, V], K=1
+    drafts = np.zeros((B, 1), np.int64)           # draft token 0 everywhere
+    targets, accept = verify(logits, drafts,
+                             np.ones((B,), np.int32),
+                             np.ones((B,), np.float32),
+                             jax.random.key(7))
+    accept = np.asarray(accept)[:, 0]
+    targets = np.asarray(targets)
+    p0 = np.exp(2.0) / np.exp([2.0, 1.0, 0.0, -1.0]).sum()
+    assert abs(accept.mean() - p0) < 0.05
+    # resample on rejection: position 0's target is never the draft token
+    assert (targets[~accept, 0] != 0).all()
+    # bonus position (full distribution) still samples the draft sometimes
+    assert (targets[:, 1] == 0).any()
+
+
+# ======================================================== greedy parity
+def test_greedy_parity_with_and_without_repetition(model):
+    """Speculative greedy ids are byte-identical to generate() and to the
+    non-speculative engine — repetitive prompts (drafts fire constantly)
+    and random prompts (drafts rarely fire) alike."""
+    prompts = [[7, 8, 9] * 4,            # repetitive: n-gram hits
+               _prompt(3, 2), _prompt(8, 3), _prompt(16, 5)]
+    refs = [_ref_tokens(model, p, 12) for p in prompts]
+    with ServingEngine(model, num_slots=3, page_size=PS,
+                       max_model_len=MAXLEN) as eng:
+        base = [eng.submit(p, max_new_tokens=12).result(timeout=300)
+                for p in prompts]
+    assert base == refs
+    for k in (2, 4):
+        with ServingEngine(model, num_slots=3, page_size=PS,
+                           max_model_len=MAXLEN, speculative_k=k) as eng:
+            hs = [eng.submit(p, max_new_tokens=12) for p in prompts]
+            spec = [h.result(timeout=300) for h in hs]
+            st = eng.stats()["speculative"]
+        assert spec == refs, f"k={k}"
+        assert st["proposed"] > 0  # the drafter actually fired
+
+
+def test_greedy_parity_eos_mid_draft(model):
+    """EOS inside an accepted draft stops emission AT the eos token —
+    byte-identical early stop, later accepted tokens discarded."""
+    p = _prompt(6, 30)
+    ref = _ref_tokens(model, p, 12)
+    eos = next(t for i, t in enumerate(ref) if i > 0 and t not in ref[:i])
+    stop_at = ref.index(eos)
+    with ServingEngine(model, num_slots=1, page_size=PS,
+                       max_model_len=MAXLEN, speculative_k=4) as eng:
+        h = eng.submit(p, max_new_tokens=12, eos_token_id=eos)
+        toks = h.result(timeout=300)
+    assert toks == ref[:stop_at + 1] and toks[-1] == eos
+    assert h.status == "completed"
+    assert eng.block_manager.free_pages == eng.block_manager.num_pages
+
+
+def test_budget_respected_and_single_token_requests(model):
+    """Drafting never overshoots max_new_tokens (at most remaining-1
+    drafts — the bonus token always lands), and a 1-token request retires
+    at prefill without ever reaching a verify step."""
+    p = [3, 4, 5] * 5
+    ref = _ref_tokens(model, p, 7)
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN, speculative_k=4) as eng:
+        assert eng.submit(p, max_new_tokens=7).result(timeout=300) == ref
+        assert len(eng.submit(p, max_new_tokens=1).result(timeout=300)) == 1
+
+
+def test_greedy_parity_at_model_cap(model):
+    """Decode right up to max_model_len with drafts firing: the chunk
+    write's pad lanes reach past the page table near the cap and must be
+    DROPPED, not clamped onto the last real position (a clamp collides
+    with the chunk's own final write in one scatter — undefined winner —
+    and silently corrupts the last tokens)."""
+    p = [11, 12, 13] * 6  # repetitive: drafts fire all the way to the cap
+    n = MAXLEN - len(p)   # total == max_model_len exactly
+    ref = _ref_tokens(model, p, n)
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN, speculative_k=4) as eng:
+        toks = eng.submit(p, max_new_tokens=n).result(timeout=300)
+        st = eng.stats()["speculative"]
+    assert toks == ref
+    assert st["proposed"] > 0
+
+
+def test_mixed_greedy_and_temperature_rows(model):
+    """Greedy and temperature requests share one verify batch: the greedy
+    row stays byte-identical, sampled ids stay in-vocab."""
+    p = _prompt(6, 95)
+    ref = _ref_tokens(model, p, 8)
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN, seed=3, speculative_k=3) as eng:
+        hg = eng.submit(p, max_new_tokens=8, temperature=0.0)
+        ht = eng.submit([4, 5, 6] * 3, max_new_tokens=8, temperature=0.9)
+        assert hg.result(timeout=300) == ref
+        toks = ht.result(timeout=300)
+    assert len(toks) == 8 and all(0 <= t < 96 for t in toks)
+
+
+# ============================================================== rollback
+class _WrongDrafter(NgramDrafter):
+    """Adversarial drafter: proposes a fixed wrong token — every draft
+    must be rejected and the engine must still produce exact output."""
+
+    def __init__(self, k, tok):
+        super().__init__(k)
+        self._tok = int(tok)
+
+    def propose(self, sid, max_tokens=None):
+        cap = self.k if max_tokens is None else min(self.k, int(max_tokens))
+        return [self._tok] * max(cap, 0)
+
+
+def test_rollback_after_fully_rejected_drafts(model):
+    """A drafter that is ALWAYS wrong: acceptance 0, one token per step
+    (the k=0-equivalent floor), output byte-identical — rejected-tail K/V
+    in the pools is provably invisible after the lens rollback."""
+    p = _prompt(9, 33)
+    ref = _ref_tokens(model, p, 10)
+    # any token absent from the greedy stream is rejected at every step
+    bad = next(t for t in range(95, 0, -1) if t not in ref)
+    eng = ServingEngine(model, num_slots=1, page_size=PS,
+                        max_model_len=MAXLEN, speculative_k=3)
+    eng._drafter = _WrongDrafter(3, bad)
+    with eng:
+        toks = eng.submit(p, max_new_tokens=10).result(timeout=300)
+        st = eng.stats()["speculative"]
+    assert toks == ref
+    assert st["proposed"] > 0 and st["accepted"] == 0
+    assert st["acceptance_rate"] == 0.0
+
+
+# ======================================================= acceptance rate
+def test_acceptance_rate_repetitive_vs_random(cyclic_model):
+    """Metric sanity: a repetitive (cyclic) prompt on a model that learned
+    the cycle accepts nearly all drafts; a random prompt accepts far
+    fewer.  Greedy output stays byte-identical in both regimes."""
+    m, cyc = cyclic_model
+    rates = {}
+    for name, p in (("rep", [int(t) for t in cyc[:24]]),
+                    ("rand", _prompt(24, 17, vocab=32))):
+        ref = _ref_tokens(m, p, 20)
+        with ServingEngine(m, num_slots=1, page_size=PS, max_model_len=128,
+                           speculative_k=4) as eng:
+            assert eng.submit(p, max_new_tokens=20).result(timeout=300) == ref
+            rates[name] = eng.acceptance_rate
+    assert rates["rep"] is not None and rates["rep"] > 0.6, rates
+    assert rates["rand"] is None or rates["rand"] < rates["rep"], rates
+
+
+# ================================================================= chaos
+@pytest.mark.chaos
+def test_step_crash_during_verify_requeues_accepted_state(model):
+    """A serving.step_crash during a VERIFY step re-queues in-flight
+    requests with exactly the accepted-token state: the engine restarts,
+    re-admits prompt + tokens-so-far, and the final greedy ids are the
+    uninterrupted ones."""
+    from paddle_tpu.resilience.retry import TransientError
+
+    p1, p2 = [2, 3, 4] * 4, _prompt(9, 71)
+    ref1, ref2 = _ref_tokens(model, p1, 12), _ref_tokens(model, p2, 10)
+    requeued0 = prof_metrics.counter("serving.requests_requeued").total()
+
+    def boom():
+        raise TransientError("injected verify crash")
+
+    eng = ServingEngine(model, num_slots=2, page_size=PS,
+                        max_model_len=MAXLEN, speculative_k=4)
+    with eng:
+        eng.generate(_prompt(4, 72), max_new_tokens=2, timeout=300)  # warm
+        faults.inject("serving.step_crash", fn=boom, at_trips={3})
+        try:
+            h1 = eng.submit(p1, max_new_tokens=12)
+            h2 = eng.submit(p2, max_new_tokens=10)
+            toks1 = h1.result(timeout=300)
+            toks2 = h2.result(timeout=300)
+        finally:
+            faults.clear()
+        assert toks1 == ref1 and toks2 == ref2
+        assert h1.status == h2.status == "completed"
+        assert eng._engine_restarts == 1
+    assert prof_metrics.counter("serving.requests_requeued").total() \
+        >= requeued0 + 1
+
+
+# ======================================================= metrics/statusz
+def test_spec_metrics_and_statusz(model):
+    """serving.spec_proposed / spec_accepted counters, the
+    serving.acceptance_rate gauge, the verify-step one-trace invariant,
+    and the speculative block on /statusz."""
+    m = _tiny_gpt(train_steps=5, seed=13)  # fresh model = fresh programs
+    prop0 = prof_metrics.counter("serving.spec_proposed").total()
+    acc0 = prof_metrics.counter("serving.spec_accepted").total()
+    vt0 = prof_metrics.counter("serving.verify_traces").total()
+    with ServingEngine(m, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN, speculative_k=3) as eng:
+        hs = [eng.submit([6, 7, 8] * 4, max_new_tokens=10),
+              eng.submit(_prompt(5, 44), max_new_tokens=8,
+                         temperature=0.7)]
+        for h in hs:
+            h.result(timeout=300)
+        st = eng._statusz()
+    assert prof_metrics.counter("serving.spec_proposed").total() > prop0
+    assert prof_metrics.counter("serving.spec_accepted").total() >= acc0
+    # ONE verify trace for the whole mixed (greedy+temp) workload
+    assert prof_metrics.counter("serving.verify_traces").total() == vt0 + 1
+    spec = st["speculative"]
+    assert spec["k"] == 3 and spec["proposed"] > 0
+    assert spec["acceptance_rate"] == eng.acceptance_rate
+    reg = prof_metrics.get_registry()
+    assert reg.get("serving.acceptance_rate") is not None
+
+
+# ==================================================== prefill bucketing
+def test_prefill_bucketing_plateaus(model):
+    """Long prompts (above _PREFILL_POW2_PAGES pages) bucket to
+    power-of-two page counts: one compiled prefill program serves the
+    whole 5..8-page range instead of four."""
+    m = _tiny_gpt(train_steps=0, seed=21)  # fresh program store
+    t0 = prof_metrics.counter("serving.prefill_traces").total()
+    with ServingEngine(m, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN) as eng:
+        refs = {}
+        for n in (34, 42, 50, 58):  # 5..8 pages -> one 8-page bucket
+            p = _prompt(n, 200 + n)
+            refs[n] = (eng.submit(p, max_new_tokens=2).result(timeout=300),
+                       _ref_tokens(m, p, 2))
+    assert prof_metrics.counter("serving.prefill_traces").total() == t0 + 1
+    for n, (got, ref) in refs.items():  # padding must not change the math
+        assert got == ref, n
+    # short prompts keep their per-page-count buckets (latency-optimal)
+    t1 = prof_metrics.counter("serving.prefill_traces").total()
+    with ServingEngine(m, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN) as eng:
+        eng.generate(_prompt(3, 300), max_new_tokens=2, timeout=300)
+        eng.generate(_prompt(12, 301), max_new_tokens=2, timeout=300)
+    assert prof_metrics.counter("serving.prefill_traces").total() == t1 + 2
+
+
+# ================================================= prefix-cache counters
+def test_prefix_cache_counters():
+    """serving.prefix_cache_{hits,misses,evictions} from the
+    prefix-sharing path: fresh registrations count misses, refcount bumps
+    and idle resurrections count hits, LRU reclaim counts evictions."""
+    c_hits = prof_metrics.counter("serving.prefix_cache_hits")
+    c_miss = prof_metrics.counter("serving.prefix_cache_misses")
+    c_evic = prof_metrics.counter("serving.prefix_cache_evictions")
+    h0, m0, e0 = c_hits.total(), c_miss.total(), c_evic.total()
+    bm = BlockManager(num_pages=8, page_size=4, prefix_sharing=True)
+    prompt = list(range(100, 110))        # 2 full pages sharable
+    a = bm.allocate(prompt, 14)           # fresh: 2 misses
+    assert (c_miss.total(), c_hits.total()) == (m0 + 2, h0)
+    b = bm.allocate(prompt, 14)           # live sharing: 2 hits
+    assert (c_hits.total(), c_miss.total()) == (h0 + 2, m0 + 2)
+    bm.free(a), bm.free(b)                # both prefix pages park idle
+    c = bm.allocate(prompt, 14)           # idle resurrection: 2 more hits
+    assert c_hits.total() == h0 + 4
+    bm.free(c)
+    bm.allocate(list(range(40, 72)), 32)  # needs all 8 pages: evicts idle
+    assert c_evic.total() == e0 + 2
+    assert c_miss.total() == m0 + 2 + 8   # the big prompt's 8 fresh pages
+
+
+# ============================================================ spec sweep
+@pytest.mark.spec
+@pytest.mark.slow
+def test_spec_parity_sweep(cyclic_model):
+    """Heavier sweep (spec marker, outside tier-1): byte-parity at every
+    k in 1..6 on repetitive AND random prompts, long decodes, plus
+    monotone sanity on the acceptance counters."""
+    m, cyc = cyclic_model
+    prompts = [[int(t) for t in cyc[:30]], _prompt(30, 55, vocab=32),
+               [int(t) for t in cyc[3:27]]]
+    refs = [_ref_tokens(m, p, 40) for p in prompts]
+    for k in range(1, 7):
+        with ServingEngine(m, num_slots=3, page_size=PS, max_model_len=128,
+                           speculative_k=k) as eng:
+            hs = [eng.submit(p, max_new_tokens=40) for p in prompts]
+            outs = [h.result(timeout=600) for h in hs]
+            st = eng.stats()["speculative"]
+        assert outs == refs, f"k={k}"
+        assert st["accepted"] <= st["proposed"]
+
+
+@pytest.mark.spec
+@pytest.mark.slow
+def test_bench_speculative_speedup():
+    """Acceptance: bench's speculative arm beats the non-speculative
+    engine by >= 1.3x decode tokens/sec on the repetitive workload, with
+    byte-identical greedy ids and a reported acceptance rate."""
+    import bench
+
+    base = bench._measure_serving_speculative(spec_k=0, train_steps=120)
+    spec = bench._measure_serving_speculative(spec_k=4, train_steps=120)
+    assert spec["ids"] == base["ids"]
+    assert spec["acceptance_rate"] is not None
+    assert spec["tokens_per_sec"] >= 1.3 * base["tokens_per_sec"], (
+        base["tokens_per_sec"], spec["tokens_per_sec"])
