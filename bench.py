@@ -130,12 +130,13 @@ def _measure_decode(cache_impl, B=8, S0=32, lo=64, hi=320):
     return B * (hi - lo) / max(t_hi - t_lo, 1e-9)
 
 
-def _metric_quantile(name, q):
-    """Reservoir quantile of a registry histogram (None when empty)."""
+def _metric_quantile(name, q, **labels):
+    """Reservoir quantile of a registry histogram child (None when empty).
+    Serving series carry replica= labels (default replica "0")."""
     from paddle_tpu.profiler import metrics as _metrics
 
     h = _metrics.get_registry().get(name)
-    c = h.labels() if h is not None else None
+    c = h.labels(**labels) if h is not None else None
     return (c.quantile(q) if c is not None and c.count else None)
 
 
@@ -190,7 +191,7 @@ def _measure_serving(n_requests=8, num_slots=4, S0=32, page_size=32,
                         timeout=600)  # compile prefill+step
         # snapshot AFTER warm-up: the warm request's TTFT is the compile
         # time (tens of seconds) and would dominate the reported mean
-        ttft_h = reg.get("serving.ttft_seconds").labels()
+        ttft_h = reg.get("serving.ttft_seconds").labels(replica="0")
         ttft_sum0, ttft_n0 = ttft_h.sum, ttft_h.count
         t0 = time.time()
         handles = [engine.submit(p, max_new_tokens=n)
@@ -212,8 +213,10 @@ def _measure_serving(n_requests=8, num_slots=4, S0=32, page_size=32,
         "ttft_mean_s": round(ttft_mean, 4) if ttft_mean is not None else None,
         # reservoir quantiles: the handful of warm-up ITL samples are noise
         # against the measured phase's hundreds
-        "itl_p50_s": _metric_quantile("serving.inter_token_seconds", 0.5),
-        "itl_p95_s": _metric_quantile("serving.inter_token_seconds", 0.95),
+        "itl_p50_s": _metric_quantile("serving.inter_token_seconds", 0.5,
+                                      replica="0"),
+        "itl_p95_s": _metric_quantile("serving.inter_token_seconds", 0.95,
+                                      replica="0"),
         "step_traces": step_traces,
         "note": ("continuous batching over the paged KV pool; sequential "
                  "baseline reuses ONE compiled generate() program pair "
@@ -280,11 +283,168 @@ def _measure_serving_speculative(spec_k=0, n_requests=8, num_slots=4, S0=32,
         "spec_k": spec_k,
         "tokens": total,
         "tokens_per_sec": round(total / dt, 2),
-        "itl_p50_s": _metric_quantile("serving.inter_token_seconds", 0.5),
-        "itl_p95_s": _metric_quantile("serving.inter_token_seconds", 0.95),
+        "itl_p50_s": _metric_quantile("serving.inter_token_seconds", 0.5,
+                                      replica="0"),
+        "itl_p95_s": _metric_quantile("serving.inter_token_seconds", 0.95,
+                                      replica="0"),
         "acceptance_rate": round(rate, 4) if rate is not None else None,
         "ids": ids,
     }
+
+
+def _measure_serving_cluster(replicas=1, policy="affinity", n_requests=16,
+                             num_slots=4, S0=48, page_size=16, max_new=64,
+                             prefix_groups=4, model_kwargs=None,
+                             workload_replicas=None):
+    """ONE arm of the cluster comparison (replicas=1 is the single-replica
+    baseline): aggregate tokens/sec over mixed-prefix traffic through the
+    ServingCluster front door, per-replica ITL p50/p95, the router's
+    affinity hit rate, per-replica prefix-cache hits, and the full greedy
+    ids so the parent can assert byte-identity across arms.  Each arm runs
+    in its own subprocess (fresh registry, fresh device state); the parent
+    sets XLA_FLAGS host-device-count so ``devices="auto"`` places one
+    replica per host device."""
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics as _metrics
+    from paddle_tpu.serving import ServingCluster
+    from paddle_tpu.text.models import GPTForCausalLM
+
+    paddle.seed(0)
+    kw = dict(vocab_size=512, hidden_size=256, num_hidden_layers=4,
+              num_attention_heads=4, max_position_embeddings=S0 + max_new)
+    kw.update(model_kwargs or {})
+    m = GPTForCausalLM(**kw).eval()
+    rs = np.random.RandomState(0)
+    # mixed-prefix traffic: prefix_groups shared prefixes of two full
+    # pages each (the BlockManager's sharing granularity), fresh tails —
+    # the workload prefix-affinity routing exists for.  Group heads are
+    # re-rolled until their affine replicas round-robin over the fleet
+    # (deterministic — the rendezvous hash is stable), so a fleet arm
+    # exercises EVERY replica instead of whichever subset 4 random
+    # prefixes happen to hash to.  workload_replicas pins the PROBE fleet
+    # size so every arm — including the single-replica baseline — gets
+    # byte-identical prompts.
+    from paddle_tpu.serving import PrefixAffinityRouter
+
+    fleet = int(workload_replicas or replicas)
+    probe = PrefixAffinityRouter(fleet, affinity_tokens=2 * page_size)
+    shared = []
+    while len(shared) < prefix_groups:
+        cand = rs.randint(1, 500, (2 * page_size,))
+        if probe.affine_index(cand) == len(shared) % fleet:
+            shared.append(cand)
+    tail_len = S0 - 2 * page_size
+    assert tail_len > 0, "prompts need a fresh tail beyond the shared prefix"
+    prompts = []
+    for i in range(n_requests):
+        tail = rs.randint(1, 500, (tail_len,))
+        prompts.append(np.concatenate(
+            [shared[i % prefix_groups], tail]).astype("int64"))
+    max_len = S0 + max_new
+
+    # saturation_queue=n_requests: the bench fires the whole workload at
+    # once, so the queue-depth fallback would otherwise scatter prefix
+    # groups (that path is covered by tests/test_cluster.py) — here the
+    # AFFINITY win is what's being measured
+    cluster = ServingCluster(
+        m, replicas=replicas, policy=policy,
+        devices="auto" if replicas > 1 else None,
+        num_slots=num_slots, page_size=page_size, max_model_len=max_len,
+        prefix_sharing=True, saturation_queue=n_requests)
+    with cluster:
+        warm = rs.randint(1, 500, (S0,)).astype("int64")
+        for e in cluster.engines:      # compile each replica's programs
+            e.generate(warm, max_new_tokens=4, timeout=900)
+        t0 = time.time()
+        handles = [cluster.submit(p, max_new_tokens=max_new)
+                   for p in prompts]
+        ids = [h.result(timeout=900) for h in handles]
+        dt = time.time() - t0
+        hit_rate = cluster.affinity_hit_rate()
+        hits_c = _metrics.get_registry().get("serving.prefix_cache_hits")
+        per_replica = {}
+        for e in cluster.engines:
+            per_replica[e.replica] = {
+                "itl_p50_s": _metric_quantile(
+                    "serving.inter_token_seconds", 0.5, replica=e.replica),
+                "itl_p95_s": _metric_quantile(
+                    "serving.inter_token_seconds", 0.95, replica=e.replica),
+                "prefix_cache_hits": (hits_c.get(replica=e.replica) or 0)
+                if hits_c is not None else 0,
+                "requests": len([h for h in handles
+                                 if h.replica_history
+                                 and h.replica_history[0] == e.replica]),
+            }
+
+    total = n_requests * max_new
+    return {
+        "replicas": replicas,
+        "policy": policy,
+        "n_requests": n_requests,
+        "tokens": total,
+        "tokens_per_sec": round(total / dt, 2),
+        "affinity_hit_rate": round(hit_rate, 4) if hit_rate is not None
+        else None,
+        "prefix_cache_hits": sum(r["prefix_cache_hits"]
+                                 for r in per_replica.values()),
+        "per_replica": per_replica,
+        "ids": [list(map(int, r)) for r in ids],
+    }
+
+
+def _serving_cluster_report(replicas):
+    """Three arms (separate subprocesses via _section): single replica,
+    N replicas with random routing (control), N replicas with
+    prefix-affinity routing — plus the ISSUE-6 acceptance checks:
+    aggregate speedup, affinity hit rate above the random control, and
+    greedy output byte-identical per request across every arm."""
+    import os
+
+    # one host device per replica so dp placement is real even on CPU
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = (flags + " --xla_force_host_platform_device_count="
+             f"{int(replicas)}").strip()
+    single = _section("serving_cluster", BENCH_REPLICAS="1",
+                      BENCH_ROUTE_POLICY="affinity", XLA_FLAGS=flags,
+                      BENCH_FLEET=str(replicas))
+    random_arm = _section("serving_cluster", BENCH_REPLICAS=str(replicas),
+                          BENCH_ROUTE_POLICY="random", XLA_FLAGS=flags,
+                          BENCH_FLEET=str(replicas))
+    affinity = _section("serving_cluster", BENCH_REPLICAS=str(replicas),
+                        BENCH_ROUTE_POLICY="affinity", XLA_FLAGS=flags,
+                        BENCH_FLEET=str(replicas))
+    ident = [a == b == c for a, b, c in
+             zip(single["ids"], random_arm["ids"], affinity["ids"])]
+    out = {
+        "replicas": int(replicas),
+        # the parallel substrate under the fleet: with one replica per
+        # device the aggregate should approach host_cores x single-replica
+        # throughput; on a 1-core host the arms SERIALIZE and the ratio
+        # measures pure cluster overhead instead of scaling
+        "host_cores": len(os.sched_getaffinity(0))
+        if hasattr(os, "sched_getaffinity") else (os.cpu_count() or 1),
+        "tokens": affinity["tokens"],
+        "single_replica_tokens_per_sec": single["tokens_per_sec"],
+        "random_routing_tokens_per_sec": random_arm["tokens_per_sec"],
+        "cluster_tokens_per_sec": affinity["tokens_per_sec"],
+        "aggregate_speedup": round(
+            affinity["tokens_per_sec"]
+            / max(single["tokens_per_sec"], 1e-9), 3),
+        "affinity_hit_rate": affinity["affinity_hit_rate"],
+        "random_hit_rate": random_arm["affinity_hit_rate"],
+        "affinity_prefix_cache_hits": affinity["prefix_cache_hits"],
+        "random_prefix_cache_hits": random_arm["prefix_cache_hits"],
+        "greedy_identical_per_request": ident,
+        "greedy_identical": all(ident),
+        "per_replica": affinity["per_replica"],
+        "note": ("ServingCluster (prefix-affinity router) vs one replica "
+                 "and vs seeded-random routing on mixed-prefix traffic; "
+                 "greedy_identical asserts byte-equal output across all "
+                 "three arms, per request"),
+    }
+    return out
 
 
 def _serving_speculative_report(k, **kwargs):
@@ -443,6 +603,14 @@ def _run_section(name):
 
         return _measure_serving_speculative(
             spec_k=int(os.environ.get("BENCH_SPEC_K", "0")))
+    if name == "serving_cluster":
+        import os
+
+        return _measure_serving_cluster(
+            replicas=int(os.environ.get("BENCH_REPLICAS", "1")),
+            policy=os.environ.get("BENCH_ROUTE_POLICY", "affinity"),
+            workload_replicas=int(os.environ.get("BENCH_FLEET", "0"))
+            or None)
     if name == "tracing_overhead":
         return _measure_tracing_overhead()
     if name == "chaos_smoke":
@@ -525,7 +693,12 @@ def main():
         # serving micro-benchmark only (own process = fresh device state,
         # same hygiene as the per-section subprocesses of the full run)
         spec_k = _spec_k_from_argv()
-        if spec_k:
+        n_replicas = _replicas_from_argv()
+        if n_replicas:
+            # --replicas N: the multi-replica cluster (prefix-affinity
+            # router) vs a single replica and vs random routing
+            out = {"serving_cluster": _serving_cluster_report(n_replicas)}
+        elif spec_k:
             # --speculative k: n-gram-draft + multi-token-verify engine vs
             # the non-speculative engine on a repetitive-suffix workload
             out = {"serving_speculative": _serving_speculative_report(spec_k)}
@@ -644,6 +817,15 @@ def main():
         if path is None:
             print("--emit-metrics: no --metrics-dir/PADDLE_METRICS_DIR set; "
                   "nothing written", file=sys.stderr)
+
+
+def _replicas_from_argv():
+    for i, a in enumerate(sys.argv):
+        if a == "--replicas" and i + 1 < len(sys.argv):
+            return int(sys.argv[i + 1])
+        if a.startswith("--replicas="):
+            return int(a.split("=", 1)[1])
+    return None
 
 
 def _spec_k_from_argv():

@@ -75,8 +75,8 @@ def main():
 
         # --- metrics: the same registry the trainers/bench export ------
         reg = prof_metrics.get_registry()
-        ttft = reg.get("serving.ttft_seconds").labels()
-        itl = reg.get("serving.inter_token_seconds").labels()
+        ttft = reg.get("serving.ttft_seconds").labels(replica="0")
+        itl = reg.get("serving.inter_token_seconds").labels(replica="0")
         print(f"TTFT mean {ttft.mean * 1e3:.1f} ms | "
               f"inter-token p50 {itl.quantile(0.5) * 1e3:.2f} ms "
               f"p95 {itl.quantile(0.95) * 1e3:.2f} ms | "

@@ -13,6 +13,7 @@ pjit/shard_map distribution without a separate "functional model" rewrite.
 from __future__ import annotations
 
 import contextlib
+import threading
 from collections import OrderedDict
 
 import jax.numpy as jnp
@@ -295,20 +296,33 @@ class Layer:
     # ------------------------------------- functional bridge (TPU-native)
     def raw_state(self, trainable_only=False):
         """Pytree of jax arrays: {name: value} for params (and buffers)."""
-        params = OrderedDict((k, p._value) for k, p in self.named_parameters()
-                             if not trainable_only or not p.stop_gradient)
-        buffers = OrderedDict((k, b._value) for k, b in self.named_buffers())
+        with self.bind_lock():
+            params = OrderedDict(
+                (k, p._value) for k, p in self.named_parameters()
+                if not trainable_only or not p.stop_gradient)
+            buffers = OrderedDict(
+                (k, b._value) for k, b in self.named_buffers())
         return params, buffers
 
-    @contextlib.contextmanager
-    def bind(self, params=None, buffers=None):
-        """Temporarily swap jax arrays into parameters/buffers.
+    def bind_lock(self):
+        """Per-layer reentrant lock serializing :meth:`bind` windows (and
+        parameter snapshots) across threads.  bind() swaps ``_value`` on
+        the SHARED parameter tensors, so with N serving replicas (or a
+        replica plus a concurrent ``generate()``) over one model, an
+        unsynchronized reader inside another thread's trace-time bind
+        window would snapshot that trace's jit TRACERS instead of arrays
+        and leak them into its own program."""
+        lock = self.__dict__.get("_bind_lock")
+        if lock is None:
+            # dict.setdefault is atomic under the GIL: both racers get ONE
+            # lock.  Direct __dict__ access skips Layer.__setattr__'s
+            # parameter bookkeeping (same trick as the decode program
+            # store).
+            lock = self.__dict__.setdefault("_bind_lock", threading.RLock())
+        return lock
 
-        Inside the context the layer computes with the given arrays (which
-        may be jit tracers or sharded arrays); on exit originals are
-        restored.  Buffer mutations during forward (e.g. BN running stats)
-        are captured in ``captured_buffers`` before restore.
-        """
+    @contextlib.contextmanager
+    def _bind_impl(self, params=None, buffers=None):
         named_p = dict(self.named_parameters())
         named_b = dict(self.named_buffers())
         saved_p = {k: t._value for k, t in named_p.items()}
@@ -330,6 +344,22 @@ class Layer:
                 t._grad_node, t.stop_gradient = saved_nodes[k]
             for k, t in named_b.items():
                 t._value = saved_b[k]
+
+    @contextlib.contextmanager
+    def bind(self, params=None, buffers=None):
+        """Temporarily swap jax arrays into parameters/buffers.
+
+        Inside the context the layer computes with the given arrays (which
+        may be jit tracers or sharded arrays); on exit originals are
+        restored.  Buffer mutations during forward (e.g. BN running stats)
+        are captured in ``captured_buffers`` before restore.  The whole
+        window holds :meth:`bind_lock` so concurrent binds / snapshots on
+        a shared model (multi-replica serving) serialize instead of
+        reading each other's trace-time tracers; the lock spans only the
+        python-side trace, never an XLA compile.
+        """
+        with self.bind_lock(), self._bind_impl(params, buffers):
+            yield self
 
     # -------------------------------------------------------------- misc
     def full_name(self):

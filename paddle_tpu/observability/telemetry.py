@@ -39,6 +39,9 @@ _PROVIDERS: dict[str, object] = {}
 # "reasons": [...]}.  /healthz aggregates the WORST component state so a
 # load balancer sees one answer (and a 503 once anything is draining).
 _HEALTH_PROVIDERS: dict[str, object] = {}
+# components reported on /healthz but excluded from the worst-state fold
+# (e.g. individual cluster replicas — the cluster component gates instead)
+_HEALTH_NON_GATING: set[str] = set()
 _HEALTH_ORDER = {"ok": 0, "healthy": 0, "degraded": 1, "stopped": 2,
                  "draining": 2, "error": 3}
 
@@ -52,15 +55,36 @@ def remove_status_provider(name):
     _PROVIDERS.pop(name, None)
 
 
-def add_health_provider(name, fn):
+def add_health_provider(name, fn, gating=True):
     """Register ``fn() -> {"state": ..., "reasons": [...]}`` folded into
     ``/healthz`` (worst state wins; draining/error answer 503 so load
-    balancers stop routing here)."""
+    balancers stop routing here).
+
+    ``gating=False`` components are still reported in the /healthz body
+    but excluded from the worst-state fold: a cluster's replicas register
+    non-gating and the cluster's OWN any-replica-routable component gates
+    instead — one dead replica of N must not 503 the whole process."""
     _HEALTH_PROVIDERS[name] = fn
+    if gating:
+        _HEALTH_NON_GATING.discard(name)
+    else:
+        _HEALTH_NON_GATING.add(name)
 
 
 def remove_health_provider(name):
     _HEALTH_PROVIDERS.pop(name, None)
+    _HEALTH_NON_GATING.discard(name)
+
+
+def remove_providers_if_owner(name, status_fn=None, health_fn=None):
+    """Unregister ``name``'s status/health providers only while they are
+    still the given functions: registration is keyed, so a newer engine or
+    cluster may own the key by now and its providers must survive an older
+    owner's stop()."""
+    if status_fn is not None and _PROVIDERS.get(name) is status_fn:
+        remove_status_provider(name)
+    if health_fn is not None and _HEALTH_PROVIDERS.get(name) is health_fn:
+        remove_health_provider(name)
 
 
 class TelemetryServer:
@@ -171,6 +195,9 @@ class TelemetryServer:
             if not isinstance(st, dict):
                 st = {"state": str(st), "reasons": []}
             components[name] = st
+            if name in _HEALTH_NON_GATING:
+                st["gating"] = False
+                continue
             s = str(st.get("state", "ok"))
             if _HEALTH_ORDER.get(s, 1) > _HEALTH_ORDER.get(worst, 0):
                 worst = s

@@ -334,6 +334,50 @@ def load_jsonl(path):
     return rows
 
 
+class BoundLabels:
+    """A metric view with constant labels pre-merged into every call.
+
+    The registry is process-wide, so N instances of one subsystem in one
+    process (e.g. N ``ServingEngine`` replicas) would otherwise stamp the
+    SAME ``serving.*`` series.  ``bind(metric, replica="3")`` gives each
+    instance a handle whose ``inc``/``observe``/``set``/``get`` forward
+    with the bound labels merged under any per-call labels (``inc(
+    status="ok")`` lands on the ``{replica="3", status="ok"}`` child)."""
+
+    __slots__ = ("_metric", "_labels")
+
+    def __init__(self, metric, **labels):
+        self._metric = metric
+        self._labels = {str(k): str(v) for k, v in labels.items()}
+
+    def _merged(self, labels):
+        return {**self._labels, **labels} if labels else self._labels
+
+    def inc(self, amount=1.0, **labels):
+        self._metric.inc(amount, **self._merged(labels))
+
+    def dec(self, amount=1.0, **labels):
+        self._metric.labels(**self._merged(labels)).dec(amount)
+
+    def set(self, value, **labels):
+        self._metric.set(value, **self._merged(labels))
+
+    def observe(self, value, **labels):
+        self._metric.observe(value, **self._merged(labels))
+
+    def get(self, **labels):
+        return self._metric.get(**self._merged(labels))
+
+    @property
+    def metric(self):
+        return self._metric
+
+
+def bind(metric, **labels):
+    """See :class:`BoundLabels`."""
+    return BoundLabels(metric, **labels)
+
+
 # ----------------------------------------------------------- default registry
 _REGISTRY = MetricsRegistry()
 _FLUSHER = None

@@ -13,6 +13,11 @@ cache (ROADMAP north star: "serves heavy traffic from millions of users").
 - :mod:`.speculative` — :class:`NgramDrafter` (prompt-lookup drafts) +
   :func:`make_verifier` (multi-token acceptance / rejection sampling) for
   ``ServingEngine(speculative_k=k)`` draft-and-verify decoding.
+- :mod:`.cluster` — multi-replica serving: :class:`ReplicaPool` (N engines
+  over one model), :class:`PrefixAffinityRouter` (rendezvous prefix
+  routing with health-aware least-loaded fallback) and
+  :class:`ServingCluster` (the routed facade with cross-replica in-flight
+  requeue; README "Cluster serving").
 
 Metrics (PR-1 registry, README "Serving"): ``serving.*`` histograms /
 gauges / counters — TTFT, inter-token latency, queue depth, slot
@@ -28,10 +33,15 @@ from .engine import (  # noqa: F401
     SamplingParams, ServingEngine,
 )
 from .speculative import NgramDrafter, make_verifier  # noqa: F401
+from .cluster import (  # noqa: F401
+    ClusterHandle, PrefixAffinityRouter, ReplicaPool, RouteDecision,
+    ServingCluster,
+)
 
 __all__ = [
     "ServingEngine", "Request", "RequestHandle", "RequestRejectedError",
     "EngineStoppedError", "SamplingParams", "BlockManager", "PageAllocation",
     "GPTAdapter", "ContinuousBatchingPredictor", "NgramDrafter",
-    "make_verifier",
+    "make_verifier", "ServingCluster", "ClusterHandle", "ReplicaPool",
+    "PrefixAffinityRouter", "RouteDecision",
 ]

@@ -46,8 +46,11 @@ class GPTAdapter:
         self.page_size = int(page_size)
 
     def params_and_buffers(self):
-        params = {k: p._value for k, p in self.model.named_parameters()}
-        bufs = {k: b._value for k, b in self.model.named_buffers()}
+        # under the bind lock: another replica of this model may be inside
+        # a trace-time bind() on its scheduler thread right now
+        with self.model.bind_lock():
+            params = {k: p._value for k, p in self.model.named_parameters()}
+            bufs = {k: b._value for k, b in self.model.named_buffers()}
         return params, bufs
 
     def init_pools(self, num_pages):

@@ -50,7 +50,8 @@ class PageAllocation:
 
 
 class BlockManager:
-    def __init__(self, num_pages, page_size, prefix_sharing=False):
+    def __init__(self, num_pages, page_size, prefix_sharing=False,
+                 replica="0"):
         if num_pages < 1:
             raise ValueError(f"num_pages must be >= 1, got {num_pages}")
         if page_size < 1:
@@ -58,24 +59,29 @@ class BlockManager:
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.prefix_sharing = bool(prefix_sharing)
+        self.replica = str(replica)
         self._free = collections.deque(range(self.num_pages))
         self._active = {}                       # prefix key -> [page, refs]
         self._idle = collections.OrderedDict()  # prefix key -> page (refs 0)
         # prefix-cache observability: hits = sharable pages whose key was
         # resident (active refcount bump or idle resurrection), misses =
         # sharable pages allocated fresh, evictions = idle prefix pages
-        # reclaimed because the free list ran dry
+        # reclaimed because the free list ran dry.  Series carry replica=
+        # (the engine's id) so N engines in one process stay distinct.
         from ..profiler import metrics as _metrics
 
-        self._m_hits = _metrics.counter(
+        self._m_hits = _metrics.bind(_metrics.counter(
             "serving.prefix_cache_hits",
-            "prefix-sharing pages reused from the active/idle cache")
-        self._m_misses = _metrics.counter(
+            "prefix-sharing pages reused from the active/idle cache"),
+            replica=self.replica)
+        self._m_misses = _metrics.bind(_metrics.counter(
             "serving.prefix_cache_misses",
-            "sharable prefix pages that had to be allocated fresh")
-        self._m_evictions = _metrics.counter(
+            "sharable prefix pages that had to be allocated fresh"),
+            replica=self.replica)
+        self._m_evictions = _metrics.bind(_metrics.counter(
             "serving.prefix_cache_evictions",
-            "idle prefix pages evicted LRU to refill the free list")
+            "idle prefix pages evicted LRU to refill the free list"),
+            replica=self.replica)
 
     # ------------------------------------------------------------ accounting
     def pages_for(self, num_tokens):

@@ -1,0 +1,30 @@
+"""paddle_tpu.serving.cluster — multi-replica serving with a
+prefix-affinity router ("Fleet for inference", README "Cluster serving").
+
+- :mod:`.pool` — :class:`ReplicaPool`: N :class:`ServingEngine` replicas
+  over one shared model (dp for inference), each with its own scheduler /
+  BlockManager / page pools / ``replica=`` metric label, optionally placed
+  one-per-device from ``jax.devices()``.
+- :mod:`.router` — :class:`PrefixAffinityRouter`: rendezvous-hash mapping
+  from prompt prefixes to replicas so BlockManager prefix sharing keeps
+  paying off under fan-out; health-aware, with least-loaded fallback when
+  the affine replica is saturated, plus random / round-robin / least-loaded
+  control policies.
+- :mod:`.service` — :class:`ServingCluster`: the routed, resilient facade —
+  submit/generate/stream across the pool, cross-replica in-flight requeue
+  when a replica is lost (greedy ids byte-identical to an uninterrupted
+  run), cluster-level /statusz section, /healthz component and ``cluster.*``
+  metrics.
+"""
+
+from .pool import ReplicaPool  # noqa: F401
+from .router import (  # noqa: F401
+    POLICIES, ROUTABLE_STATES, PrefixAffinityRouter, RouteDecision,
+    prefix_key,
+)
+from .service import ClusterHandle, ServingCluster  # noqa: F401
+
+__all__ = [
+    "ReplicaPool", "PrefixAffinityRouter", "RouteDecision", "prefix_key",
+    "POLICIES", "ROUTABLE_STATES", "ServingCluster", "ClusterHandle",
+]

@@ -1,0 +1,112 @@
+"""ReplicaPool — N :class:`~paddle_tpu.serving.engine.ServingEngine`
+replicas over one model (dp for inference).
+
+Each replica is a full engine: its own scheduler thread, its own
+:class:`~paddle_tpu.serving.block_manager.BlockManager` and page pools,
+its own ``replica=`` metric label and keyed ``/statusz`` provider.  The
+model (and its compiled-program store) is SHARED — the engines key the
+``program_store`` by (phase, batch-shape, sampler), so N same-shaped
+replicas reuse one traced prefill/step/verify family instead of minting N.
+
+Device placement is configured from ``jax.devices()`` with an explicit
+dp-replica count: ``devices="auto"`` round-robins replicas over the
+visible devices and commits each replica's params/buffers/pools to its
+device (the engine's uncommitted per-step host arrays follow); the default
+``devices=None`` leaves placement to jax (all replicas on the default
+device — the single-host dryrun shape, where replicas still overlap
+host-side scheduling with device dispatch).  A mesh-sliced mp replica
+(sharded engine) is future work; the seam is ``engine_kwargs["device"]``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class ReplicaPool:
+    """Build and own N serving-engine replicas.
+
+    ``replicas=None`` defaults to one per visible device when ``devices``
+    selects placement, else 1.  ``replica_prefix`` namespaces the replica
+    ids (metric labels / provider keys) when several pools share a
+    process.  Remaining ``engine_kwargs`` go to every engine verbatim.
+    """
+
+    def __init__(self, model, replicas=None, devices=None, replica_prefix="",
+                 **engine_kwargs):
+        from ..engine import ServingEngine
+
+        if devices == "auto":
+            devices = list(jax.devices())
+        if devices is not None and not devices:
+            raise ValueError("devices must be non-empty (or None/'auto')")
+        if replicas is None:
+            replicas = len(devices) if devices is not None else 1
+        replicas = int(replicas)
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.model = model
+        self.devices = devices
+        self.engines = []
+        for i in range(replicas):
+            dev = devices[i % len(devices)] if devices is not None else None
+            self.engines.append(ServingEngine(
+                model, replica=f"{replica_prefix}{i}", device=dev,
+                **engine_kwargs))
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        for e in self.engines:
+            e.start()
+        return self
+
+    def drain(self, timeout=600):
+        for e in self.engines:
+            e.drain(timeout=timeout)
+        return True
+
+    def stop(self, drain=False, drain_timeout=600):
+        errors = []
+        for e in self.engines:
+            try:
+                e.stop(drain=drain, drain_timeout=drain_timeout)
+            except Exception as exc:  # stop the REST before surfacing
+                errors.append(exc)
+        if errors:
+            raise errors[0]
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __len__(self):
+        return len(self.engines)
+
+    # -------------------------------------------------------------- insight
+    @property
+    def replica_ids(self):
+        return [e.replica for e in self.engines]
+
+    def states(self):
+        """Router-input snapshots, one per replica (reads race the
+        scheduler threads benignly — routing is a heuristic, not a
+        transaction)."""
+        out = []
+        for e in self.engines:
+            hs = e.health_state()
+            out.append({
+                "replica": e.replica,
+                "state": hs["state"],
+                "reasons": hs.get("reasons", []),
+                "stalled": any("scheduler_stalled" in r
+                               for r in hs.get("reasons", [])),
+                "queue_depth": len(e._queue),
+                "active": sum(1 for s in e._slots if s is not None),
+                "num_slots": e.num_slots,
+            })
+        return out
+
+    def stats(self):
+        return {e.replica: e.stats() for e in self.engines}

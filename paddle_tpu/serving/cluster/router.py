@@ -1,0 +1,161 @@
+"""Prefix-affinity request router — the front end of the serving cluster.
+
+The BlockManager's refcounted prefix sharing only pays off when requests
+with a common prompt prefix land on the SAME replica: a prefix page cached
+on replica 2 is invisible to replica 5.  The router therefore maps the
+first ``affinity_tokens`` prompt tokens (aligned with the page-granular
+prefix keys BlockManager uses) to a replica by **rendezvous hashing**
+(highest-random-weight): every (prefix, replica) pair gets a stable score,
+the prefix's *affine replica* is the top scorer, and when a replica leaves
+the routable set only ITS prefixes move — everyone else's cache stays warm
+(the stability property consistent hashing exists for).
+
+Health-aware fallback: replicas whose :meth:`ServingEngine.health_state`
+reports ``draining`` / ``stopped`` / ``error`` are not routable at all;
+a routable-but-*saturated* affine replica (deep queue, or a scheduler
+stalled past its degraded threshold) falls back to the **least-loaded**
+routable replica, trading a prefix-cache hit for latency only when the
+affine replica could not serve promptly anyway.
+
+Control policies for benchmarking the affinity win (``bench.py --serving
+--replicas N``): ``random`` (seeded uniform over routable replicas) and
+``round_robin`` and ``least_loaded``.  Every decision still records the
+affine replica, so the *affinity hit rate* — fraction of requests that
+landed on their affine replica — is comparable across policies.
+
+The router is pure host-side policy: it sees a list of replica state
+snapshots (built by :class:`~.service.ServingCluster` from the live
+engines) and returns a :class:`RouteDecision`; it never touches an engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import random
+
+#: health states a replica may receive traffic in
+ROUTABLE_STATES = ("healthy", "degraded")
+
+POLICIES = ("affinity", "least_loaded", "random", "round_robin")
+
+
+@dataclasses.dataclass
+class RouteDecision:
+    """One routing decision.  ``replica`` is the chosen index into the
+    states list; ``affine`` the prefix's rendezvous winner over ALL
+    replicas (dead or alive — it says where the prefix's pages would
+    accumulate in a fully healthy pool); ``hit`` whether they coincide;
+    ``reason`` the machine-readable branch taken."""
+
+    replica: int
+    affine: int
+    hit: bool
+    reason: str
+    policy: str
+
+
+def prefix_key(prompt_ids, affinity_tokens):
+    """Canonical bytes for a prompt's routing prefix (its first
+    ``affinity_tokens`` ids).  Prompts shorter than the window key on what
+    they have — two prompts only share a key when one's window is a prefix
+    the other matches exactly."""
+    head = [int(t) for t in list(prompt_ids)[:max(int(affinity_tokens), 1)]]
+    return (",".join(map(str, head))).encode()
+
+
+class PrefixAffinityRouter:
+    """See module docstring.
+
+    ``saturation_queue``: a replica with this many queued requests no
+    longer receives affine traffic (``None`` = its ``num_slots``, i.e. a
+    full extra batch already waiting).  A replica whose health reasons
+    include a stalled scheduler is treated as saturated regardless of
+    queue depth — a wedged replica's queue may be short AND hopeless.
+    """
+
+    def __init__(self, n_replicas, affinity_tokens=16, policy="affinity",
+                 saturation_queue=None, seed=0):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if policy not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, "
+                             f"got {policy!r}")
+        self.n_replicas = int(n_replicas)
+        self.affinity_tokens = int(affinity_tokens)
+        self.policy = policy
+        self.saturation_queue = None if saturation_queue is None \
+            else int(saturation_queue)
+        self._rng = random.Random(seed)
+        self._rr = itertools.count()
+
+    # ------------------------------------------------------------- hashing
+    def _score(self, key, idx):
+        h = hashlib.sha1(key + b"|" + str(idx).encode()).digest()
+        return int.from_bytes(h[:8], "big")
+
+    def _affine_for_key(self, key):
+        return max(range(self.n_replicas),
+                   key=lambda i: self._score(key, i))
+
+    def affine_index(self, prompt_ids):
+        """The prefix's rendezvous winner over ALL replica indices."""
+        return self._affine_for_key(
+            prefix_key(prompt_ids, self.affinity_tokens))
+
+    # -------------------------------------------------------------- policy
+    @staticmethod
+    def _load(st):
+        return st.get("queue_depth", 0) + st.get("active", 0)
+
+    def _saturated(self, st):
+        if st.get("stalled"):
+            return True
+        cap = self.saturation_queue if self.saturation_queue is not None \
+            else max(1, int(st.get("num_slots", 1)))
+        return st.get("queue_depth", 0) >= cap
+
+    def _least_loaded(self, key, candidates, states):
+        # rendezvous score as the tie-break so equal-load choices are
+        # stable per prefix instead of always index 0
+        return min(candidates,
+                   key=lambda i: (self._load(states[i]),
+                                  -self._score(key, i)))
+
+    def route(self, prompt_ids, states):
+        """Pick a replica for this prompt given live state snapshots
+        (dicts with ``state``/``stalled``/``queue_depth``/``active``/
+        ``num_slots``).  Returns ``None`` when no replica is routable —
+        the caller sheds the request."""
+        if len(states) != self.n_replicas:
+            raise ValueError(f"router built for {self.n_replicas} replicas, "
+                             f"got {len(states)} states")
+        key = prefix_key(prompt_ids, self.affinity_tokens)
+        affine = self._affine_for_key(key)
+        routable = [i for i, st in enumerate(states)
+                    if st.get("state") in ROUTABLE_STATES]
+        if not routable:
+            return None
+        if self.policy == "random":
+            chosen = self._rng.choice(routable)
+            reason = "random"
+        elif self.policy == "round_robin":
+            chosen = routable[next(self._rr) % len(routable)]
+            reason = "round_robin"
+        elif self.policy == "least_loaded":
+            chosen = self._least_loaded(key, routable, states)
+            reason = "least_loaded"
+        elif affine in routable and not self._saturated(states[affine]):
+            chosen, reason = affine, "affinity"
+        else:
+            # affine replica down or saturated: least-loaded fallback,
+            # preferring unsaturated replicas so a wedged replica's queue
+            # doesn't keep accreting
+            unsat = [i for i in routable if not self._saturated(states[i])]
+            chosen = self._least_loaded(key, unsat or routable, states)
+            reason = "fallback_unroutable" if affine not in routable \
+                else "fallback_saturated"
+        return RouteDecision(replica=chosen, affine=affine,
+                             hit=chosen == affine, reason=reason,
+                             policy=self.policy)

@@ -235,8 +235,23 @@ class ServingEngine:
                  max_queue=None, seed=0, adapter=None, watchdog_s=None,
                  telemetry_port=None, max_engine_restarts=3,
                  degraded_stall_s=2.0, restart_cooldown_s=10.0,
-                 speculative_k=0, draft_max_ngram=3, draft_min_ngram=1):
+                 speculative_k=0, draft_max_ngram=3, draft_min_ngram=1,
+                 replica="0", device=None, health_gating=True):
         self._model = model
+        # replica identity (cluster serving): stamps every serving.* metric
+        # series with a replica= label so N engines in one process don't
+        # overwrite each other, keys the /statusz|/healthz provider
+        # registration, and names the per-replica fault sites
+        # serving.{step_crash,scheduler_wedge}@<replica>
+        self.replica = str(replica)
+        self._site_wedge = f"serving.scheduler_wedge@{self.replica}"
+        self._site_step_crash = f"serving.step_crash@{self.replica}"
+        self._provider_key = f"serving/{self.replica}"
+        # False for cluster replicas: the replica still shows on /healthz
+        # but the ServingCluster's any-replica-routable component gates
+        # the 503 fold instead (one dead replica must not fail the fleet)
+        self._health_gating = bool(health_gating)
+        self._device = device
         self._adapter = adapter if adapter is not None \
             else GPTAdapter(model, page_size)
         self.page_size = int(page_size)
@@ -250,13 +265,22 @@ class ServingEngine:
         self._num_pages = int(num_pages)
         self._prefix_sharing = bool(prefix_sharing)
         self._bm = BlockManager(num_pages, self.page_size,
-                                prefix_sharing=prefix_sharing)
+                                prefix_sharing=prefix_sharing,
+                                replica=self.replica)
         # pool row num_pages is the SCRATCH page: inactive decode slots and
         # padded table tails point at it (every table entry must be a valid
         # pool row; junk written there is never attended)
         self._scratch = int(num_pages)
         self._pools = self._adapter.init_pools(num_pages + 1)
         self._params, self._bufs = self._adapter.params_and_buffers()
+        if device is not None:
+            # dp-replica placement: commit this replica's params/buffers and
+            # page pools to its device — uncommitted per-step host arrays
+            # (table/lens/ids) follow the committed operands, so every
+            # dispatch of this engine runs there
+            self._params = jax.device_put(self._params, device)
+            self._bufs = jax.device_put(self._bufs, device)
+            self._pools = jax.device_put(self._pools, device)
         from ..text.models._decode import make_batched_sampler
 
         self._sampler = make_batched_sampler(top_k, top_p)
@@ -331,56 +355,70 @@ class ServingEngine:
 
         from ..profiler import metrics as _metrics
 
-        self._m_ttft = _metrics.histogram(
-            "serving.ttft_seconds", "submit -> first token")
-        self._m_itl = _metrics.histogram(
+        # every serving.* series carries replica=<id> (default "0") so N
+        # engines in one process keep distinct series; per-call labels like
+        # status=/reason= merge on top of it (metrics.bind)
+        def _h(name, help):
+            return _metrics.bind(_metrics.histogram(name, help),
+                                 replica=self.replica)
+
+        def _g(name, help):
+            return _metrics.bind(_metrics.gauge(name, help),
+                                 replica=self.replica)
+
+        def _c(name, help):
+            return _metrics.bind(_metrics.counter(name, help),
+                                 replica=self.replica)
+
+        self._m_ttft = _h("serving.ttft_seconds", "submit -> first token")
+        self._m_itl = _h(
             "serving.inter_token_seconds", "per-sequence inter-token latency")
-        self._m_step_seconds = _metrics.histogram(
+        self._m_step_seconds = _h(
             "serving.step_seconds", "one batched decode iteration")
-        self._m_prefill_seconds = _metrics.histogram(
+        self._m_prefill_seconds = _h(
             "serving.prefill_seconds", "admit-time prefill")
-        self._m_queue_depth = _metrics.gauge(
+        self._m_queue_depth = _g(
             "serving.queue_depth", "requests waiting for a slot")
-        self._m_active = _metrics.gauge(
+        self._m_active = _g(
             "serving.active_slots", "slots decoding this iteration")
-        self._m_occupancy = _metrics.gauge(
+        self._m_occupancy = _g(
             "serving.slot_occupancy", "active_slots / num_slots")
-        self._m_page_util = _metrics.gauge(
+        self._m_page_util = _g(
             "serving.page_utilization", "KV pages in use / pool size")
-        self._m_pages_used = _metrics.gauge(
+        self._m_pages_used = _g(
             "serving.pages_in_use", "KV pages held by live sequences")
-        self._m_tokens = _metrics.counter(
+        self._m_tokens = _c(
             "serving.tokens_generated", "tokens emitted to callers")
-        self._m_requests = _metrics.counter(
+        self._m_requests = _c(
             "serving.requests", "requests by terminal status")
-        self._m_blocked = _metrics.counter(
+        self._m_blocked = _c(
             "serving.admissions_blocked",
             "admissions deferred: page pool exhausted")
-        self._m_preempt = _metrics.counter(
+        self._m_preempt = _c(
             "serving.preemptions", "running sequences retired by deadline")
-        self._m_step_traces = _metrics.counter(
+        self._m_step_traces = _c(
             "serving.step_traces", "decode-step program traces")
-        self._m_prefill_traces = _metrics.counter(
+        self._m_prefill_traces = _c(
             "serving.prefill_traces", "prefill program traces")
-        self._m_shed = _metrics.counter(
+        self._m_shed = _c(
             "serving.load_shed", "requests shed at submit, by reason")
-        self._m_engine_restarts = _metrics.counter(
+        self._m_engine_restarts = _c(
             "serving.engine_restarts",
             "scheduler auto-restarts after transient failures")
-        self._m_requeued = _metrics.counter(
+        self._m_requeued = _c(
             "serving.requests_requeued",
             "in-flight requests transparently re-queued across a restart")
-        self._m_health = _metrics.gauge(
+        self._m_health = _g(
             "serving.health_state",
             "0 healthy, 1 degraded, 2 draining, 3 stopped, 4 error")
-        self._m_spec_proposed = _metrics.counter(
+        self._m_spec_proposed = _c(
             "serving.spec_proposed", "draft tokens submitted to verification")
-        self._m_spec_accepted = _metrics.counter(
+        self._m_spec_accepted = _c(
             "serving.spec_accepted", "draft tokens accepted by verification")
-        self._m_accept_rate = _metrics.gauge(
+        self._m_accept_rate = _g(
             "serving.acceptance_rate",
             "speculative acceptance: spec_accepted / spec_proposed")
-        self._m_verify_traces = _metrics.counter(
+        self._m_verify_traces = _c(
             "serving.verify_traces", "verify-step program traces")
 
     # ------------------------------------------------------------ lifecycle
@@ -400,7 +438,8 @@ class ServingEngine:
         self._engine_restarts = 0   # a fresh start() is a fresh budget
         self._progress_t = time.monotonic()
         self._thread = threading.Thread(
-            target=self._loop, name="paddle-serving-engine", daemon=True)
+            target=self._loop,
+            name=f"paddle-serving-engine[{self.replica}]", daemon=True)
         self._started = True
         self._thread.start()
         self._start_observability()
@@ -470,12 +509,10 @@ class ServingEngine:
             # must not pin model params/pools past stop()
             from ..observability import telemetry as _telemetry
 
-            if _telemetry._PROVIDERS.get("serving") is self._status_provider:
-                _telemetry.remove_status_provider("serving")
+            _telemetry.remove_providers_if_owner(
+                self._provider_key, self._status_provider,
+                self._health_provider)
             self._status_provider = None
-            if _telemetry._HEALTH_PROVIDERS.get("serving") \
-                    is self._health_provider:
-                _telemetry.remove_health_provider("serving")
             self._health_provider = None
         self._started = False
 
@@ -509,12 +546,17 @@ class ServingEngine:
                 port = int(env) if env else None
             if port is not None:
                 _telemetry.serve(port)
+                # registration is KEYED by replica id ("serving/<replica>")
+                # so a second engine in the process gets its own /statusz
+                # section and /healthz component instead of clobbering the
+                # first's, and unregister-on-stop stays per replica
                 self._status_provider = self._statusz
-                _telemetry.add_status_provider("serving",
+                _telemetry.add_status_provider(self._provider_key,
                                                self._status_provider)
                 self._health_provider = self.health_state
-                _telemetry.add_health_provider("serving",
-                                               self._health_provider)
+                _telemetry.add_health_provider(self._provider_key,
+                                               self._health_provider,
+                                               gating=self._health_gating)
         except Exception as e:
             # opt-in observability must never take down serving startup
             # (EADDRINUSE on a shared port, malformed env value, ...)
@@ -542,10 +584,15 @@ class ServingEngine:
 
     # ------------------------------------------------------------------ api
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
-               eos_token_id=None, deadline_s=None, sampling=None):
+               eos_token_id=None, deadline_s=None, sampling=None,
+               _autostart=True):
         """Queue one request; returns a :class:`RequestHandle` immediately.
         ``deadline_s`` is a wall-clock budget from now — a sequence still
-        queued or decoding past it is retired with status ``expired``."""
+        queued or decoding past it is retired with status ``expired``.
+        ``_autostart=False`` (the cluster's leg path) never starts a
+        stopped engine: the submit is rejected instead, atomically with
+        the enqueue, so a leg racing ``stop()`` cannot resurrect the
+        replica or enqueue past the stop-time handle sweep."""
         prompt = self._normalize_prompt(prompt_ids)
         if not prompt:
             raise ValueError("empty prompt")
@@ -564,11 +611,20 @@ class ServingEngine:
                 f"{total} positions; engine caps are "
                 f"{self._bm.num_pages} pages / {self.max_model_len} positions",
                 reason="unservable")
-        self.start()  # before enqueue: a failed engine rejects loudly
+        if _autostart:
+            self.start()  # before enqueue: a failed engine rejects loudly
         with _tracing.span("serving.submit", trace_id=handle.trace_id,
                            request_id=handle.request_id,
                            prompt_len=len(prompt)):
             with self._cv:
+                # stop() sets _stop_evt before its queue sweep (which holds
+                # this lock): a leg either rejects here, or its enqueue
+                # precedes the sweep and the sweep fails its handle
+                if not _autostart and (not self._started or self._error
+                                       is not None
+                                       or self._stop_evt.is_set()):
+                    raise EngineStoppedError(
+                        f"replica {self.replica} is not running")
                 if self._draining:
                     self._shed("draining",
                                "engine is draining; not admitting new work")
@@ -751,6 +807,7 @@ class ServingEngine:
                 # leaves the stamp stale exactly like a real stuck iteration
                 self._progress_t = time.monotonic()
                 _faults.maybe("serving.scheduler_wedge")
+                _faults.maybe(self._site_wedge)  # replica-scoped chaos site
                 self._admit()
                 self._update_gauges()
                 if not any(s is not None for s in self._slots):
@@ -808,8 +865,11 @@ class ServingEngine:
         # fresh device state: the page pools were donated into the crashed
         # dispatch; re-admission prefills rewrite every sequence's K/V
         self._bm = BlockManager(self._num_pages, self.page_size,
-                                prefix_sharing=self._prefix_sharing)
+                                prefix_sharing=self._prefix_sharing,
+                                replica=self.replica)
         self._pools = self._adapter.init_pools(self._num_pages + 1)
+        if self._device is not None:
+            self._pools = jax.device_put(self._pools, self._device)
         self._reset_host_buffers()
         with self._lock:
             for req, produced in reversed(inflight):
@@ -960,6 +1020,7 @@ class ServingEngine:
         # step — a crash between verifies must requeue with exactly the
         # accepted-token state)
         _faults.maybe("serving.step_crash")
+        _faults.maybe(self._site_step_crash)  # replica-scoped chaos site
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if self._spec_k:
             return self._verify_once(active)
@@ -1250,6 +1311,7 @@ class ServingEngine:
 
     def stats(self):
         st = {
+            "replica": self.replica,
             "iteration": self._iteration,
             "queue_depth": len(self._queue),
             "active_slots": sum(1 for s in self._slots if s is not None),

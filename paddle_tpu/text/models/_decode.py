@@ -102,8 +102,12 @@ def decode_loop(model, fwd, ids0, max_new_tokens, init_cache,
     import numpy as np
 
     S0 = ids0.shape[1]
-    params = {k: p._value for k, p in model.named_parameters()}
-    bufs = {k: b._value for k, b in model.named_buffers()}
+    # snapshot under the model's bind lock: a serving replica tracing on
+    # its scheduler thread holds bind() on this model, and an unlocked
+    # read here would capture its tracers instead of the real arrays
+    with model.bind_lock():
+        params = {k: p._value for k, p in model.named_parameters()}
+        bufs = {k: b._value for k, b in model.named_buffers()}
     modes = [(m, m.training) for m in model.sublayers(include_self=True)]
     model.eval()
 

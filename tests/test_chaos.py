@@ -158,8 +158,8 @@ def test_serving_wedge_sheds_with_distinct_reasons_then_recovers(model):
     reason queue_full, deadline-bound submits shed deadline_unmeetable,
     /healthz degrades — and once the wedge clears, queued work completes."""
     shed = prof_metrics.counter("serving.load_shed")
-    qf0 = shed.get(reason="queue_full") or 0
-    dl0 = shed.get(reason="deadline_unmeetable") or 0
+    qf0 = shed.get(reason="queue_full", replica="0") or 0
+    dl0 = shed.get(reason="deadline_unmeetable", replica="0") or 0
     eng = ServingEngine(model, num_slots=1, page_size=PS,
                         max_model_len=MAXLEN, max_queue=2,
                         degraded_stall_s=0.2)
@@ -195,8 +195,8 @@ def test_serving_wedge_sheds_with_distinct_reasons_then_recovers(model):
         while eng.health != "healthy" and time.time() - t0 < 60:
             time.sleep(0.02)
         assert eng.health == "healthy"
-    assert (shed.get(reason="queue_full") or 0) == qf0 + 1
-    assert (shed.get(reason="deadline_unmeetable") or 0) == dl0 + 1
+    assert (shed.get(reason="queue_full", replica="0") or 0) == qf0 + 1
+    assert (shed.get(reason="deadline_unmeetable", replica="0") or 0) == dl0 + 1
 
 
 def test_serving_step_crash_restarts_requeues_and_keeps_greedy_ids(model):

@@ -1,0 +1,476 @@
+"""paddle_tpu.serving.cluster — multi-replica serving with the
+prefix-affinity router ("Fleet for inference").
+
+Covers the ISSUE-6 satellites: router policy units (pure host), distinct
+per-replica ``serving.*`` metric series for two engines in one process,
+keyed /statusz provider registration, prefix-affinity vs random routing
+(same-prefix requests land on one replica and its prefix cache actually
+hits more), least-loaded fallback under a wedged replica, and the chaos
+acceptance — killing one of two replicas mid-decode re-routes its
+in-flight requests with greedy ids byte-identical to an uninterrupted
+single-engine run."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.observability import faults
+from paddle_tpu.profiler import metrics as prof_metrics
+from paddle_tpu.serving import (
+    PrefixAffinityRouter, ReplicaPool, RequestRejectedError, ServingCluster,
+    ServingEngine,
+)
+from paddle_tpu.serving.engine import EngineStoppedError
+from paddle_tpu.text.models.gpt import GPTForCausalLM
+
+pytestmark = pytest.mark.cluster
+
+PS = 8          # page size used throughout
+MAXLEN = 64
+
+
+def _tiny_gpt(train_steps=5, seed=0):
+    paddle.seed(seed)
+    m = GPTForCausalLM(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                       num_attention_heads=2, max_position_embeddings=MAXLEN)
+    if train_steps:
+        o = opt.AdamW(learning_rate=1e-2, parameters=m.parameters())
+        step = paddle.jit.TrainStep(m, o, loss_fn=None)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(1, 96, (8, 20)).astype("int64"))
+        for _ in range(train_steps):
+            step({"input_ids": ids, "labels": ids})
+    return m.eval()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_gpt()
+
+
+def _prompt(n, seed=1):
+    return np.random.RandomState(seed).randint(1, 96, (n,)).tolist()
+
+
+def _affine_prompt(router, target, n, start_seed):
+    """A seeded prompt whose routing prefix rendezvous-hashes to
+    ``target`` — lets a test aim traffic at one replica deterministically."""
+    for seed in range(start_seed, start_seed + 500):
+        p = _prompt(n, seed)
+        if router.affine_index(p) == target:
+            return p
+    raise AssertionError(f"no prompt affine to replica {target} found")
+
+
+def _ref_tokens(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray([prompt], "int64"))
+    out = model.generate(ids, max_new_tokens=n, temperature=0.0,
+                         cache_impl="paged", page_size=PS,
+                         max_len=len(prompt) + n)
+    return [int(t) for t in out.numpy()[0, len(prompt):]]
+
+
+def _healthy(i, n=2, **over):
+    st = {"replica": str(i), "state": "healthy", "reasons": [],
+          "stalled": False, "queue_depth": 0, "active": 0, "num_slots": 4}
+    st.update(over)
+    return st
+
+
+# ================================================================= router
+def test_router_affinity_deterministic_and_stable():
+    """Rendezvous hashing: the same prefix always maps to the same
+    replica, and removing one replica from the routable set only moves
+    THAT replica's prefixes (everyone else's cache stays warm)."""
+    r = PrefixAffinityRouter(4, affinity_tokens=16)
+    states = [_healthy(i, 4) for i in range(4)]
+    prompts = [_prompt(20, s) for s in range(24)]
+    affines = [r.affine_index(p) for p in prompts]
+    assert set(affines) > {affines[0]} or len(set(affines)) == 1
+    for p, a in zip(prompts, affines):
+        d = r.route(p, states)
+        assert (d.replica, d.affine, d.hit, d.reason) \
+            == (a, a, True, "affinity")
+        # only the routing window matters: a different tail, same prefix
+        assert r.affine_index(list(p[:16]) + [7, 7, 7]) == a
+    # kill replica affines[0]: its prompts move, the rest stay put
+    lost = affines[0]
+    states[lost]["state"] = "error"
+    for p, a in zip(prompts, affines):
+        d = r.route(p, states)
+        assert d.affine == a            # the affine identity never changes
+        if a == lost:
+            assert d.replica != lost and d.reason == "fallback_unroutable"
+        else:
+            assert d.replica == a and d.reason == "affinity"
+
+
+def test_router_policies_and_validation():
+    with pytest.raises(ValueError):
+        PrefixAffinityRouter(0)
+    with pytest.raises(ValueError):
+        PrefixAffinityRouter(2, policy="bogus")
+    r = PrefixAffinityRouter(2, policy="round_robin")
+    states = [_healthy(0), _healthy(1)]
+    picks = [r.route(_prompt(8, 1), states).replica for _ in range(4)]
+    assert picks == [0, 1, 0, 1]
+    r = PrefixAffinityRouter(2, policy="random", seed=3)
+    picks = {r.route(_prompt(8, 1), states).replica for _ in range(32)}
+    assert picks == {0, 1}             # seeded, but spreads over replicas
+    with pytest.raises(ValueError):    # states list must match the pool
+        r.route(_prompt(8, 1), states[:1])
+
+
+def test_router_sheds_and_falls_back():
+    r = PrefixAffinityRouter(2, affinity_tokens=16)
+    p = _affine_prompt(r, 0, 20, 100)
+    # nothing routable -> None (caller sheds)
+    assert r.route(p, [_healthy(0, state="stopped"),
+                       _healthy(1, state="error")]) is None
+    # saturated affine replica -> least-loaded fallback, still a "miss"
+    d = r.route(p, [_healthy(0, queue_depth=9, num_slots=4), _healthy(1)])
+    assert (d.replica, d.hit, d.reason) == (1, False, "fallback_saturated")
+    # a stalled scheduler saturates regardless of queue depth
+    d = r.route(p, [_healthy(0, stalled=True), _healthy(1)])
+    assert (d.replica, d.reason) == (1, "fallback_saturated")
+    # degraded is still routable; the affine replica keeps its traffic
+    d = r.route(p, [_healthy(0, state="degraded"), _healthy(1)])
+    assert (d.replica, d.hit) == (0, True)
+
+
+# ============================================== satellite: metric series
+def test_two_engines_distinct_metric_series(model):
+    """The process-wide registry must NOT fold two engines into one
+    ``serving.*`` series: every site carries replica= (default "0")."""
+    c = prof_metrics.counter("serving.requests")
+    t = prof_metrics.counter("serving.tokens_generated")
+    base_r0 = c.get(status="completed", replica="0") or 0
+    base_r1 = c.get(status="completed", replica="1") or 0
+    tok_r0 = t.get(replica="0") or 0
+    tok_r1 = t.get(replica="1") or 0
+    pool = ReplicaPool(model, replicas=2, num_slots=1, page_size=PS,
+                       max_model_len=MAXLEN)
+    with pool:
+        e0, e1 = pool.engines
+        assert pool.replica_ids == ["0", "1"]
+        e0.generate(_prompt(6, 30), max_new_tokens=3, timeout=300)
+        e1.generate(_prompt(7, 31), max_new_tokens=3, timeout=300)
+        e1.generate(_prompt(5, 32), max_new_tokens=3, timeout=300)
+    assert (c.get(status="completed", replica="0") or 0) == base_r0 + 1
+    assert (c.get(status="completed", replica="1") or 0) == base_r1 + 2
+    assert (t.get(replica="0") or 0) == tok_r0 + 3
+    assert (t.get(replica="1") or 0) == tok_r1 + 6
+    prom = prof_metrics.get_registry().to_prometheus()
+    assert 'serving_requests{replica="0",status="completed"}' in prom
+    assert 'serving_requests{replica="1",status="completed"}' in prom
+
+
+# ============================================ satellite: keyed /statusz
+def test_statusz_providers_keyed_per_replica(model):
+    """Two engines register distinct ``serving/<replica>`` providers on
+    /statusz and /healthz; stopping one unregisters ONLY its own."""
+    from paddle_tpu.observability import telemetry
+
+    e0 = ServingEngine(model, num_slots=1, page_size=PS, max_model_len=MAXLEN,
+                       replica="s0", telemetry_port=0)
+    e1 = ServingEngine(model, num_slots=1, page_size=PS, max_model_len=MAXLEN,
+                       replica="s1", telemetry_port=0)
+    e0.start()
+    try:
+        e1.start()
+        try:
+            assert "serving/s0" in telemetry._PROVIDERS
+            assert "serving/s1" in telemetry._PROVIDERS
+            assert "serving/s0" in telemetry._HEALTH_PROVIDERS
+            assert "serving/s1" in telemetry._HEALTH_PROVIDERS
+            with urllib.request.urlopen(
+                    telemetry._SERVER.url + "/statusz", timeout=10) as r:
+                sz = json.loads(r.read().decode())
+            assert sz["serving/s0"]["replica"] == "s0"
+            assert sz["serving/s1"]["replica"] == "s1"
+            assert sz["serving/s0"]["started"] is True
+        finally:
+            e1.stop()
+        # per-replica unregister: s1 gone, s0 still live
+        assert "serving/s1" not in telemetry._PROVIDERS
+        assert "serving/s1" not in telemetry._HEALTH_PROVIDERS
+        assert "serving/s0" in telemetry._PROVIDERS
+    finally:
+        e0.stop()
+    assert "serving/s0" not in telemetry._PROVIDERS
+
+
+# ====================================================== cluster behavior
+def test_cluster_greedy_parity_and_prefix_affinity(model):
+    """Same-prefix requests land on the SAME replica (hit rate 1.0 on
+    clean traffic), results are byte-identical to generate(), and the
+    affine replica's prefix cache actually hits."""
+    hits = prof_metrics.counter("serving.prefix_cache_hits")
+    base = {r: hits.get(replica=r) or 0 for r in ("0", "1")}
+    # saturation_queue high: queue-depth fallback must not split the
+    # prefix group while requests wait for slots (that path has its own
+    # test below) — only the routing policy is under test here
+    cluster = ServingCluster(model, replicas=2, num_slots=2, page_size=PS,
+                             max_model_len=MAXLEN, prefix_sharing=True,
+                             saturation_queue=32)
+    with cluster:
+        head = _prompt(16, 8)           # two full shared prefix pages
+        group = [head + _prompt(4, s) for s in range(20, 24)]
+        other = _prompt(13, 9)
+        hs = [cluster.submit(p, max_new_tokens=6) for p in group]
+        ho = cluster.submit(other, max_new_tokens=6)
+        res = [h.result(timeout=300) for h in hs]
+        for p, r in zip(group, res):
+            assert r == _ref_tokens(model, p, 6)
+        assert ho.result(timeout=300) == _ref_tokens(model, other, 6)
+        # one replica serves the whole prefix group
+        landed = {h.replica_history[0] for h in hs}
+        assert len(landed) == 1
+        assert cluster.affinity_hit_rate() == 1.0
+        st = cluster.stats()
+        assert st["affinity"]["hits"] == 5 and st["rerouted_requests"] == 0
+        rep = landed.pop()
+        assert (hits.get(replica=rep) or 0) > base[rep]  # shared pages hit
+
+
+@pytest.mark.slow
+def test_affinity_beats_random_on_prefix_cache_hits(model):
+    """The routing policy is visible in the BlockManager: on the same
+    mixed-prefix workload, affinity routing produces strictly more
+    prefix-cache hits (and a higher hit rate) than the random control."""
+    hits = prof_metrics.counter("serving.prefix_cache_hits")
+
+    def run(policy):
+        h0 = sum(hits.get(replica=r) or 0 for r in ("0", "1"))
+        cluster = ServingCluster(model, replicas=2, num_slots=2,
+                                 page_size=PS, max_model_len=MAXLEN,
+                                 prefix_sharing=True, policy=policy, seed=7,
+                                 saturation_queue=32)
+        with cluster:
+            heads = [_prompt(16, 60 + g) for g in range(3)]
+            prompts = [heads[i % 3] + _prompt(4, 70 + i) for i in range(12)]
+            hs = [cluster.submit(p, max_new_tokens=4) for p in prompts]
+            res = [h.result(timeout=300) for h in hs]
+            rate = cluster.affinity_hit_rate()
+        return sum(hits.get(replica=r) or 0 for r in ("0", "1")) - h0, \
+            rate, res
+
+    aff_hits, aff_rate, aff_res = run("affinity")
+    rnd_hits, rnd_rate, rnd_res = run("random")
+    assert aff_res == rnd_res            # routing must not change the math
+    assert aff_rate == 1.0 and rnd_rate < 1.0
+    assert aff_hits > rnd_hits
+
+
+@pytest.mark.slow
+def test_wedged_replica_falls_back_least_loaded(model):
+    """A wedged replica (fault-injected stalled scheduler) stops
+    receiving its affine traffic: the router sees scheduler_stalled via
+    health_state() and falls back to the least-loaded survivor."""
+    cluster = ServingCluster(model, replicas=2, num_slots=2, page_size=PS,
+                             max_model_len=MAXLEN, prefix_sharing=True,
+                             degraded_stall_s=0.2)
+    with cluster:
+        for e in cluster.engines:       # compile off the critical path
+            e.generate(_prompt(4, 40), max_new_tokens=2, timeout=300)
+        p0 = _affine_prompt(cluster.router, 0, 20, 200)
+        ref_w = _ref_tokens(model, p0, 8)
+        faults.inject("serving.scheduler_wedge@0", seconds=4.0, times=1)
+        try:
+            h_wedged = cluster.submit(p0, max_new_tokens=8)
+            t0 = time.time()
+            while time.time() - t0 < 30:
+                st = cluster.engines[0].health_state()
+                if st["state"] == "degraded" and any(
+                        "scheduler_stalled" in r for r in st["reasons"]):
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError("replica 0 never reported stalled")
+            # same routing prefix, fresh tail: affine to the wedged
+            # replica, must fall back to replica 1 and still finish
+            p_var = list(p0[:16]) + _prompt(4, 41)
+            assert cluster.router.affine_index(p_var) == 0
+            h2 = cluster.submit(p_var, max_new_tokens=4)
+            assert h2.result(timeout=300) == _ref_tokens(model, p_var, 4)
+            assert h2.replica_history == ["1"]
+        finally:
+            faults.clear()
+        # the wedge clears; the parked request completes on replica 0
+        assert h_wedged.result(timeout=300) == ref_w
+        assert h_wedged.replica_history == ["0"]
+        assert cluster.stats()["rerouted_requests"] == 0  # stalls != loss
+
+
+# ================================================== cross-replica requeue
+@pytest.mark.chaos
+def test_replica_loss_mid_decode_reroutes_greedy_identical(model):
+    """ISSUE-6 acceptance: a fatal ``serving.step_crash@0`` kills replica
+    0 mid-decode (fatal classification = no engine self-restart); its
+    in-flight requests re-route onto replica 1 as prompt + tokens-so-far
+    and every completed request's greedy ids match an uninterrupted
+    single-engine run."""
+    rerouted = prof_metrics.counter("cluster.rerouted_requests")
+    base = rerouted.total() or 0
+    cluster = ServingCluster(model, replicas=2, num_slots=2, page_size=PS,
+                             max_model_len=MAXLEN, prefix_sharing=True)
+    with cluster:
+        for e in cluster.engines:
+            e.generate(_prompt(4, 50), max_new_tokens=2, timeout=300)
+        pa = _affine_prompt(cluster.router, 0, 7, 300)
+        pb = _affine_prompt(cluster.router, 0, 10, 400)
+        ref_a = _ref_tokens(model, pa, 14)
+        ref_b = _ref_tokens(model, pb, 12)
+
+        def bug():
+            raise ValueError("injected fatal replica crash")
+
+        # trips 1+2 are the two admission prefills' decode iterations —
+        # fire on a later decode step so tokens are already in flight
+        faults.inject("serving.step_crash@0", fn=bug, at_trips={4})
+        try:
+            ha = cluster.submit(pa, max_new_tokens=14)
+            hb = cluster.submit(pb, max_new_tokens=12)
+            assert ha.result(timeout=300) == ref_a
+            assert hb.result(timeout=300) == ref_b
+        finally:
+            faults.clear()
+        assert ha.status == hb.status == "completed"
+        # both requests survived the replica loss on replica 1
+        assert ha.replica_history == ["0", "1"]
+        assert hb.replica_history == ["0", "1"]
+        assert cluster.engines[0].health == "error"
+        assert (rerouted.total() or 0) == base + 2
+        assert cluster.stats()["rerouted_requests"] == 2
+        # the dead replica receives no further traffic
+        h3 = cluster.submit(_affine_prompt(cluster.router, 0, 6, 500),
+                            max_new_tokens=4)
+        h3.result(timeout=300)
+        assert h3.replica_history == ["1"]
+        assert cluster.health == "healthy"    # one survivor keeps the LB on
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_replica_stop_mid_decode_reroutes(model):
+    """Killing a replica with a plain stop() (operator action, not a
+    crash) re-routes its in-flight work the same way."""
+    cluster = ServingCluster(model, replicas=2, num_slots=2, page_size=PS,
+                             max_model_len=MAXLEN)
+    with cluster:
+        for e in cluster.engines:
+            e.generate(_prompt(4, 55), max_new_tokens=2, timeout=300)
+        pa = _affine_prompt(cluster.router, 0, 8, 600)
+        ref_a = _ref_tokens(model, pa, 24)
+        ha = cluster.submit(pa, max_new_tokens=24)
+        t0 = time.time()
+        while len(ha.token_ids) < 2 and time.time() - t0 < 60:
+            time.sleep(0.001)
+        assert len(ha.token_ids) >= 2, "no tokens before the kill"
+        cluster.engines[0].stop()          # kill mid-decode
+        assert ha.result(timeout=300) == ref_a
+        assert ha.replica_history[0] == "0" and ha.replica_history[-1] == "1"
+
+
+def test_cluster_sheds_when_nothing_routable(model):
+    rejected = prof_metrics.counter("cluster.rejected")
+    base = rejected.get(reason="no_routable_replica", cluster="0") or 0
+    cluster = ServingCluster(model, replicas=2, num_slots=1, page_size=PS,
+                             max_model_len=MAXLEN)
+    with cluster:
+        cluster.generate(_prompt(5, 90), max_new_tokens=2, timeout=300)
+        for e in cluster.engines:          # kill both replicas
+            e.stop()
+        with pytest.raises(RequestRejectedError) as ei:
+            cluster.submit(_prompt(5, 91), max_new_tokens=2)
+        assert ei.value.reason == "no_routable_replica"
+        assert (rejected.get(reason="no_routable_replica", cluster="0")
+                or 0) == base + 1
+        assert cluster.health == "stopped"
+
+
+def test_cluster_stop_fails_inflight_fast_without_reroute(model):
+    """A cluster stop() is not a replica failure: in-flight handles fail
+    fast with EngineStoppedError and are never re-routed."""
+    cluster = ServingCluster(model, replicas=2, num_slots=1, page_size=PS,
+                             max_model_len=MAXLEN)
+    cluster.start()
+    cluster.generate(_prompt(4, 95), max_new_tokens=2, timeout=300)
+    h = cluster.submit(_prompt(8, 96), max_new_tokens=30)
+    t0 = time.time()
+    while len(h.token_ids) < 1 and time.time() - t0 < 60:
+        time.sleep(0.001)
+    cluster.stop()
+    with pytest.raises(EngineStoppedError):
+        h.result(timeout=10)
+    assert cluster.stats()["rerouted_requests"] == 0
+    # and a drain-stop finishes the work instead
+    cluster2 = ServingCluster(model, replicas=2, num_slots=1, page_size=PS,
+                              max_model_len=MAXLEN)
+    cluster2.start()
+    p = _prompt(6, 97)
+    h2 = cluster2.submit(p, max_new_tokens=5)
+    cluster2.stop(drain=True)
+    assert h2.result(timeout=10) == _ref_tokens(model, p, 5)
+
+
+def test_healthz_cluster_gates_replica_components(model):
+    """One dead replica of two must NOT 503 the process: replica
+    components register non-gating under a cluster, and the cluster's
+    any-replica-routable component gates /healthz instead."""
+    from paddle_tpu.observability import telemetry
+
+    cluster = ServingCluster(model, replicas=2, num_slots=1, page_size=PS,
+                             max_model_len=MAXLEN, telemetry_port=0)
+    with cluster:
+        cluster.generate(_prompt(5, 85), max_new_tokens=2, timeout=300)
+        code, doc = telemetry._SERVER._healthz()
+        assert code == 200
+        assert doc["components"]["serving/0"].get("gating") is False
+        assert doc["components"]["serving/1"].get("gating") is False
+        assert doc["components"]["cluster"]["state"] == "healthy"
+        cluster.engines[0].stop()          # replica lost mid-flight
+        code, doc = telemetry._SERVER._healthz()
+        assert code == 200                 # the LB keeps sending traffic
+        assert doc["components"]["cluster"]["state"] == "healthy"
+    # a bare engine still gates /healthz as before (PR-4 contract)
+    eng = ServingEngine(model, num_slots=1, page_size=PS,
+                        max_model_len=MAXLEN, replica="solo",
+                        telemetry_port=0)
+    eng.start()
+    try:
+        code, doc = telemetry._SERVER._healthz()
+        assert doc["components"]["serving/solo"].get("gating") is None
+    finally:
+        eng.stop()
+
+
+def test_cluster_statusz_section_and_cancel(model):
+    cluster = ServingCluster(model, replicas=2, num_slots=2, page_size=PS,
+                             max_model_len=MAXLEN)
+    with cluster:
+        from paddle_tpu.observability import telemetry
+
+        assert "cluster" in telemetry._PROVIDERS
+        cluster.generate(_prompt(5, 98), max_new_tokens=3, timeout=300)
+        sz = cluster._statusz()
+        assert set(sz["replica_health"]) == {"0", "1"}
+        for rep in sz["replica_health"].values():
+            assert {"state", "queue_depth", "occupancy",
+                    "page_utilization"} <= set(rep)
+        assert sz["health"]["state"] == "healthy"
+        assert sz["affinity"]["hits"] + sz["affinity"]["misses"] >= 1
+        # cancellation chases the leg onto the serving engine: the
+        # request retires early and returns only the tokens it produced
+        h = cluster.submit(_prompt(8, 99), max_new_tokens=40)
+        h.cancel()
+        toks = h.result(timeout=60)
+        assert h.status == "cancelled" and len(toks) < 40
+    from paddle_tpu.observability import telemetry
+
+    assert "cluster" not in telemetry._PROVIDERS

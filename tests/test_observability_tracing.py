@@ -465,11 +465,12 @@ def test_telemetry_endpoints_with_engine(model, tmp_path):
         code, ctype, body = _get(srv.url + "/statusz")
         sz = json.loads(body)
         assert code == 200
-        assert sz["serving"]["num_slots"] == 2
-        assert sz["serving"]["started"] is True
-        assert len(sz["serving"]["slots"]) == 2
-        assert "queue_depth" in sz["serving"]
-        assert "page_utilization" in sz["serving"]
+        # provider registration is keyed by replica id (default "0")
+        assert sz["serving/0"]["num_slots"] == 2
+        assert sz["serving/0"]["started"] is True
+        assert len(sz["serving/0"]["slots"]) == 2
+        assert "queue_depth" in sz["serving/0"]
+        assert "page_utilization" in sz["serving/0"]
         assert sz["flight_recorder_armed"] is True
         assert isinstance(sz["in_flight_spans"], list)
 
@@ -484,17 +485,25 @@ def test_telemetry_statusz_shows_slot_table_mid_flight(model):
     with eng:
         srv = telemetry.serve(0)
         telemetry.add_status_provider("serving", eng._statusz)
-        h = eng.submit([1, 2, 3, 4, 5], max_new_tokens=40)
-        # deterministic mid-flight snapshot: once the first token exists the
-        # slot is occupied; wedge the scheduler so it STAYS occupied while
-        # we scrape (cached programs can otherwise finish between polls)
-        t0 = time.time()
-        while not h.token_ids and time.time() - t0 < 120:
-            time.sleep(0.01)
-        assert h.token_ids, "prefill never produced a token"
-        faults.inject("serving.scheduler_wedge", seconds=30.0)
+        # deterministic mid-flight snapshot: park the scheduler INSIDE its
+        # third loop iteration (after the prefill token + one decode step,
+        # long before the 40-token budget) so the slot is guaranteed
+        # occupied while we scrape — with warm cached programs the whole
+        # request can otherwise finish between injection polls
+        import threading
+
+        release = threading.Event()
+        faults.inject("serving.scheduler_wedge",
+                      fn=lambda: release.wait(60), at_trips={3})
         try:
-            time.sleep(0.1)  # let the loop reach the wedge hook
+            h = eng.submit([1, 2, 3, 4, 5], max_new_tokens=40)
+            t0 = time.time()
+            while not faults.trip_count("serving.scheduler_wedge") \
+                    and time.time() - t0 < 120:
+                time.sleep(0.005)
+            assert faults.trip_count("serving.scheduler_wedge"), \
+                "scheduler never reached the wedge hook"
+            assert h.token_ids, "no tokens before the parked iteration"
             _, _, body = _get(srv.url + "/statusz")
             rows = [s for s in json.loads(body)["serving"]["slots"] if s]
             assert rows, "slot table empty while a request is mid-decode"
@@ -502,6 +511,7 @@ def test_telemetry_statusz_shows_slot_table_mid_flight(model):
             assert rows[0]["trace_id"] == h.trace_id
             assert rows[0]["produced"] >= 1
         finally:
+            release.set()
             faults.clear()
         h.cancel()
 

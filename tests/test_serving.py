@@ -222,8 +222,8 @@ def test_engine_metrics_exported(model):
         for h in hs:
             h.result(timeout=300)
     reg = prof_metrics.get_registry()
-    ttft = reg.get("serving.ttft_seconds").labels()
-    itl = reg.get("serving.inter_token_seconds").labels()
+    ttft = reg.get("serving.ttft_seconds").labels(replica="0")
+    itl = reg.get("serving.inter_token_seconds").labels(replica="0")
     assert ttft.count >= 3 and ttft.mean > 0
     assert itl.count >= 3 * 4  # >= (6-1) tokens per request, 3 requests
     assert reg.get("serving.queue_depth") is not None
@@ -236,22 +236,22 @@ def test_engine_metrics_exported(model):
         assert n in names, n
     prom = reg.to_prometheus()
     assert "serving_ttft_seconds_bucket" in prom
-    assert 'serving_requests{status="completed"}' in prom
+    assert 'serving_requests{replica="0",status="completed"}' in prom
 
 
 def test_submit_rejections(model):
     eng = ServingEngine(model, num_slots=1, page_size=PS,
                         max_model_len=MAXLEN)
-    rej0 = prof_metrics.counter("serving.requests").get(status="rejected") \
-        or 0
+    rej0 = prof_metrics.counter("serving.requests").get(
+        status="rejected", replica="0") or 0
     with pytest.raises(RequestRejectedError):  # longer than the model cap
         eng.submit(_prompt(8, 90), max_new_tokens=MAXLEN)
     eng2 = ServingEngine(model, num_slots=1, page_size=PS,
                          max_model_len=MAXLEN, max_queue=0)
     with pytest.raises(RequestRejectedError):  # bounded queue: reject now
         eng2.submit(_prompt(4, 91), max_new_tokens=4)
-    assert (prof_metrics.counter("serving.requests").get(status="rejected")
-            or 0) >= rej0 + 2
+    assert (prof_metrics.counter("serving.requests").get(
+        status="rejected", replica="0") or 0) >= rej0 + 2
     eng.stop()
     eng2.stop()
 
