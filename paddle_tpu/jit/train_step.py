@@ -23,8 +23,10 @@ in place exactly like the reference.
 
 from __future__ import annotations
 
+import itertools
 import os
 import warnings
+import weakref
 from collections import OrderedDict
 from time import perf_counter
 
@@ -33,34 +35,20 @@ import jax.numpy as jnp
 
 from ..framework import random as _rng
 from ..framework.state import no_grad_ctx
+from ..observability import perf as _perf
 from ..observability import tracing as _tracing
 from ..optimizer.lr import LRScheduler
 from ..profiler import events as _prof_events
 from ..profiler import metrics as _metrics
 from ..tensor.tensor import Tensor
 
-# bf16 datasheet peaks per chip generation, for the MFU gauge (BENCH
-# convention: the v5e int8 TOPS line is NOT the bf16 peak).  Override with
-# PADDLE_PEAK_FLOPS (FLOP/s) — required on the CPU test mesh.
-_PEAK_BF16_FLOPS = {"v6": 918e12, "v5p": 459e12, "v5 lite": 197e12,
-                    "v5e": 197e12, "v4": 275e12, "v3": 123e12, "v2": 45e12}
+# bf16 datasheet peaks now live in observability.perf (one table feeds the
+# MFU gauge here AND the per-program roofline attribution); these aliases
+# keep the old spelling working.
+_PEAK_BF16_FLOPS = _perf.PEAK_BF16_FLOPS
+_peak_flops = _perf.peak_flops
 
-
-def _peak_flops():
-    env = os.environ.get("PADDLE_PEAK_FLOPS")
-    if env:
-        try:
-            return float(env)
-        except ValueError:
-            return None  # malformed override must not kill the train loop
-    try:
-        kind = jax.devices()[0].device_kind.lower()
-    except Exception:
-        return None
-    for k, v in _PEAK_BF16_FLOPS.items():
-        if k in kind:
-            return v
-    return None
+_PERF_INSTANCE_IDS = itertools.count()
 
 
 class TrainStep:
@@ -132,6 +120,14 @@ class TrainStep:
                                                     self._diff) if d))
         self._step_count = 0
         self._compiled = {}
+        # per-instance tag for roofline attribution families: two
+        # TrainSteps in one process must not fold their stats (and one
+        # cost_analysis) into a shared "train_step/v0".  The finalizer
+        # evicts this instance's families when it dies, so TrainStep-in-a-
+        # loop processes don't grow the table without bound.
+        self._perf_tag = f"train_step/t{next(_PERF_INSTANCE_IDS)}"
+        self._perf_prev_family = None  # family that RAN in the last interval
+        weakref.finalize(self, _perf.table().drop_prefix, self._perf_tag)
         self._donate = donate
         self._lr_float = None
         self._lr_dev = None
@@ -212,6 +208,7 @@ class TrainStep:
                     "shape/dtype compiles a new XLA program — pad or bucket "
                     "batches to avoid recompilation.", stacklevel=2)
             fn = self._build(treedef, bool(self.model.training))
+            fn._perf_family = f"{self._perf_tag}.v{len(self._compiled)}"
             self._compiled[avals] = fn
         # avals only, for dist_main_program re-lowering: holding the real
         # arrays would pin a full batch of HBM for the TrainStep's lifetime.
@@ -229,6 +226,12 @@ class TrainStep:
             # includes host work between dispatches, excludes compiles)
             dt = t_call - self._last_call_t
             self._m_step_s.observe(dt)
+            # per-program roofline attribution: dt covers the interval in
+            # which the PREVIOUS dispatch executed, so it is recorded
+            # under THAT call's variant family (with alternating bucketed
+            # variants, crediting the current fn would swap their seconds)
+            if self._perf_prev_family is not None:
+                _perf.record(self._perf_prev_family, dt)
             if self._flops_per_step:
                 achieved = self._flops_per_step / max(dt, 1e-12)
                 self._m_tflops.set(achieved / 1e12)
@@ -236,6 +239,7 @@ class TrainStep:
                 if peak:
                     self._m_mfu.set(achieved / peak)
         self._last_call_t = t_call
+        self._perf_prev_family = fn._perf_family
         # span per fused step: traced-phase collective events recorded
         # while a new variant traces inherit this trace id, so a step and
         # its collectives correlate in the merged cross-rank timeline
@@ -257,6 +261,29 @@ class TrainStep:
             if (os.environ.get("PADDLE_TRAINSTEP_COST", "0").lower()
                     not in ("", "0", "false", "no")) or _prof_events._ACTIVE:
                 self.cost_analysis(_fn=fn)
+            # lazy cost for the roofline table: shapes are captured now,
+            # the re-lower+compile runs only when the table resolves costs
+            fam = fn._perf_family
+            if _perf.needs_cost(fam):
+                vals_sds = list(self._last_batch_vals)
+                # weakrefs: the process-wide perf table must not pin this
+                # TrainStep's params/opt-state past its lifetime just
+                # because nobody resolved costs yet
+                self_ref, fn_ref = weakref.ref(self), weakref.ref(fn)
+
+                def _cost(vals=vals_sds):
+                    ts, v = self_ref(), fn_ref()
+                    if ts is None or v is None:
+                        raise RuntimeError(
+                            "TrainStep was garbage-collected before its "
+                            "cost_analysis resolved")
+                    out = ts.cost_analysis(_fn=v, _vals=vals,
+                                           _update_gauges=False)
+                    if not out:
+                        raise RuntimeError("cost_analysis unavailable")
+                    return out["flops"], out["bytes_accessed"]
+
+                _perf.register_cost_thunk(fam, _cost)
             # the next call's inter-step dt would include this compile —
             # restart the steady-state clock
             self._last_call_t = None
@@ -291,26 +318,31 @@ class TrainStep:
                 pass  # prng keys on some backends hide their bytes
         return total
 
-    def cost_analysis(self, _fn=None):
+    def cost_analysis(self, _fn=None, _vals=None, _update_gauges=True):
         """flops / bytes-accessed of the compiled step via XLA cost
         analysis; feeds the flops/MFU gauges.  Runs automatically on each
         compile when PADDLE_TRAINSTEP_COST=1 or a Profiler is recording
         (it re-lowers and compiles the program once more, so it is not free
-        — hence the gate); callable explicitly any time after step one."""
+        — hence the gate); callable explicitly any time after step one.
+        ``_vals`` pins the batch avals to lower with (the perf-table cost
+        thunks pass the avals captured at the variant's first dispatch, so
+        a later variant's batch shape cannot mismatch the program)."""
         # default to the variant that produced _last_batch_vals — pairing
         # an older variant with the newest avals lowers a mismatched
         # program (same defect dist_main_program had)
         fn = _fn if _fn is not None else getattr(
             self, "_last_fn", None) or next(iter(self._compiled.values()),
                                             None)
-        if fn is None or getattr(self, "_last_batch_vals", None) is None:
+        vals = _vals if _vals is not None \
+            else getattr(self, "_last_batch_vals", None)
+        if fn is None or vals is None:
             return None
         try:
             args = [self._diff_params, self._opt_state, self._buffers,
                     self._frozen_params, self._lr_dev, self._rng_carry]
             if self._scaler_state is not None:
                 args.append(self._scaler_state)
-            comp = fn._jitted.lower(*args, *self._last_batch_vals).compile()
+            comp = fn._jitted.lower(*args, *vals).compile()
             ca = comp.cost_analysis()
             ca = ca[0] if isinstance(ca, list) else ca
             flops = float(ca.get("flops", 0.0))
@@ -318,7 +350,10 @@ class TrainStep:
                    "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
         except Exception:
             return None
-        if flops > 0:
+        if flops > 0 and _update_gauges:
+            # _update_gauges=False: a deferred perf-table cost thunk may
+            # resolve an OLD variant while another is training — it must
+            # not clobber the live MFU denominator
             self._flops_per_step = flops
             self._m_flops.set(flops)
         return out

@@ -29,8 +29,12 @@ Env flags (README "Distributed tracing & forensics"):
 
 from __future__ import annotations
 
-from . import faults, flight_recorder, telemetry, tracing, watchdog  # noqa: F401
+from . import (  # noqa: F401
+    faults, flight_recorder, perf, slo, telemetry, tracing, watchdog,
+)
 from .faults import FaultPlan  # noqa: F401
+from .perf import ProgramTable  # noqa: F401
+from .slo import RequestTimeline, SLOAccountant, SLOPolicy  # noqa: F401
 from .flight_recorder import (  # noqa: F401
     FlightRecorder, get_flight_recorder, install_crash_handlers,
 )
@@ -48,6 +52,8 @@ from .watchdog import (  # noqa: F401
 
 __all__ = [
     "tracing", "flight_recorder", "watchdog", "telemetry", "faults",
+    "perf", "slo", "ProgramTable", "SLOPolicy", "SLOAccountant",
+    "RequestTimeline",
     "Span", "Tracer", "span", "event", "new_trace_id", "current_trace_id",
     "open_spans", "merge_rank_traces",
     "FlightRecorder", "get_flight_recorder", "install_crash_handlers",

@@ -1,0 +1,477 @@
+"""Per-program roofline attribution — WHO spent the device time, and WHY.
+
+The PR-1/PR-3 layers can see wall time (op timers, spans) but cannot say
+which *compiled program* spent it, nor whether that program is HBM-bound
+or compute-bound — exactly the information ROADMAP item 3 (close the
+0.255/0.379 MFU gap, beat 0.958x paged decode) needs to pick kernel
+targets.  This module turns BENCH_r04's one-off roofline numbers into a
+live table:
+
+- every compiled-program family (the ``program_store`` families:
+  ``prefill/<bucket>``, ``decode``, ``verify/k<k>``, ``generate.decode``,
+  ``train_step/t<n>.v<i>`` — ``t<n>`` scopes per TrainStep instance, so
+  two models training in one process never fold into one family)
+  accumulates **calls** and **device seconds** as the dispatch sites
+  record them (engine step/prefill/verify timers, ``decode_loop``,
+  ``TrainStep.__call__``).  Engine families are deliberately COARSE:
+  replicas over one model share compiled programs and should share a
+  family; heterogeneous engines in one process (different models or pool
+  shapes) fold together — pair such engines with their own process, or
+  read the per-replica serving.* histograms instead;
+- each family lazily attaches **XLA cost_analysis** flops/bytes (a
+  re-lower+compile, so it runs on demand or on a background thread —
+  never on the dispatch path, never inside a telemetry scrape);
+- the table derives achieved TFLOP/s, achieved GB/s, arithmetic
+  intensity, the **roofline regime** (bandwidth- vs compute-bound against
+  ``PADDLE_PEAK_FLOPS`` and a measured-or-configured HBM ceiling,
+  ``PADDLE_HBM_GBS``), and fraction-of-the-binding-peak.
+
+Exported three ways: ``perf.program.*`` metrics in the PR-1 registry, a
+``perf_programs`` section on ``/statusz`` (sorted by total device time),
+and :func:`report` — a ``Profiler.summary()``-style text table naming the
+top fusion/kernel candidates.
+
+"Device seconds" here are host-observed dispatch-to-sync walls at the
+recording sites (the engine syncs every iteration; ``decode_loop`` syncs
+once per generate call) — the same convention every BENCH number uses, so
+fractions-of-peak line up with the bench roofline.
+
+Ceiling resolution order (both axes): explicit :func:`set_hbm_ceiling` /
+``PADDLE_HBM_GBS`` env / datasheet-by-device-kind; ``PADDLE_PEAK_FLOPS``
+env / bf16 datasheet.  BENCH_r04 measured 456 GB/s and 126.8 TFLOP/s
+through this tunnel vs the 819 GB/s / 197 TFLOP/s v5e datasheet lines —
+export the measured numbers for honest fractions on tunneled chips.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from time import perf_counter  # noqa: F401  (recording sites' clock)
+
+# bf16 datasheet peaks per chip generation (BENCH convention: the v5e int8
+# TOPS line is NOT the bf16 peak).  Override with PADDLE_PEAK_FLOPS
+# (FLOP/s) — required on the CPU test mesh.  TrainStep's MFU gauge reads
+# the same table via peak_flops().
+PEAK_BF16_FLOPS = {"v6": 918e12, "v5p": 459e12, "v5 lite": 197e12,
+                   "v5e": 197e12, "v4": 275e12, "v3": 123e12, "v2": 45e12}
+
+# HBM bandwidth datasheet lines (bytes/s) by chip generation.  A tunneled
+# chip measures well under these (BENCH_r04: 456 GB/s vs 819 datasheet);
+# PADDLE_HBM_GBS / set_hbm_ceiling() is the production spelling.
+HBM_GBS = {"v6": 1640e9, "v5p": 2765e9, "v5 lite": 819e9, "v5e": 819e9,
+           "v4": 1228e9, "v3": 900e9, "v2": 700e9}
+
+_hbm_override = None  # set_hbm_ceiling() value (bytes/s)
+
+
+def _device_kind():
+    import jax
+
+    try:
+        return jax.devices()[0].device_kind.lower()
+    except Exception:
+        return None
+
+
+def peak_flops():
+    """Device peak FLOP/s: PADDLE_PEAK_FLOPS override, else the bf16
+    datasheet number for the visible chip kind, else None (CPU mesh)."""
+    env = os.environ.get("PADDLE_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            return None  # malformed override must not kill the caller
+    kind = _device_kind()
+    if kind:
+        for k, v in PEAK_BF16_FLOPS.items():
+            if k in kind:
+                return v
+    return None
+
+
+def hbm_ceiling():
+    """HBM ceiling in bytes/s: set_hbm_ceiling() > PADDLE_HBM_GBS env >
+    datasheet by device kind > None."""
+    if _hbm_override is not None:
+        return _hbm_override
+    env = os.environ.get("PADDLE_HBM_GBS")
+    if env:
+        try:
+            return float(env) * 1e9
+        except ValueError:
+            return None
+    kind = _device_kind()
+    if kind:
+        for k, v in HBM_GBS.items():
+            if k in kind:
+                return v
+    return None
+
+
+def set_hbm_ceiling(gbs):
+    """Record a MEASURED HBM ceiling (GB/s) — e.g. the bench roofline
+    section's number — overriding env/datasheet.  ``None`` clears it."""
+    global _hbm_override
+    _hbm_override = None if gbs is None else float(gbs) * 1e9
+
+
+def classify(flops_per_call, bytes_per_call, peak=None, hbm=None):
+    """Roofline regime of a program: its arithmetic intensity (FLOP/byte)
+    against the machine ridge point ``peak_flops / hbm_bytes_per_s``.
+    Below the ridge the program cannot reach peak FLOP/s no matter how
+    good the kernels are — HBM feeds it too slowly (bandwidth-bound);
+    above it, compute is the wall."""
+    peak = peak if peak is not None else peak_flops()
+    hbm = hbm if hbm is not None else hbm_ceiling()
+    if not flops_per_call or not bytes_per_call or not peak or not hbm:
+        return "unknown"
+    ridge = peak / hbm
+    intensity = flops_per_call / bytes_per_call
+    return "bandwidth-bound" if intensity < ridge else "compute-bound"
+
+
+class _ProgStats:
+    __slots__ = ("family", "calls", "device_seconds", "flops_per_call",
+                 "bytes_per_call", "cost_thunk", "cost_error")
+
+    def __init__(self, family):
+        self.family = family
+        self.calls = 0
+        self.device_seconds = 0.0
+        self.flops_per_call = None
+        self.bytes_per_call = None
+        self.cost_thunk = None   # lazy () -> (flops, bytes)
+        self.cost_error = None   # last thunk failure (kept, not retried)
+
+
+class ProgramTable:
+    """The live per-program attribution table (one per process by
+    default — :func:`table`).  ``record`` is the hot-path entry: one dict
+    lookup, two float adds under a per-table lock, two counter bumps."""
+
+    def __init__(self, registry=None):
+        from ..profiler import metrics as _metrics
+
+        reg = registry if registry is not None else _metrics.get_registry()
+        self._stats: dict[str, _ProgStats] = {}
+        self._lock = threading.Lock()
+        self._resolver = None
+        self._m_calls = reg.counter(
+            "perf.program.calls", "compiled-program dispatches, by family")
+        self._m_seconds = reg.counter(
+            "perf.program.device_seconds",
+            "device seconds attributed to the family (dispatch-to-sync)")
+        self._m_tflops = reg.gauge(
+            "perf.program.achieved_tflops",
+            "cost_analysis flops * calls / device seconds")
+        self._m_gbs = reg.gauge(
+            "perf.program.achieved_gbs",
+            "cost_analysis bytes * calls / device seconds")
+        self._m_frac = reg.gauge(
+            "perf.program.frac_of_peak",
+            "achieved rate over the BINDING peak (HBM when "
+            "bandwidth-bound, FLOP/s when compute-bound)")
+
+    # -------------------------------------------------------------- recording
+    def _get(self, family):
+        st = self._stats.get(family)
+        if st is None:
+            with self._lock:
+                st = self._stats.setdefault(family, _ProgStats(family))
+        return st
+
+    def record(self, family, seconds, calls=1):
+        """Attribute ``seconds`` of device time (``calls`` dispatches) to
+        a program family.  Recording sites skip compile dispatches — a
+        trace+compile wall is not device time."""
+        st = self._get(family)
+        with self._lock:
+            st.calls += calls
+            st.device_seconds += seconds
+        self._m_calls.inc(calls, program=family)
+        self._m_seconds.inc(seconds, program=family)
+
+    def needs_cost(self, family):
+        """True while the family has neither cost numbers nor a pending
+        thunk — dispatch sites use this to capture arg shapes only once."""
+        st = self._stats.get(family)
+        return st is None or (st.flops_per_call is None
+                              and st.cost_thunk is None
+                              and st.cost_error is None)
+
+    def set_cost(self, family, flops_per_call, bytes_per_call):
+        st = self._get(family)
+        with self._lock:
+            st.flops_per_call = float(flops_per_call)
+            st.bytes_per_call = float(bytes_per_call)
+            st.cost_thunk = None
+
+    def register_cost_thunk(self, family, thunk):
+        """Attach a lazy ``() -> (flops, bytes_accessed)`` (usually an XLA
+        re-lower+compile+cost_analysis — seconds of work, so it never runs
+        here; see :meth:`resolve_costs`)."""
+        st = self._get(family)
+        with self._lock:
+            if st.flops_per_call is None and st.cost_thunk is None:
+                st.cost_thunk = thunk
+
+    def resolve_costs(self):
+        """Run every pending cost thunk SYNCHRONOUSLY (tests, report,
+        bench).  A failing thunk records its error and is not retried."""
+        for st in list(self._stats.values()):
+            with self._lock:
+                thunk = st.cost_thunk
+            if thunk is None:
+                continue
+            try:
+                flops, nbytes = thunk()
+                self.set_cost(st.family, flops, nbytes)
+            except Exception as e:  # cost analysis is best-effort
+                with self._lock:
+                    st.cost_error = repr(e)
+                    st.cost_thunk = None
+
+    def _resolve_costs_async(self):
+        """Kick cost resolution on a daemon thread (telemetry scrapes must
+        stay bounded — a scrape never compiles)."""
+        with self._lock:
+            if self._resolver is not None and self._resolver.is_alive():
+                return
+            if not any(st.cost_thunk is not None
+                       for st in self._stats.values()):
+                return
+            self._resolver = threading.Thread(
+                target=self.resolve_costs, name="paddle-perf-cost-resolver",
+                daemon=True)
+            self._resolver.start()
+
+    # -------------------------------------------------------------- reading
+    def snapshot(self, resolve=False):
+        """Table rows sorted by total device time (descending), derived
+        rates and roofline regime included; refreshes the ``perf.program``
+        gauges.  ``resolve=True`` first runs pending cost thunks (slow —
+        never from a scrape; the /statusz provider instead kicks the
+        background resolver and shows what is already known)."""
+        if resolve:
+            self.resolve_costs()
+        peak, hbm = peak_flops(), hbm_ceiling()
+        rows = []
+        with self._lock:
+            stats = [(st.family, st.calls, st.device_seconds,
+                      st.flops_per_call, st.bytes_per_call, st.cost_error,
+                      st.cost_thunk is not None)
+                     for st in self._stats.values()]
+        for family, calls, secs, flops, nbytes, err, pending in stats:
+            row = {"program": family, "calls": calls,
+                   "device_seconds": secs,
+                   "flops_per_call": flops, "bytes_per_call": nbytes,
+                   "achieved_tflops": None, "achieved_gbs": None,
+                   "intensity_flop_per_byte": None,
+                   "regime": "unknown", "frac_of_peak": None}
+            if pending:
+                row["cost"] = "pending"
+            elif err is not None:
+                row["cost"] = f"error: {err}"
+            if flops and nbytes and secs > 0 and calls:
+                fps = flops * calls / secs
+                bps = nbytes * calls / secs
+                row["achieved_tflops"] = fps / 1e12
+                row["achieved_gbs"] = bps / 1e9
+                row["intensity_flop_per_byte"] = flops / nbytes
+                row["regime"] = classify(flops, nbytes, peak, hbm)
+                if row["regime"] == "bandwidth-bound" and hbm:
+                    row["frac_of_peak"] = bps / hbm
+                elif row["regime"] == "compute-bound" and peak:
+                    row["frac_of_peak"] = fps / peak
+                self._m_tflops.set(row["achieved_tflops"], program=family)
+                self._m_gbs.set(row["achieved_gbs"], program=family)
+                if row["frac_of_peak"] is not None:
+                    self._m_frac.set(row["frac_of_peak"], program=family)
+            rows.append(row)
+        rows.sort(key=lambda r: -r["device_seconds"])
+        return rows
+
+    def statusz(self):
+        """/statusz ``perf_programs`` provider: the table plus the
+        ceilings it was judged against.  A scrape NEVER compiles: with
+        ``PADDLE_PERF_COST=1`` pending costs resolve on a background
+        thread kicked here; otherwise they stay "pending" until someone
+        calls :func:`resolve_costs` / ``report()`` explicitly (a hidden
+        background XLA compile per scrape is real CPU stolen from the
+        serving process — opt in deliberately)."""
+        if os.environ.get("PADDLE_PERF_COST", "").lower() \
+                not in ("", "0", "false", "no"):
+            self._resolve_costs_async()
+        peak, hbm = peak_flops(), hbm_ceiling()
+        return {
+            "peak_tflops": peak / 1e12 if peak else None,
+            "hbm_gbs": hbm / 1e9 if hbm else None,
+            "ridge_flop_per_byte": (peak / hbm) if peak and hbm else None,
+            "programs": self.snapshot(resolve=False),
+        }
+
+    def report(self, top=3, resolve=True):
+        """Profiler.summary()-style text table + the top fusion/kernel
+        candidates (largest device-time programs, with the roofline-driven
+        recommendation: cut bytes when bandwidth-bound, cut/overlap flops
+        when compute-bound)."""
+        rows = self.snapshot(resolve=resolve)
+        head = (f"{'program':<24}{'calls':>8}{'dev s':>10}{'TFLOP/s':>10}"
+                f"{'GB/s':>9}{'I(F/B)':>9}{'of peak':>9}  regime")
+        lines = ["Per-program roofline attribution", head, "-" * len(head)]
+
+        def fmt(v, nd=2):
+            return f"{v:.{nd}f}" if v is not None else "-"
+
+        for r in rows:
+            lines.append(
+                f"{r['program']:<24}{r['calls']:>8}"
+                f"{r['device_seconds']:>10.3f}"
+                f"{fmt(r['achieved_tflops']):>10}{fmt(r['achieved_gbs'], 1):>9}"
+                f"{fmt(r['intensity_flop_per_byte'], 1):>9}"
+                f"{fmt(r['frac_of_peak'], 3):>9}  {r['regime']}")
+        cands = [r for r in rows if r["device_seconds"] > 0][:top]
+        if cands:
+            lines.append("")
+            lines.append("Top kernel/fusion candidates (by device time):")
+            for i, r in enumerate(cands, 1):
+                if r["regime"] == "bandwidth-bound":
+                    hint = ("HBM-bound: cut bytes/call — fuse producers "
+                            "into the kernel, quantize operands, raise "
+                            "arithmetic intensity")
+                elif r["regime"] == "compute-bound":
+                    hint = ("compute-bound: raise matmul utilization — "
+                            "tile for the MXU, overlap with transfers")
+                else:
+                    hint = "regime unknown: resolve cost_analysis first"
+                lines.append(f"  {i}. {r['program']} "
+                             f"({r['device_seconds']:.3f}s over "
+                             f"{r['calls']} calls) — {hint}")
+        return "\n".join(lines)
+
+    def drop_prefix(self, prefix):
+        """Evict every family under ``prefix`` (``prefix`` itself or
+        ``prefix.*``/``prefix/*``).  TrainStep registers this as a
+        weakref finalizer on its per-instance tag, so a process that
+        constructs TrainSteps in a loop does not grow the table without
+        bound (already-rendered ``perf.program.*`` registry series stay,
+        like any labelled metric's)."""
+        with self._lock:
+            for fam in [f for f in self._stats
+                        if f == prefix or f.startswith(prefix + ".")
+                        or f.startswith(prefix + "/")]:
+                del self._stats[fam]
+
+    def reset(self):
+        with self._lock:
+            self._stats.clear()
+
+
+# ------------------------------------------------------- process-wide table
+_TABLE = None
+_TABLE_LOCK = threading.Lock()
+_PROVIDER_REGISTERED = False
+
+
+def table() -> ProgramTable:
+    global _TABLE
+    if _TABLE is None:
+        with _TABLE_LOCK:
+            if _TABLE is None:
+                _TABLE = ProgramTable()
+    return _TABLE
+
+
+def _ensure_provider():
+    """Register the /statusz ``perf_programs`` section once, lazily on
+    first record — a process that never dispatches never grows the key."""
+    global _PROVIDER_REGISTERED
+    if _PROVIDER_REGISTERED:
+        return
+    with _TABLE_LOCK:
+        if _PROVIDER_REGISTERED:
+            return
+        from . import telemetry as _telemetry
+
+        _telemetry.add_status_provider("perf_programs",
+                                       lambda: table().statusz())
+        _PROVIDER_REGISTERED = True
+
+
+def record(family, seconds, calls=1):
+    """Module-level spelling of :meth:`ProgramTable.record` on the process
+    table (the one dispatch sites use)."""
+    _ensure_provider()
+    table().record(family, seconds, calls)
+
+
+def needs_cost(family):
+    return table().needs_cost(family)
+
+
+def register_cost_thunk(family, thunk):
+    table().register_cost_thunk(family, thunk)
+
+
+def snapshot(resolve=False):
+    return table().snapshot(resolve=resolve)
+
+
+def resolve_costs():
+    table().resolve_costs()
+
+
+def report(top=3, resolve=True):
+    return table().report(top=top, resolve=resolve)
+
+
+def reset():
+    """Tests: drop accumulated attribution (the table object and its
+    registered provider survive)."""
+    if _TABLE is not None:
+        _TABLE.reset()
+
+
+# ------------------------------------------------- cost-thunk construction
+def _shape_struct(v):
+    import jax
+
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is None or dtype is None:
+        return v
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def jit_cost_thunk(jitted, args):
+    """Build a lazy cost thunk for a ``jax.jit``-ed callable from the
+    concrete args of one dispatch: shapes/dtypes are captured NOW (cheap;
+    donated buffers keep their metadata), the re-lower+compile+
+    cost_analysis runs only when the table resolves costs.
+
+    The program is held by WEAKREF: the process-wide table outlives any
+    one engine/model, and a pending thunk must not pin a dead model's
+    params (the jitted closure reaches them) until someone happens to
+    resolve costs."""
+    import weakref
+
+    import jax
+
+    shapes = jax.tree_util.tree_map(_shape_struct, args)
+    ref = weakref.ref(jitted)
+
+    def thunk():
+        fn = ref()
+        if fn is None:
+            raise RuntimeError(
+                "compiled program was garbage-collected before its "
+                "cost_analysis resolved")
+        comp = fn.lower(*shapes).compile()
+        ca = comp.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        return (float(ca.get("flops", 0.0)),
+                float(ca.get("bytes accessed", 0.0)))
+
+    return thunk

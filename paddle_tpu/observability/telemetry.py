@@ -22,7 +22,7 @@ import json
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from time import time as _wall
+from time import perf_counter as _pc, time as _wall
 
 from ..profiler import metrics as _metrics
 from . import faults as _faults
@@ -104,6 +104,14 @@ class TelemetryServer:
         if self._httpd is not None:
             return self
         server = self
+        # self-observation: every scrape's render+send wall time, by path.
+        # The PR-3 signal-path rule says providers never hold engine or
+        # scheduler locks across a render — this histogram is how the
+        # under-load regression test (and an operator) checks that scrapes
+        # actually stay bounded while the engine is mid-decode.
+        self._m_scrape = _metrics.histogram(
+            "telemetry.scrape_seconds",
+            "telemetry endpoint render+send wall time, by path")
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # no stderr chatter per scrape
@@ -119,6 +127,7 @@ class TelemetryServer:
 
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
+                t0 = _pc()
                 try:
                     if path == "/metrics":
                         self._send(200, server._metrics_text(),
@@ -143,6 +152,16 @@ class TelemetryServer:
                                    "application/json")
                     except Exception:
                         pass
+                finally:
+                    try:
+                        # bounded label set: arbitrary 404 paths (a port
+                        # scanner on a non-loopback bind) must not mint
+                        # permanent series in the process-wide registry
+                        known = path if path in ("/metrics", "/healthz",
+                                                 "/statusz") else "other"
+                        server._m_scrape.observe(_pc() - t0, path=known)
+                    except Exception:
+                        pass  # self-observation must not break a scrape
 
         self._httpd = ThreadingHTTPServer((self.host, self._requested_port),
                                           Handler)

@@ -204,6 +204,40 @@ class Histogram(_Metric):
     def _new_child(self, labels):
         return _HistogramChild(labels, self._buckets)
 
+    @property
+    def buckets(self):
+        return self._buckets
+
+    def set_buckets(self, buckets):
+        """Re-edge this metric: future children use the new buckets, and
+        existing UNOBSERVED children are rebuilt on them.  Children that
+        already hold observations keep their old edges — cumulative bucket
+        counts cannot be rebinned after the fact — with a loud warning, so
+        configure buckets before traffic flows (e.g. the serving engine
+        aligns ``serving.ttft/itl`` edges with its SLO thresholds at
+        construction)."""
+        new = tuple(sorted(set(float(b) for b in buckets)))
+        if not new:
+            raise ValueError("set_buckets needs at least one edge")
+        with self._lock:
+            if new == self._buckets:
+                return
+            self._buckets = new
+            observed = []
+            for key, c in list(self._children.items()):
+                if c.count:
+                    observed.append(c.labels)
+                    continue
+                self._children[key] = _HistogramChild(c.labels, new)
+        if observed:
+            import warnings
+
+            warnings.warn(
+                f"histogram {self.name!r}: set_buckets left "
+                f"{len(observed)} already-observed child(ren) on their old "
+                f"edges (counts cannot be rebinned): {observed}",
+                stacklevel=2)
+
     def observe(self, value, **labels):
         self.labels(**labels).observe(value)
 
@@ -255,7 +289,21 @@ class MetricsRegistry:
         return self._get_or_create(Gauge, name, help)
 
     def histogram(self, name, help="", buckets=None) -> Histogram:
-        return self._get_or_create(Histogram, name, help, buckets=buckets)
+        """Get-or-create; ``buckets`` on an EXISTING metric MERGES the
+        requested edges into the current ones via
+        :meth:`Histogram.set_buckets` (per-metric configurable edges —
+        instrumented modules can align a shared histogram's buckets with
+        their thresholds without coordinating creation order, and two
+        callers with different thresholds both keep theirs: replacement
+        here would silently destroy the first caller's alignment).
+        ``set_buckets`` itself stays a full replacement for deliberate
+        re-edging."""
+        h = self._get_or_create(Histogram, name, help, buckets=buckets)
+        if buckets is not None:
+            merged = set(h.buckets) | {float(b) for b in buckets}
+            if merged != set(h.buckets):
+                h.set_buckets(merged)
+        return h
 
     def get(self, name):
         return self._metrics.get(name)

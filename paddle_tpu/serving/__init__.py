@@ -32,6 +32,7 @@ from .engine import (  # noqa: F401
     EngineStoppedError, Request, RequestHandle, RequestRejectedError,
     SamplingParams, ServingEngine,
 )
+from ..observability.slo import SLOPolicy  # noqa: F401  (engine/cluster slo=)
 from .speculative import NgramDrafter, make_verifier  # noqa: F401
 from .cluster import (  # noqa: F401
     ClusterHandle, PrefixAffinityRouter, ReplicaPool, RouteDecision,
@@ -43,5 +44,5 @@ __all__ = [
     "EngineStoppedError", "SamplingParams", "BlockManager", "PageAllocation",
     "GPTAdapter", "ContinuousBatchingPredictor", "NgramDrafter",
     "make_verifier", "ServingCluster", "ClusterHandle", "ReplicaPool",
-    "PrefixAffinityRouter", "RouteDecision",
+    "PrefixAffinityRouter", "RouteDecision", "SLOPolicy",
 ]

@@ -101,7 +101,7 @@ class ServingCluster:
     def __init__(self, model=None, replicas=2, devices=None, pool=None,
                  router=None, policy="affinity", affinity_tokens=None,
                  saturation_queue=None, seed=0, max_reroutes=None,
-                 poll_s=0.002, replica_prefix="", name=None,
+                 poll_s=0.002, replica_prefix="", name=None, slo=None,
                  **engine_kwargs):
         if pool is None:
             if model is None:
@@ -109,6 +109,11 @@ class ServingCluster:
             # replicas report on /healthz but don't gate it — this
             # cluster's own any-replica-routable component does
             engine_kwargs.setdefault("health_gating", False)
+            if slo is not None:
+                # per-replica accounting too: each engine evaluates the
+                # legs it served under its replica= label (a prebuilt
+                # pool= configures its own engines)
+                engine_kwargs.setdefault("slo", slo)
             pool = ReplicaPool(model, replicas=replicas, devices=devices,
                                replica_prefix=replica_prefix,
                                **engine_kwargs)
@@ -149,6 +154,16 @@ class ServingCluster:
         self._aff_hits = 0
         self._aff_misses = 0
         self._rerouted_total = 0
+        # cluster-wide SLO accounting over the OUTER handles: failover
+        # legs and reroute overhead land here, not in any one replica's
+        # numbers (serving.slo.* series carry cluster=<name>)
+        self._slo = None
+        if slo is not None:
+            from ...observability.slo import SLOAccountant, SLOPolicy
+
+            if not isinstance(slo, SLOPolicy):
+                raise TypeError(f"slo must be an SLOPolicy, got {slo!r}")
+            self._slo = SLOAccountant(slo, cluster=self.name)
 
         # every cluster.* series carries cluster=<name> (default "0") so
         # two pools in one process keep distinct series, mirroring the
@@ -329,11 +344,14 @@ class ServingCluster:
         last_rejection = None
         for idx in order:
             eng = self._pool.engines[idx]
+            # the full RouteDecision rides the span as REAL attributes
+            # (OTLP/chrome export them as-is), so failover forensics read
+            # affine/hit/reason off the trace instead of grepping logs
             with _tracing.span("cluster.route", trace_id=h.trace_id,
                                request_id=h.request_id, replica=eng.replica,
                                affine=self._pool.engines[dec.affine].replica,
-                               policy=dec.policy, reason=dec.reason,
-                               leg=h._legs + 1):
+                               hit=idx == dec.affine, policy=dec.policy,
+                               reason=dec.reason, leg=h._legs + 1):
                 try:
                     inner = eng.submit(
                         prompt, max_new_tokens=max_new,
@@ -401,9 +419,13 @@ class ServingCluster:
                 self._finish_outer(h, "error")
 
     def _forward_token(self, h, tok):
+        now = time.time()
         if h.first_token_at is None:
-            h.first_token_at = time.time()
+            h.first_token_at = now
         h.token_ids.append(tok)
+        # outer token timeline: what the CALLER observed, including any
+        # cross-replica failover gap (the cluster's SLO truth)
+        h.token_times.append(now)
         h._events.put(("token", tok))
 
     def _on_leg_done(self, h, inner, status):
@@ -448,6 +470,9 @@ class ServingCluster:
     def _finish_outer(self, h, status):
         h.status = status
         h.finished_at = time.time()
+        if self._slo is not None and status in ("completed", "expired"):
+            self._slo.observe(h, met_override=False
+                              if status == "expired" else None)
         with self._lock:
             self._inflight.discard(h)
             self._m_inflight.set(len(self._inflight))
@@ -488,6 +513,11 @@ class ServingCluster:
         return self._router
 
     @property
+    def slo_accountant(self):
+        """Cluster-wide SLO accountant (None unless ``slo=`` was set)."""
+        return self._slo
+
+    @property
     def engines(self):
         return self._pool.engines
 
@@ -496,8 +526,12 @@ class ServingCluster:
         return self._aff_hits / total if total else None
 
     def stats(self):
-        with self._lock:
-            inflight = len(self._inflight)
+        # LOCKLESS snapshot (len() is atomic enough for a diagnostic):
+        # /statusz renders this while callers and the monitor churn, and a
+        # scrape must never queue behind — or hold — the cluster lock
+        # (PR-3 signal-path rule, asserted by the telemetry-under-load
+        # test)
+        inflight = len(self._inflight)
         return {
             "replicas": self._pool.stats(),
             "policy": self._router.policy,
@@ -514,6 +548,8 @@ class ServingCluster:
         st = self.stats()
         st["started"] = self._started
         st["health"] = self.health_state()
+        if self._slo is not None:
+            st["slo"] = self._slo.summary()
         per = {}
         for snap, e in zip(self._pool.states(), self._pool.engines):
             per[e.replica] = {

@@ -74,6 +74,7 @@ import jax
 import numpy as np
 
 from ..observability import faults as _faults
+from ..observability import perf as _perf
 from ..observability import tracing as _tracing
 from ..resilience.retry import EngineStoppedError, classify_failure  # noqa: F401 — re-exported
 from .adapter import GPTAdapter
@@ -139,6 +140,9 @@ class RequestHandle:
         # (submit -> prefill -> each decode iteration) carries/links it
         self.trace_id = _tracing.new_trace_id()
         self.token_ids = []            # generated ids (appended by the engine)
+        # wall-clock stamp of every emission — the request's token-level
+        # timeline (observability.slo evaluates TTFT/ITL/e2e targets on it)
+        self.token_times = []
         self.status = "queued"
         self.submitted_at = time.time()
         self.first_token_at = None
@@ -236,7 +240,7 @@ class ServingEngine:
                  telemetry_port=None, max_engine_restarts=3,
                  degraded_stall_s=2.0, restart_cooldown_s=10.0,
                  speculative_k=0, draft_max_ngram=3, draft_min_ngram=1,
-                 replica="0", device=None, health_gating=True):
+                 replica="0", device=None, health_gating=True, slo=None):
         self._model = model
         # replica identity (cluster serving): stamps every serving.* metric
         # series with a replica= label so N engines in one process don't
@@ -355,11 +359,34 @@ class ServingEngine:
 
         from ..profiler import metrics as _metrics
 
+        # request-level SLO accounting (observability.slo): evaluate every
+        # finished request's token timeline against the policy, export
+        # rolling attainment/burn-rate/goodput gauges per replica
+        self._slo = None
+        ttft_buckets = itl_buckets = None
+        if slo is not None:
+            from ..observability.slo import (SLOAccountant, SLOPolicy,
+                                             slo_histogram_buckets)
+
+            if not isinstance(slo, SLOPolicy):
+                raise TypeError(f"slo must be an SLOPolicy, got {slo!r}")
+            self._slo = SLOAccountant(slo, replica=self.replica)
+            # align the latency histogram edges with the SLO thresholds so
+            # "fraction of samples under target" reads straight off the
+            # Prometheus _bucket series
+            if slo.ttft_s:
+                ttft_buckets = slo_histogram_buckets(
+                    _metrics._DEFAULT_BUCKETS, slo.ttft_s)
+            if slo.itl_s:
+                itl_buckets = slo_histogram_buckets(
+                    _metrics._DEFAULT_BUCKETS, slo.itl_s)
+
         # every serving.* series carries replica=<id> (default "0") so N
         # engines in one process keep distinct series; per-call labels like
         # status=/reason= merge on top of it (metrics.bind)
-        def _h(name, help):
-            return _metrics.bind(_metrics.histogram(name, help),
+        def _h(name, help, buckets=None):
+            return _metrics.bind(_metrics.histogram(name, help,
+                                                    buckets=buckets),
                                  replica=self.replica)
 
         def _g(name, help):
@@ -370,9 +397,11 @@ class ServingEngine:
             return _metrics.bind(_metrics.counter(name, help),
                                  replica=self.replica)
 
-        self._m_ttft = _h("serving.ttft_seconds", "submit -> first token")
+        self._m_ttft = _h("serving.ttft_seconds", "submit -> first token",
+                          buckets=ttft_buckets)
         self._m_itl = _h(
-            "serving.inter_token_seconds", "per-sequence inter-token latency")
+            "serving.inter_token_seconds", "per-sequence inter-token latency",
+            buckets=itl_buckets)
         self._m_step_seconds = _h(
             "serving.step_seconds", "one batched decode iteration")
         self._m_prefill_seconds = _h(
@@ -959,6 +988,14 @@ class ServingEngine:
         temps = np.asarray([req.sampling.temperature], np.float32)
         prog, traces = self._prefill_program(s_pad)
         n0 = traces[0]
+        rkey = self._next_key()
+        fam = f"prefill/{s_pad}"
+        if _perf.needs_cost(fam):
+            # capture arg shapes ONCE per family; the cost_analysis
+            # re-lower+compile itself runs lazily, off this thread
+            _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
+                prog, (self._params, self._bufs, ids, *self._pools,
+                       table, lens, temps, rkey)))
         # first dispatch of this program = minutes-long XLA compile: flag it
         # so the serving watchdog doesn't read a legitimate compile stall
         # as a wedged scheduler
@@ -970,8 +1007,7 @@ class ServingEngine:
                                request_id=req.handle.request_id,
                                slot=slot_idx, prompt_len=S0):
                 tok, kp, vp = prog(self._params, self._bufs, ids,
-                                   *self._pools, table, lens, temps,
-                                   self._next_key())
+                                   *self._pools, table, lens, temps, rkey)
                 self._pools = (kp, vp)
                 tok = int(np.asarray(tok)[0])
         finally:
@@ -979,6 +1015,10 @@ class ServingEngine:
             self._progress_t = time.monotonic()
         if traces[0] > n0:
             self._m_prefill_traces.inc(traces[0] - n0)
+        elif traces[0]:
+            # warm dispatch: attribute its device time to the program
+            # family (a trace+compile wall is not device time — skipped)
+            _perf.record(fam, time.perf_counter() - t0)
         self._m_prefill_seconds.observe(time.perf_counter() - t0)
         slot = _Slot(req, alloc, table_row)
         slot.last = tok
@@ -1029,6 +1069,11 @@ class ServingEngine:
     def _plain_step(self, active):
         prog, traces = self._step_program()
         n0 = traces[0]
+        rkey = self._step_key()
+        if _perf.needs_cost("decode"):
+            _perf.register_cost_thunk("decode", _perf.jit_cost_thunk(
+                prog, (self._params, self._bufs, self._h_last, *self._pools,
+                       self._h_table, self._h_lens, self._h_temps, rkey)))
         if _tracing._ACTIVE:
             # one span per batched iteration, LINKING every active
             # request's trace id (a decode step serves many traces at once
@@ -1045,7 +1090,7 @@ class ServingEngine:
             with cm:
                 tok, kp, vp = prog(self._params, self._bufs, self._h_last,
                                    *self._pools, self._h_table, self._h_lens,
-                                   self._h_temps, self._step_key())
+                                   self._h_temps, rkey)
                 self._pools = (kp, vp)
                 tok = np.asarray(tok)
         finally:
@@ -1053,6 +1098,8 @@ class ServingEngine:
             self._progress_t = time.monotonic()
         if traces[0] > n0:
             self._m_step_traces.inc(traces[0] - n0)
+        else:
+            _perf.record("decode", time.perf_counter() - t0)
         self._m_step_seconds.observe(time.perf_counter() - t0)
         self._iteration += 1
         for i in active:
@@ -1098,6 +1145,13 @@ class ServingEngine:
             return self._plain_step(active)
         prog, traces = self._verify_program()
         n0 = traces[0]
+        rkey = self._step_key()
+        fam = f"verify/k{K}"
+        if _perf.needs_cost(fam):
+            _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
+                prog, (self._params, self._bufs, self._h_ids, *self._pools,
+                       self._h_table, self._h_lens, self._h_dlen,
+                       self._h_temps, rkey)))
         if _tracing._ACTIVE:
             cm = _tracing.span(
                 "serving.verify_step", iteration=self._iteration,
@@ -1113,7 +1167,7 @@ class ServingEngine:
                 targets, accept, kp, vp = prog(
                     self._params, self._bufs, self._h_ids, *self._pools,
                     self._h_table, self._h_lens, self._h_dlen,
-                    self._h_temps, self._step_key())
+                    self._h_temps, rkey)
                 self._pools = (kp, vp)
                 targets = np.asarray(targets)
                 accept = np.asarray(accept)
@@ -1122,6 +1176,8 @@ class ServingEngine:
             self._progress_t = time.monotonic()
         if traces[0] > n0:
             self._m_verify_traces.inc(traces[0] - n0)
+        else:
+            _perf.record(fam, time.perf_counter() - t0)
         self._m_step_seconds.observe(time.perf_counter() - t0)
         self._iteration += 1
         proposed = accepted = 0
@@ -1177,6 +1233,7 @@ class ServingEngine:
             self._m_itl.observe(now - slot.last_token_t)
         slot.last_token_t = now
         h.token_ids.append(tok)
+        h.token_times.append(now)
         h._events.put(("token", tok))
         self._m_tokens.inc()
 
@@ -1239,6 +1296,13 @@ class ServingEngine:
             dur = handle.finished_at - handle.submitted_at
             self._ema_request_s = dur if self._ema_request_s is None \
                 else 0.8 * self._ema_request_s + 0.2 * dur
+        if self._slo is not None and status in ("completed", "expired"):
+            # expired = the deadline preempted it: an SLO miss by
+            # definition, whatever its timeline says.  cancelled/stopped/
+            # error requests are excluded — they measure the caller or the
+            # engine, not the latency promise.
+            self._slo.observe(handle, met_override=False
+                              if status == "expired" else None)
         self._m_requests.inc(status=status)
         handle._events.put(("done", status))
         handle._done.set()
@@ -1303,6 +1367,11 @@ class ServingEngine:
         return self._bm
 
     @property
+    def slo_accountant(self):
+        """The replica's SLO accountant (None unless ``slo=`` was set)."""
+        return self._slo
+
+    @property
     def acceptance_rate(self):
         """Lifetime speculative acceptance (None before any proposal)."""
         if not self._spec_proposed_total:
@@ -1340,6 +1409,8 @@ class ServingEngine:
         st["engine_restarts"] = self._engine_restarts
         st["draining"] = self._draining
         st["typical_request_s"] = self._ema_request_s
+        if self._slo is not None:
+            st["slo"] = self._slo.summary()
         if self._progress_t is not None:
             st["last_progress_age_s"] = time.monotonic() - self._progress_t
         slots = []

@@ -116,6 +116,7 @@ def decode_loop(model, fwd, ids0, max_new_tokens, init_cache,
     if program_key is not None:
         store = program_store(model)
         progs = store.get(program_key)
+    warm = progs is not None  # cached pair: no trace/compile in this call
     if progs is None:
         sample = make_sampler(temperature, top_k, top_p)
 
@@ -134,11 +135,23 @@ def decode_loop(model, fwd, ids0, max_new_tokens, init_cache,
             store[program_key] = progs
     prefill, step = progs
 
+    from time import perf_counter
+
+    from ...observability import perf as _perf
+
     try:
         cache = init_cache()
         base = jax.random.key(seed if seed is not None else 0)
         key0 = jax.random.fold_in(base, 0)
+        t_loop = perf_counter()
         nxt, cache = prefill(params, bufs, jnp.asarray(ids0), cache, key0)
+        if store is not None and _perf.needs_cost("generate.decode"):
+            # per-token roofline attribution for the generate() path: one
+            # representative step program's cost (shapes captured here,
+            # the re-lower+compile runs lazily off this path)
+            _perf.register_cost_thunk("generate.decode", _perf.jit_cost_thunk(
+                step, (params, bufs, nxt[:, None].astype(jnp.int64), cache,
+                       np.int32(S0), key0)))
         # tokens stay ON DEVICE across the loop: async dispatch queues every
         # step without a host round-trip (through a tunneled TPU, a per-token
         # np.asarray sync made RTT — not step time — the decode bottleneck),
@@ -155,6 +168,12 @@ def decode_loop(model, fwd, ids0, max_new_tokens, init_cache,
                               key0 if greedy else jax.random.fold_in(base, t))
             out.append(nxt[:, None])
         new = np.asarray(jnp.concatenate(out, axis=1))
+        if warm:
+            # whole pipelined loop (prefill + steps + the one sync),
+            # attributed per emitted token; cold calls are trace+compile
+            # walls, not device time, and are skipped
+            _perf.record("generate.decode", perf_counter() - t_loop,
+                         calls=max_new_tokens)
     finally:
         for m, tr in modes:
             m.training = tr
