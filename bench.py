@@ -299,6 +299,130 @@ def _measure_serving_speculative(spec_k=0, n_requests=8, num_slots=4, S0=32,
     }
 
 
+def _measure_serving_quant(kv_dtype="bf16", n_requests=60, budget_slots=4,
+                           S0=24, page_size=8, max_new=96, train_steps=150,
+                           model_kwargs=None):
+    """ONE arm of the quantized-serving comparison (kv_dtype="bf16" is the
+    full-precision baseline — the pools follow the model dtype, so f32 on
+    a CPU run; the ``pool_dtype`` field records what actually ran): decode
+    tokens/sec and ITL p50/p95 over a decode-heavy workload (short
+    prompts, long generations), plus the full greedy ids so the parent
+    can score top-1 agreement across arms.
+
+    THE BUDGET IS THE EXPERIMENT: both arms get the same page-pool HBM
+    budget (``budget_slots`` full-residency sequences in the
+    full-precision layout), each sizes its pool AND its slot count to
+    what its own bytes/page fits into that budget — exactly how a
+    per-chip deployment is sized.  The int8 layout fits ~2x the bf16
+    slots (~3.8x vs f32), so the same traffic runs in fewer, wider
+    decode waves: the occupancy win IS the aggregate-throughput win, on
+    top of the HBM-bandwidth win the Pallas kernel sees on TPU.  The
+    default n_requests=60 divides both arms' wave widths on the CPU
+    reference shapes (4-wide f32 waves, 15-wide int8 waves) so neither
+    arm pays a mostly-idle ragged tail batch.  Each arm runs in its own
+    subprocess (fresh registry, fresh device state).
+
+    The model keeps head_dim=64 (production-shaped): the int8 layout's
+    per-(slot, head) f32 scales cost 4/d of the payload, so bytes/page
+    are (d+4)/2d of bf16 — 1.88x more pages per byte at d=64."""
+    import time
+
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.adapter import GPTAdapter
+    from paddle_tpu.serving.quant import QuantizedGPTAdapter
+
+    kw = dict(model_kwargs or {})
+    kw.setdefault("num_attention_heads", 2)   # hidden 128 / 2 -> d=64
+    m, cyc, period = _overfit_cyclic_gpt(kw, train_steps=train_steps)
+    prompts = [cyc[i % period:i % period + S0] for i in range(n_requests)]
+    max_len = S0 + max_new
+    pages_per_req = -(-max_len // page_size)
+    kv = None if kv_dtype in ("bf16", "native") else kv_dtype
+
+    # the FIXED budget, derived from model dims only (identical across
+    # arms): budget_slots full-residency sequences in the baseline layout
+    base_bpp = GPTAdapter(m, page_size).page_bytes()
+    budget_bytes = budget_slots * pages_per_req * base_bpp
+    arm_bpp = (QuantizedGPTAdapter(m, page_size) if kv
+               else GPTAdapter(m, page_size)).page_bytes()
+    num_pages = budget_bytes // arm_bpp
+    num_slots = max(1, min(n_requests, num_pages // pages_per_req))
+
+    engine = ServingEngine(m, num_slots=num_slots, page_size=page_size,
+                           max_model_len=max_len, num_pages=num_pages,
+                           kv_dtype=kv)
+    with engine:
+        engine.generate(prompts[0], max_new_tokens=4, timeout=600)  # compile
+        t0 = time.time()
+        handles = [engine.submit(p, max_new_tokens=max_new) for p in prompts]
+        ids = [h.result(timeout=600) for h in handles]
+        dt = time.time() - t0
+        resident = engine.block_manager.max_resident_sequences(
+            max_len, budget_bytes=budget_bytes)
+        stats = engine.stats()
+
+    total = n_requests * max_new
+    return {
+        "kv_dtype": kv_dtype,
+        "tokens": total,
+        "tokens_per_sec": round(total / dt, 2),
+        "itl_p50_s": _metric_quantile("serving.inter_token_seconds", 0.5,
+                                      replica="0"),
+        "itl_p95_s": _metric_quantile("serving.inter_token_seconds", 0.95,
+                                      replica="0"),
+        "bytes_per_page": stats["bytes_per_page"],
+        "kv_bytes_per_token": stats["kv_bytes_per_token"],
+        "pool_dtype": stats["pool_dtype"],
+        "budget_bytes": int(budget_bytes),
+        "num_pages_at_budget": int(num_pages),
+        "num_slots": num_slots,
+        "max_resident_slots_at_budget": resident,
+        "ids": [list(map(int, r)) for r in ids],
+    }
+
+
+def _serving_quant_report(kv_dtype="int8"):
+    """Both arms (separate subprocesses via _section) + the ISSUE-8
+    acceptance numbers: int8 tokens/sec vs bf16 on the decode-heavy
+    workload, top-1 agreement of the int8 greedy stream against the
+    full-precision one, and the resident-slot ratio at an identical
+    page-pool HBM budget (>= 1.8x is the acceptance bar at d=64)."""
+    base = _section("serving_quant", BENCH_KV_DTYPE="bf16")
+    quant = _section("serving_quant", BENCH_KV_DTYPE=str(kv_dtype))
+    match = total = 0
+    for r, g in zip(base["ids"], quant["ids"]):
+        n = min(len(r), len(g))
+        total += max(len(r), len(g))
+        match += sum(1 for i in range(n) if r[i] == g[i])
+    out = {
+        "kv_dtype": str(kv_dtype),
+        "tokens": quant["tokens"],
+        "bf16_tokens_per_sec": base["tokens_per_sec"],
+        "int8_tokens_per_sec": quant["tokens_per_sec"],
+        "int8_vs_bf16": round(quant["tokens_per_sec"]
+                              / max(base["tokens_per_sec"], 1e-9), 3),
+        "top1_agreement": round(match / total, 4) if total else None,
+        "bf16_itl_p50_s": base["itl_p50_s"],
+        "bf16_itl_p95_s": base["itl_p95_s"],
+        "int8_itl_p50_s": quant["itl_p50_s"],
+        "int8_itl_p95_s": quant["itl_p95_s"],
+        "bf16_bytes_per_page": base["bytes_per_page"],
+        "int8_bytes_per_page": quant["bytes_per_page"],
+        "budget_bytes": quant["budget_bytes"],
+        "bf16_resident_slots": base["max_resident_slots_at_budget"],
+        "int8_resident_slots": quant["max_resident_slots_at_budget"],
+        "resident_slot_ratio": round(
+            quant["max_resident_slots_at_budget"]
+            / max(base["max_resident_slots_at_budget"], 1), 3),
+        "note": ("int8 paged KV pools (per-(slot,head) scale pools, "
+                 "dequant fused into the paged kernel) vs the "
+                 "full-precision engine on a decode-heavy workload; BOTH "
+                 "arms size pool + slots into ONE page-pool HBM budget, "
+                 "so the occupancy win shows up as aggregate tokens/sec"),
+    }
+    return out
+
+
 def _measure_serving_cluster(replicas=1, policy="affinity", n_requests=16,
                              num_slots=4, S0=48, page_size=16, max_new=64,
                              prefix_groups=4, model_kwargs=None,
@@ -610,6 +734,11 @@ def _run_section(name):
 
         return _measure_serving_speculative(
             spec_k=int(os.environ.get("BENCH_SPEC_K", "0")))
+    if name == "serving_quant":
+        import os
+
+        return _measure_serving_quant(
+            kv_dtype=os.environ.get("BENCH_KV_DTYPE", "bf16"))
     if name == "serving_cluster":
         import os
 
@@ -913,10 +1042,20 @@ def main():
         # same hygiene as the per-section subprocesses of the full run)
         spec_k = _spec_k_from_argv()
         n_replicas = _replicas_from_argv()
+        kv_dtype = _argv_value("--kv-dtype")
         if n_replicas:
             # --replicas N: the multi-replica cluster (prefix-affinity
             # router) vs a single replica and vs random routing
             out = {"serving_cluster": _serving_cluster_report(n_replicas)}
+        elif kv_dtype and kv_dtype not in ("bf16", "native"):
+            # --kv-dtype int8: the quantized-pool engine vs the
+            # full-precision engine on a decode-heavy workload (tokens/sec,
+            # ITL, resident slots at a fixed HBM budget, top-1 agreement)
+            out = {"serving_quant": _serving_quant_report(kv_dtype)}
+        elif kv_dtype:
+            # --kv-dtype bf16: the baseline arm alone (sanity/debug)
+            out = {"serving_quant_bf16": _section(
+                "serving_quant", BENCH_KV_DTYPE="bf16")}
         elif spec_k:
             # --speculative k: n-gram-draft + multi-token-verify engine vs
             # the non-speculative engine on a repetitive-suffix workload

@@ -8,7 +8,8 @@ targets.  This module turns BENCH_r04's one-off roofline numbers into a
 live table:
 
 - every compiled-program family (the ``program_store`` families:
-  ``prefill/<bucket>``, ``decode``, ``verify/k<k>``, ``generate.decode``,
+  ``prefill/<bucket>``, ``decode``, ``verify/k<k>`` — with an ``@int8``
+  suffix when the engine serves quantized KV pools — ``generate.decode``,
   ``train_step/t<n>.v<i>`` — ``t<n>`` scopes per TrainStep instance, so
   two models training in one process never fold into one family)
   accumulates **calls** and **device seconds** as the dispatch sites
@@ -130,6 +131,49 @@ def classify(flops_per_call, bytes_per_call, peak=None, hbm=None):
     ridge = peak / hbm
     intensity = flops_per_call / bytes_per_call
     return "bandwidth-bound" if intensity < ridge else "compute-bound"
+
+
+#: serving-engine program families whose bytes are dominated by the paged
+#: KV cache — the ones int8 pools (kv_dtype="int8") directly shrink
+_KV_BOUND_FAMILIES = ("decode", "prefill/", "verify/")
+
+
+def is_quantized_family(family):
+    """True for the quantized serving program families — the engine
+    attributes its int8-pool programs as ``decode@int8``,
+    ``prefill/<bucket>@int8``, ``verify/k<k>@int8``."""
+    return "@int8" in family
+
+
+def candidate_hint(family, regime):
+    """The regime-driven recommendation :meth:`ProgramTable.report` prints
+    for a top device-time program.  Recognizes the quantized serving
+    families: a bandwidth-bound UNQUANTIZED serving program's first lever
+    is int8 KV pools (dequant fuses into the paged kernel — the
+    serving.quant subsystem); an ``@int8`` family has already pulled it,
+    so the hint points at the remaining byte traffic instead."""
+    quant = is_quantized_family(family)
+    serving = family.split("@")[0].startswith(_KV_BOUND_FAMILIES)
+    if regime == "bandwidth-bound":
+        if quant:
+            return ("HBM-bound int8 serving program: KV dequant already "
+                    "fused in-kernel — cut the remaining bytes (int8 "
+                    "weights via weight_dtype, larger pages, more slots "
+                    "per dispatch)")
+        if serving:
+            return ("HBM-bound serving program: quantize the KV pools "
+                    "(kv_dtype=\"int8\" — dequant fuses into the paged "
+                    "kernel, ~2x fewer cache bytes/call), fuse producers, "
+                    "raise arithmetic intensity")
+        return ("HBM-bound: cut bytes/call — fuse producers into the "
+                "kernel, quantize operands, raise arithmetic intensity")
+    if regime == "compute-bound":
+        return ("compute-bound: raise matmul utilization — tile for the "
+                "MXU, overlap with transfers")
+    if quant:
+        return ("regime unknown (resolve cost_analysis first); int8 "
+                "serving program — KV dequant already fused in-kernel")
+    return "regime unknown: resolve cost_analysis first"
 
 
 class _ProgStats:
@@ -337,18 +381,10 @@ class ProgramTable:
             lines.append("")
             lines.append("Top kernel/fusion candidates (by device time):")
             for i, r in enumerate(cands, 1):
-                if r["regime"] == "bandwidth-bound":
-                    hint = ("HBM-bound: cut bytes/call — fuse producers "
-                            "into the kernel, quantize operands, raise "
-                            "arithmetic intensity")
-                elif r["regime"] == "compute-bound":
-                    hint = ("compute-bound: raise matmul utilization — "
-                            "tile for the MXU, overlap with transfers")
-                else:
-                    hint = "regime unknown: resolve cost_analysis first"
                 lines.append(f"  {i}. {r['program']} "
                              f"({r['device_seconds']:.3f}s over "
-                             f"{r['calls']} calls) — {hint}")
+                             f"{r['calls']} calls) — "
+                             f"{candidate_hint(r['program'], r['regime'])}")
         return "\n".join(lines)
 
     def drop_prefix(self, prefix):

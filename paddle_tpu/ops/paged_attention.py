@@ -136,6 +136,23 @@ def _paged_pallas(q, k_pages, v_pages, page_table, seq_lens, scale,
     return out.astype(q.dtype)
 
 
+def _gathered_attend(q, k, v, seq_lens, scale):
+    """The dense-reference math shared by the bf16 and int8 fallbacks:
+    q [B, H, D] against gathered k/v [B, T, HKV, D] masked by seq_lens."""
+    B, H, D = q.shape
+    HKV = k.shape[2]
+    if HKV != H:
+        k = jnp.repeat(k, H // HKV, axis=2)
+        v = jnp.repeat(v, H // HKV, axis=2)
+    s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    pos = jnp.arange(k.shape[1])[None, None, :]
+    s = jnp.where(pos < seq_lens[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bht,bthd->bhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
 def paged_attention_ref(q, k_pages, v_pages, page_table, seq_lens,
                         scale=None):
     """Dense-gather reference with identical semantics (oracle + fallback).
@@ -149,16 +166,7 @@ def paged_attention_ref(q, k_pages, v_pages, page_table, seq_lens,
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     k = k_pages[page_table].reshape(B, NP * page_size, HKV, D)
     v = v_pages[page_table].reshape(B, NP * page_size, HKV, D)
-    if HKV != H:
-        k = jnp.repeat(k, H // HKV, axis=2)
-        v = jnp.repeat(v, H // HKV, axis=2)
-    s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    pos = jnp.arange(NP * page_size)[None, None, :]
-    s = jnp.where(pos < seq_lens[:, None, None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bht,bthd->bhd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    return _gathered_attend(q, k, v, seq_lens, scale)
 
 
 def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
@@ -248,28 +256,31 @@ def paged_decode_attend(q, k_pages, v_pages, pos, scale=None):
 def paged_table_prefill_write(pool, kv, table):
     """Write whole prompts into their table pages at position 0.
 
-    pool: [P, ps, h, d]; kv: [B, S, h, d]; table: [B, NP] int32.  S is a
+    pool: [P, ps, *rest]; kv: [B, S, *rest]; table: [B, NP] int32.  S is a
     trace-time constant; each row's S tokens land in pages
     ``table[b, 0:ceil(S/ps)]`` (rows shorter than S are right-padded by the
     caller — the junk tokens go into pages that per-slot ``seq_lens``
-    masking keeps invisible, or into the caller's scratch page)."""
-    B, S, h, d = kv.shape
+    masking keeps invisible, or into the caller's scratch page).  The
+    trailing dims are generic: K/V payload pools carry ``[h, d]``, the
+    quantized path's scale pools carry ``[h]``."""
+    B, S = kv.shape[:2]
+    rest = kv.shape[2:]
     ps = pool.shape[1]
     pad = (ps - S % ps) % ps
     if pad:
-        kv = jnp.pad(kv, ((0, 0), (0, pad), (0, 0), (0, 0)))
-    chunks = kv.reshape(B, -1, ps, h, d)
+        kv = jnp.pad(kv, ((0, 0), (0, pad)) + ((0, 0),) * len(rest))
+    chunks = kv.reshape((B, -1, ps) + rest)
     nc = chunks.shape[1]
     idx = table[:, :nc].reshape(-1)
     return pool.at[idx].set(
-        chunks.reshape(B * nc, ps, h, d).astype(pool.dtype))
+        chunks.reshape((B * nc, ps) + rest).astype(pool.dtype))
 
 
 def paged_table_token_write(pool, tok, table, lens):
     """Write one token's K or V per slot at each slot's OWN position.
 
-    pool: [P, ps, h, d]; tok: [B, h, d]; table: [B, NP]; lens: [B] int32 —
-    slot b's token lands in page ``table[b, lens[b]//ps]`` slot
+    pool: [P, ps, *rest]; tok: [B, *rest]; table: [B, NP]; lens: [B] int32
+    — slot b's token lands in page ``table[b, lens[b]//ps]`` slot
     ``lens[b]%ps``.  All args may be traced (scatter write)."""
     B = tok.shape[0]
     ps = pool.shape[1]
@@ -283,7 +294,8 @@ def paged_table_chunk_write(pool, kv, table, lens):
     lens[b]+C-1`` (speculative verify: the last sampled token plus C-1
     draft tokens land in one call).
 
-    pool: [P, ps, h, d]; kv: [B, C, h, d]; table: [B, NP]; lens: [B] int32.
+    pool: [P, ps, *rest]; kv: [B, C, *rest]; table: [B, NP]; lens: [B]
+    int32.
     Lanes past the table's reach (pad drafts of a slot near the model cap)
     are DROPPED, not clamped: a clamp would make the pad lane collide with
     the chunk's own last real write in the same scatter, and duplicate-
@@ -292,7 +304,8 @@ def paged_table_chunk_write(pool, kv, table, lens):
     undo: they sit past the slot's valid length, invisible to ``seq_lens``
     masking, and the next step's write at the rolled-back length
     overwrites them."""
-    B, C, h, d = kv.shape
+    B, C = kv.shape[:2]
+    rest = kv.shape[2:]
     ps = pool.shape[1]
     NP = table.shape[1]
     pos = lens.astype(jnp.int32)[:, None] \
@@ -302,7 +315,7 @@ def paged_table_chunk_write(pool, kv, table, lens):
     pages = jnp.take_along_axis(table.astype(jnp.int32), pos_c // ps, axis=1)
     pages = jnp.where(in_range, pages, jnp.int32(-1))  # OOB sentinel
     return pool.at[pages.reshape(-1), (pos_c % ps).reshape(-1)].set(
-        kv.reshape(B * C, h, d).astype(pool.dtype), mode="drop")
+        kv.reshape((B * C,) + rest).astype(pool.dtype), mode="drop")
 
 
 def paged_chunk_attend(q, k_pages, v_pages, table, lens):
@@ -326,6 +339,226 @@ def paged_chunk_attend(q, k_pages, v_pages, table, lens):
     table2 = jnp.broadcast_to(table[:, None, :], (B, C, NP)).reshape(B * C, NP)
     out = paged_attention(q.reshape(B * C, H, D), k_pages, v_pages,
                           table2, lens2.reshape(-1))
+    return out.reshape(B, C, H, D)
+
+
+# --------------------------------------------------- int8 quantized pools
+# The quantized serving path (paddle_tpu.serving.quant): K/V page pools
+# stored as int8 with a PARALLEL SCALE POOL — one float32 scale per
+# (page-slot, kv-head), i.e. each page carries a [ps, h] scale tile next to
+# its [ps, h, d] int8 payload, addressed by the SAME page table.  Per-slot
+# scales make every write self-contained (a token write never has to
+# requantize a page it shares with older tokens), and per-head granularity
+# keeps outlier heads from poisoning the grid of quiet ones.  Scale-pool
+# overhead is 4/d of the payload (≈6% at d=64) — bytes per token drop
+# ~2x vs bf16, ~3.8x vs f32.
+#
+# Quantization is FUSED into the write ops (the bf16 K/V produced by the
+# projection is rounded on the way into the pool scatter) and
+# dequantization into the attention consumers: the Pallas kernel multiplies
+# each int8 page tile by its scale column in VMEM right after the HBM
+# stream-in, so no full-precision copy of the cache ever materializes in
+# HBM.  (The off-TPU dense reference dequantizes the GATHERED pages — a
+# transient [B, T] working set, still never a full pool copy.)
+
+
+def quantize_kv(kv, bits=8):
+    """Quantize K or V activations onto the pool grid: ``[..., h, d]`` ->
+    ``(int8 [..., h, d], float32 scales [..., h])`` — absmax over d per
+    position per head (the per-page-slot-per-head layout above)."""
+    from .quant import quantize_absmax
+
+    q, scale = quantize_absmax(kv, axis=-1, bits=bits)
+    return q, jnp.squeeze(scale, -1)
+
+
+def paged_table_prefill_write_quant(pool, spool, kv, table):
+    """Quantizing twin of :func:`paged_table_prefill_write`: rounds the
+    prompt's K or V into the int8 pool AND writes the per-(slot, head)
+    scale tiles into the parallel scale pool.  pool: [P, ps, h, d] int8;
+    spool: [P, ps, h] f32; kv: [B, S, h, d]; returns (pool, spool)."""
+    qv, sc = quantize_kv(kv)
+    return (paged_table_prefill_write(pool, qv, table),
+            paged_table_prefill_write(spool, sc, table))
+
+
+def paged_table_token_write_quant(pool, spool, tok, table, lens):
+    """Quantizing twin of :func:`paged_table_token_write` (one token per
+    slot at its own position).  tok: [B, h, d]; returns (pool, spool)."""
+    qv, sc = quantize_kv(tok)
+    return (paged_table_token_write(pool, qv, table, lens),
+            paged_table_token_write(spool, sc, table, lens))
+
+
+def paged_table_chunk_write_quant(pool, spool, kv, table, lens):
+    """Quantizing twin of :func:`paged_table_chunk_write` (speculative
+    verify: C tokens per slot in one scatter, same drop-OOB semantics).
+    kv: [B, C, h, d]; returns (pool, spool)."""
+    qv, sc = quantize_kv(kv)
+    return (paged_table_chunk_write(pool, qv, table, lens),
+            paged_table_chunk_write(spool, sc, table, lens))
+
+
+def _paged_q_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                    o_ref, m_scr, l_scr, acc_scr, *, page_size, scale,
+                    num_kv_heads):
+    """The dequant-fused twin of :func:`_paged_kernel`: int8 page tiles
+    stream HBM->VMEM at half the bf16 bytes, and the per-(slot, head)
+    scale column multiplies them back to f32 IN VMEM — the full-precision
+    page never exists outside the register file."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, jnp.float32(NEG_INF))
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    seq_len = lens_ref[b]
+    num_q = q_ref.shape[1]
+    g = num_q // num_kv_heads
+
+    @pl.when(i * page_size < seq_len)
+    def _compute():
+        # same Mosaic discipline as _paged_kernel (2-D tiles, keepdims,
+        # f32 constants, plain-contracting dots); the only addition is the
+        # [page, 1] scale column applied right after the int8->f32 convert
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        valid = pos < seq_len                              # [1, page]
+        for j in range(num_kv_heads):
+            r = slice(j * g, (j + 1) * g)
+            q = q_ref[0, r, :].astype(jnp.float32)         # [g, D]
+            k = k_ref[0, :, j, :].astype(jnp.float32) \
+                * ks_ref[0, :, j:j + 1]                    # [page, D] f32
+            v = v_ref[0, :, j, :].astype(jnp.float32) \
+                * vs_ref[0, :, j:j + 1]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * jnp.float32(scale)
+            s = jnp.where(valid, s, jnp.float32(NEG_INF))  # [g, page]
+            m_prev = m_scr[r, :]                           # [g, 1]
+            m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)                         # [g, page]
+            alpha = jnp.exp(m_prev - m_new)                # [g, 1]
+            l_scr[r, :] = l_scr[r, :] * alpha + p.sum(axis=1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [g, D]
+            acc_scr[r, :] = acc_scr[r, :] * alpha + pv
+            m_scr[r, :] = m_new
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _fin():
+        o_ref[0] = acc_scr[...] / jnp.maximum(l_scr[...], jnp.float32(1e-30))
+
+
+def _paged_q_pallas(q, k_pages, v_pages, k_scales, v_scales, page_table,
+                    seq_lens, scale, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, D = q.shape
+    HKV = k_pages.shape[2]
+    page_size = k_pages.shape[1]
+    NP = page_table.shape[1]
+
+    page_spec = pl.BlockSpec((1, page_size, HKV, D),
+                             lambda b, i, pt, ln: (pt[b, i], 0, 0, 0))
+    scale_spec = pl.BlockSpec((1, page_size, HKV),
+                              lambda b, i, pt, ln: (pt[b, i], 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, NP),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b, i, pt, ln: (b, 0, 0)),
+            page_spec, page_spec, scale_spec, scale_spec,
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, i, pt, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+        ],
+    )
+    # x64 OFF for the same Mosaic i64-index reason as _paged_pallas
+    from jax.experimental import enable_x64 as _enable_x64
+
+    with _enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_paged_q_kernel, page_size=page_size,
+                              scale=scale, num_kv_heads=HKV),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(q.shape, jnp.float32),
+            interpret=interpret,
+        )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+          q, k_pages, v_pages, k_scales.astype(jnp.float32),
+          v_scales.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def paged_attention_quantized_ref(q, k_pages, v_pages, k_scales, v_scales,
+                                  page_table, seq_lens, scale=None):
+    """Dense-gather oracle/fallback for the quantized pools: gather the
+    int8 pages AND their scale tiles, dequantize the gathered working set
+    (transient [B, T] — never a full pool copy), then the shared reference
+    math."""
+    B, H, D = q.shape
+    HKV = k_pages.shape[2]
+    page_size = k_pages.shape[1]
+    NP = page_table.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    k = k_pages[page_table].astype(jnp.float32) \
+        * k_scales[page_table].astype(jnp.float32)[..., None]
+    v = v_pages[page_table].astype(jnp.float32) \
+        * v_scales[page_table].astype(jnp.float32)[..., None]
+    k = k.reshape(B, NP * page_size, HKV, D)
+    v = v.reshape(B, NP * page_size, HKV, D)
+    return _gathered_attend(q, k, v, seq_lens, scale)
+
+
+def paged_attention_quantized(q, k_pages, v_pages, k_scales, v_scales,
+                              page_table, seq_lens, scale=None,
+                              interpret=None):
+    """Decode attention over int8 paged pools with dequant fused into the
+    kernel (see the section comment above).
+
+    q [B, H, D]; k_pages/v_pages [P, ps, HKV, D] int8; k_scales/v_scales
+    [P, ps, HKV] f32; page_table [B, NP] int32; seq_lens [B] int32.  Same
+    table/masking/GQA contract as :func:`paged_attention`."""
+    B, H, D = q.shape
+    if H % k_pages.shape[2]:
+        raise ValueError(f"q heads {H} not a multiple of kv heads "
+                         f"{k_pages.shape[2]}")
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return paged_attention_quantized_ref(
+                q, k_pages, v_pages, k_scales, v_scales, page_table,
+                seq_lens, scale)
+        interpret = False
+    return _paged_q_pallas(q, k_pages, v_pages, k_scales, v_scales,
+                           page_table, seq_lens, scale, interpret)
+
+
+def paged_chunk_attend_quant(q, k_pages, v_pages, k_scales, v_scales,
+                             table, lens):
+    """Quantized twin of :func:`paged_chunk_attend` (speculative verify
+    over int8 pools): the same [B*C]-row batch expansion, attention via
+    :func:`paged_attention_quantized`.  q: [B, C, H, D] -> [B, C, H, D]."""
+    B, C, H, D = q.shape
+    NP = table.shape[1]
+    ps = k_pages.shape[1]
+    lens2 = lens.astype(jnp.int32)[:, None] + jnp.int32(1) \
+        + jnp.arange(C, dtype=jnp.int32)[None, :]            # [B, C]
+    lens2 = jnp.minimum(lens2, jnp.int32(NP * ps))
+    table2 = jnp.broadcast_to(table[:, None, :], (B, C, NP)).reshape(B * C, NP)
+    out = paged_attention_quantized(
+        q.reshape(B * C, H, D), k_pages, v_pages, k_scales, v_scales,
+        table2, lens2.reshape(-1))
     return out.reshape(B, C, H, D)
 
 

@@ -16,6 +16,14 @@ import jax
 import jax.numpy as jnp
 
 from ..nn.layer import Layer
+# the PUBLIC deploy-grid primitives: ONE implementation of absmax scale
+# selection / int-grid rounding / dequantization (ops/quant.py), shared by
+# Int8Linear below, the serving engine's quantized KV page pools
+# (serving.quant + ops.paged_attention.quantize_kv), and the calibration
+# harness — scales can no longer drift between the weight and cache paths
+from ..ops.quant import (  # noqa: F401  (re-exported)
+    absmax_scale, dequantize, quantize, quantize_absmax,
+)
 from ..tensor.dispatch import apply
 from ..tensor.tensor import Tensor
 
@@ -276,26 +284,28 @@ class Int8Linear(Layer):
 
     def __init__(self, linear, w_scale, act_scale=None, bits=8):
         super().__init__()
-        qmax = 2.0 ** (bits - 1) - 1
-        self._qmax = qmax
+        self._bits = int(bits)
+        self._qmax = 2.0 ** (bits - 1) - 1
         self.w_scale = float(max(w_scale, 1e-8))
         self.act_scale = float(act_scale) if act_scale else None
-        w = linear.weight._value
-        q = jnp.clip(jnp.round(w / self.w_scale), -qmax, qmax)
-        self.register_buffer("weight_int8", Tensor(q.astype(jnp.int8)))
+        # the shared grid (ops/quant.py): the serving KV pools round onto
+        # exactly the same symmetric int grid
+        q = quantize(linear.weight._value, jnp.float32(self.w_scale),
+                     bits=bits)
+        self.register_buffer("weight_int8", Tensor(q))
         self.bias = getattr(linear, "bias", None)
 
     def forward(self, x):
-        qmax = self._qmax
+        bits = self._bits
         w_scale, act_scale = self.w_scale, self.act_scale
         bias = self.bias
 
         def fn(v, wq, *b):
             if act_scale is not None:
                 s_a = jnp.float32(act_scale)
-            else:
-                s_a = jnp.maximum(jnp.max(jnp.abs(v)), 1e-8) / qmax
-            xq = jnp.clip(jnp.round(v / s_a), -qmax, qmax).astype(jnp.int8)
+                xq = quantize(v, s_a, bits=bits)
+            else:  # dynamic per-call absmax (the PTQ-free fallback)
+                xq, s_a = quantize_absmax(v, bits=bits)
             y = jnp.matmul(xq, wq, preferred_element_type=jnp.int32)
             out = y.astype(jnp.float32) * (s_a * jnp.float32(w_scale))
             if b:

@@ -18,6 +18,11 @@ cache (ROADMAP north star: "serves heavy traffic from millions of users").
   routing with health-aware least-loaded fallback) and
   :class:`ServingCluster` (the routed facade with cross-replica in-flight
   requeue; README "Cluster serving").
+- :mod:`.quant` — quantized serving: int8 paged KV pools with parallel
+  scale pools (:class:`QuantizedGPTAdapter`, ``ServingEngine(kv_dtype=
+  "int8")``), the :func:`quantize_model_weights` Int8Linear weight path,
+  and the :func:`calibrate` accuracy harness (README "Quantized
+  serving").
 
 Metrics (PR-1 registry, README "Serving"): ``serving.*`` histograms /
 gauges / counters — TTFT, inter-token latency, queue depth, slot
@@ -38,6 +43,9 @@ from .cluster import (  # noqa: F401
     ClusterHandle, PrefixAffinityRouter, ReplicaPool, RouteDecision,
     ServingCluster,
 )
+from .quant import (  # noqa: F401
+    QuantizedGPTAdapter, calibrate, quantize_model_weights,
+)
 
 __all__ = [
     "ServingEngine", "Request", "RequestHandle", "RequestRejectedError",
@@ -45,4 +53,5 @@ __all__ = [
     "GPTAdapter", "ContinuousBatchingPredictor", "NgramDrafter",
     "make_verifier", "ServingCluster", "ClusterHandle", "ReplicaPool",
     "PrefixAffinityRouter", "RouteDecision", "SLOPolicy",
+    "QuantizedGPTAdapter", "quantize_model_weights", "calibrate",
 ]

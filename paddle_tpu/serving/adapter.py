@@ -1,25 +1,31 @@
 """Model adapters: the pure functions the ServingEngine jit-compiles.
 
-An adapter reduces a causal LM to two closures over explicit jax state
-(the engine wraps them in ``jax.jit`` with DONATED pools, once per
-(batch-shape, sampler) tuple — the ``_decode.py`` discipline):
+An adapter reduces a causal LM to closures over explicit jax state (the
+engine wraps them in ``jax.jit`` with DONATED pools, once per
+(batch-shape, sampler) tuple — the ``_decode.py`` discipline).  The KV
+state is an adapter-defined POOL TUPLE of ``n_pools`` arrays: the base
+:class:`GPTAdapter` carries ``(kp, vp)`` per-layer global page pools; the
+quantized :class:`~paddle_tpu.serving.quant.QuantizedGPTAdapter` carries
+``(kp, vp, k_scales, v_scales)`` — int8 payloads plus parallel scale
+pools.  The engine treats the tuple opaquely (build, donate, rebind), so
+one scheduler serves every pool layout.
 
-- ``prefill(params, bufs, ids, kp, vp, table, lens)`` — run the
+- ``prefill(params, bufs, ids, *pools, table, lens)`` — run the
   (right-padded) prompts ``ids [B, S]`` densely, write their K/V into the
   global page pools through ``table [B, NP]``, and return the next-token
   logits gathered at each row's true last position ``lens[b] - 1``.
-- ``step(params, bufs, last, kp, vp, table, lens)`` — one decode token per
+- ``step(params, bufs, last, *pools, table, lens)`` — one decode token per
   slot at each slot's OWN position ``lens[b]`` (iteration-level batching:
   no lock-step scalar pos), attention through the paged kernel.
-- ``verify(params, bufs, ids, kp, vp, table, lens)`` — speculative
+- ``verify(params, bufs, ids, *pools, table, lens)`` — speculative
   decoding's multi-token step: C tokens per slot at positions
-  ``lens[b]..lens[b]+C-1`` through the "served_chunk" cache variant,
-  returning logits at EVERY position so the engine can accept/reject the
-  drafted suffix (serving/speculative.py).
+  ``lens[b]..lens[b]+C-1`` through the chunk cache variant, returning
+  logits at EVERY position so the engine can accept/reject the drafted
+  suffix (serving/speculative.py).
 
-prefill/step return ``(logits [B, V] f32, kp, vp)``, verify
-``(logits [B, C, V] f32, kp, vp)``, with ``kp/vp: [L, P, ps, h, d]``
-stacked per-layer global pools.
+prefill/step return ``(logits [B, V] f32, *pools)``, verify
+``(logits [B, C, V] f32, *pools)``, with each pool a per-layer-stacked
+``[L, P, ...]`` array.
 """
 
 from __future__ import annotations
@@ -31,7 +37,17 @@ import jax.numpy as jnp
 class GPTAdapter:
     """Adapter for :class:`paddle_tpu.text.models.GPTForCausalLM` (and any
     model exposing the same ``.gpt`` decoder structure with the "served"
-    cache variant)."""
+    cache variant).  Subclasses override the pool hooks (``init_pools`` /
+    ``_layer_caches`` / ``_stack_pools``) and the cache tags to change the
+    KV storage format without touching the closure shapes."""
+
+    #: GPTDecoderLayer cache-variant tags this adapter drives
+    tag = "served"
+    chunk_tag = "served_chunk"
+    #: number of arrays in the pool tuple (the engine donates all of them)
+    n_pools = 2
+    #: storage format label ("native" = the model dtype)
+    kv_dtype = "native"
 
     def __init__(self, model, page_size=16):
         self.model = model
@@ -39,8 +55,14 @@ class GPTAdapter:
         blk = self.gpt.layers[0]
         self.num_layers = len(self.gpt.layers)
         self.head_dim = blk.head_dim
-        # local head count from the actual projection width (TP-safe)
-        self.num_kv_heads = blk.qkv.weight.shape[-1] // (3 * blk.head_dim)
+        # local head count from the actual projection width (TP-safe); an
+        # int8-weight model (serving.quant.quantize_model_weights) stores
+        # the projection as an Int8Linear whose weight lives in the
+        # ``weight_int8`` buffer — same shape, different attribute
+        qkv_w = getattr(blk.qkv, "weight", None)
+        if qkv_w is None:
+            qkv_w = blk.qkv.weight_int8
+        self.num_kv_heads = qkv_w.shape[-1] // (3 * blk.head_dim)
         self.dtype = self.gpt.word_embeddings.weight._value.dtype
         self.max_model_len = self.gpt.position_embeddings.weight.shape[0]
         self.page_size = int(page_size)
@@ -53,16 +75,36 @@ class GPTAdapter:
             bufs = {k: b._value for k, b in self.model.named_buffers()}
         return params, bufs
 
+    # ----------------------------------------------------------- pool hooks
     def init_pools(self, num_pages):
-        """Zeroed per-layer K/V pools [L, P, ps, h, d]."""
+        """Zeroed per-layer K/V pools ``(kp, vp)``, each [L, P, ps, h, d]."""
         shape = (self.num_layers, int(num_pages), self.page_size,
                  self.num_kv_heads, self.head_dim)
         kp = jnp.zeros(shape, self.dtype)
         return kp, jnp.zeros_like(kp)
 
+    def page_bytes(self):
+        """HBM bytes ONE page costs across all layers, K and V (the unit
+        BlockManager capacity math and the serving.kv_bytes_per_token
+        gauge are denominated in)."""
+        return (2 * self.num_layers * self.page_size * self.num_kv_heads
+                * self.head_dim * jnp.dtype(self.dtype).itemsize)
+
+    def _layer_caches(self, pools, table, lens, tag):
+        """Per-layer GPTDecoderLayer cache tuples from the pool tuple."""
+        from ..tensor.tensor import Tensor
+
+        kp, vp = pools
+        return [(tag, Tensor(kp[i]), Tensor(vp[i]), Tensor(table),
+                 Tensor(lens)) for i in range(self.num_layers)]
+
+    def _stack_pools(self, new_cache):
+        """Re-stack the per-layer cache tuples into the pool tuple."""
+        return (jnp.stack([c[1]._value for c in new_cache]),
+                jnp.stack([c[2]._value for c in new_cache]))
+
     # ------------------------------------------------------------- closures
-    def _run(self, params, bufs, ids, kp, vp, table, lens, pos_ids,
-             tag="served"):
+    def _run(self, params, bufs, ids, pools, table, lens, pos_ids, tag):
         from ..framework import random as _rng
         from ..framework.state import no_grad_ctx
         from ..tensor.tensor import Tensor
@@ -70,44 +112,52 @@ class GPTAdapter:
         gpt = self.gpt
         with no_grad_ctx(), _rng.rng_scope(jax.random.key(0)), \
                 self.model.bind(params, bufs):
-            lc = [(tag, Tensor(kp[i]), Tensor(vp[i]), Tensor(table),
-                   Tensor(lens)) for i in range(self.num_layers)]
+            lc = self._layer_caches(pools, table, lens, tag)
             x, new_cache = gpt(Tensor(ids), position_ids=Tensor(pos_ids),
                                cache=lc)
             w = gpt.word_embeddings.weight._value
-            kp = jnp.stack([c[1]._value for c in new_cache])
-            vp = jnp.stack([c[2]._value for c in new_cache])
-            return x._value, w, kp, vp
+            return x._value, w, self._stack_pools(new_cache)
 
-    def prefill(self, params, bufs, ids, kp, vp, table, lens):
+    def _split(self, args):
+        """``(*pools, table, lens)`` -> (pools tuple, table, lens)."""
+        if len(args) != self.n_pools + 2:
+            raise TypeError(
+                f"{type(self).__name__} closures take {self.n_pools} pool "
+                f"arrays + table + lens; got {len(args)} trailing args")
+        return tuple(args[:self.n_pools]), args[-2], args[-1]
+
+    def prefill(self, params, bufs, ids, *args):
+        pools, table, lens = self._split(args)
         S = ids.shape[1]
         pos_ids = jnp.arange(S, dtype=jnp.int64)[None, :]
-        x, w, kp, vp = self._run(params, bufs, ids, kp, vp, table, lens,
-                                 pos_ids)
+        x, w, pools = self._run(params, bufs, ids, pools, table, lens,
+                                pos_ids, self.tag)
         # logits at each row's LAST REAL position (rows are right-padded)
         idx = (lens.astype(jnp.int32) - 1)[:, None, None]
         h = jnp.take_along_axis(x, idx, axis=1)[:, 0]
         logits = h.astype(jnp.float32) @ w.T.astype(jnp.float32)
-        return logits, kp, vp
+        return (logits,) + pools
 
-    def step(self, params, bufs, last, kp, vp, table, lens):
+    def step(self, params, bufs, last, *args):
+        pools, table, lens = self._split(args)
         pos_ids = lens[:, None].astype(jnp.int64)
-        x, w, kp, vp = self._run(params, bufs, last, kp, vp, table, lens,
-                                 pos_ids)
+        x, w, pools = self._run(params, bufs, last, pools, table, lens,
+                                pos_ids, self.tag)
         logits = x[:, -1].astype(jnp.float32) @ w.T.astype(jnp.float32)
-        return logits, kp, vp
+        return (logits,) + pools
 
-    def verify(self, params, bufs, ids, kp, vp, table, lens):
+    def verify(self, params, bufs, ids, *args):
         """Multi-token verification step (speculative decoding): run
         ``ids [B, C]`` — each row the slot's last sampled token followed by
         C-1 draft tokens — at per-slot positions ``lens[b]..lens[b]+C-1``.
         All C K/V per slot are written into the global pools and attended
-        against them in ONE call (the "served_chunk" cache variant), and
-        logits come back for EVERY position: ``logits[b, t]`` is the
-        next-token distribution after ``ids[b, :t+1]``, which is exactly
-        what accepting/rejecting draft t+1 needs.
+        against them in ONE call (the chunk cache variant), and logits
+        come back for EVERY position: ``logits[b, t]`` is the next-token
+        distribution after ``ids[b, :t+1]``, which is exactly what
+        accepting/rejecting draft t+1 needs.
 
-        Returns ``(logits [B, C, V] f32, kp, vp)``."""
+        Returns ``(logits [B, C, V] f32, *pools)``."""
+        pools, table, lens = self._split(args)
         C = ids.shape[1]
         pos_ids = lens[:, None].astype(jnp.int64) \
             + jnp.arange(C, dtype=jnp.int64)[None, :]
@@ -115,7 +165,7 @@ class GPTAdapter:
         # position table near the model cap; those positions' logits are
         # junk the engine never reads (draft lengths are capped host-side)
         pos_ids = jnp.minimum(pos_ids, self.max_model_len - 1)
-        x, w, kp, vp = self._run(params, bufs, ids, kp, vp, table, lens,
-                                 pos_ids, tag="served_chunk")
+        x, w, pools = self._run(params, bufs, ids, pools, table, lens,
+                                pos_ids, self.chunk_tag)
         logits = x.astype(jnp.float32) @ w.T.astype(jnp.float32)
-        return logits, kp, vp
+        return (logits,) + pools

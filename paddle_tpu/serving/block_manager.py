@@ -51,7 +51,7 @@ class PageAllocation:
 
 class BlockManager:
     def __init__(self, num_pages, page_size, prefix_sharing=False,
-                 replica="0"):
+                 replica="0", bytes_per_page=None, pool_dtype=None):
         if num_pages < 1:
             raise ValueError(f"num_pages must be >= 1, got {num_pages}")
         if page_size < 1:
@@ -60,6 +60,13 @@ class BlockManager:
         self.page_size = int(page_size)
         self.prefix_sharing = bool(prefix_sharing)
         self.replica = str(replica)
+        # HBM accounting (quantized serving): what one page costs across
+        # all layers, K+V, scale pools included, and what the pool rows
+        # are made of — the engine fills these in so capacity math and the
+        # /statusz slot table talk in bytes, not just page counts
+        self.bytes_per_page = int(bytes_per_page) \
+            if bytes_per_page is not None else None
+        self.pool_dtype = str(pool_dtype) if pool_dtype is not None else None
         self._free = collections.deque(range(self.num_pages))
         self._active = {}                       # prefix key -> [page, refs]
         self._idle = collections.OrderedDict()  # prefix key -> page (refs 0)
@@ -98,6 +105,41 @@ class BlockManager:
 
     def utilization(self):
         return self.used_pages / self.num_pages
+
+    def stats(self):
+        """Allocator snapshot, HBM-denominated when the engine supplied
+        ``bytes_per_page``/``pool_dtype`` (quantized serving: the int8
+        pool's bytes_per_page is ~half bf16's, which is exactly the
+        resident-slot win)."""
+        st = {
+            "num_pages": self.num_pages,
+            "page_size": self.page_size,
+            "used_pages": self.used_pages,
+            "free_pages": self.free_pages,
+            "utilization": self.utilization(),
+            "prefix_sharing": self.prefix_sharing,
+            "bytes_per_page": self.bytes_per_page,
+            "pool_dtype": self.pool_dtype,
+        }
+        if self.bytes_per_page is not None:
+            st["pool_bytes"] = self.num_pages * self.bytes_per_page
+            st["used_bytes"] = self.used_pages * self.bytes_per_page
+            st["kv_bytes_per_token"] = self.bytes_per_page / self.page_size
+        return st
+
+    def max_resident_sequences(self, tokens_per_seq, budget_bytes=None):
+        """Capacity math: how many sequences of ``tokens_per_seq`` worst
+        case fit — in this pool, or in a hypothetical pool of
+        ``budget_bytes`` HBM at this manager's bytes_per_page (the
+        occupancy comparison the int8 acceptance test and the bench arm
+        assert on)."""
+        per_seq = self.pages_for(tokens_per_seq)
+        pages = self.num_pages
+        if budget_bytes is not None:
+            if self.bytes_per_page is None:
+                raise ValueError("budget_bytes needs bytes_per_page")
+            pages = int(budget_bytes) // self.bytes_per_page
+        return pages // per_seq
 
     # ------------------------------------------------------------ allocation
     def _pop_free(self):

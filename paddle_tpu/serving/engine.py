@@ -56,6 +56,18 @@ in-flight handles fast with :class:`EngineStoppedError`; ``stop(drain=
 True)`` finishes all in-flight work first.  Extra metrics:
 ``serving.load_shed{reason=}``, ``serving.engine_restarts``,
 ``serving.requests_requeued``, ``serving.health_state``.
+
+Quantized serving (``kv_dtype="int8"`` / ``weight_dtype="int8"``, see
+``serving/quant`` and README "Quantized serving"): the paged KV pools
+store int8 payloads with parallel per-(page slot, head) float32 scale
+pools — quant fused into every pool write, dequant into the paged
+attention kernels, so decode streams half the bf16 cache bytes and the
+same HBM budget holds ~2x the resident slots; the model's Linears can
+ride along as :class:`~paddle_tpu.quantization.Int8Linear`.  The engine
+is layout-agnostic: the adapter defines the pool tuple, every compiled
+program donates all of it, and the quantized program families are
+attributed separately (``decode@int8`` etc.) in the perf table.  Extra
+metrics: ``serving.kv_bytes_per_token``, ``serving.pool_bytes{dtype=}``.
 """
 
 from __future__ import annotations
@@ -240,8 +252,37 @@ class ServingEngine:
                  telemetry_port=None, max_engine_restarts=3,
                  degraded_stall_s=2.0, restart_cooldown_s=10.0,
                  speculative_k=0, draft_max_ngram=3, draft_min_ngram=1,
-                 replica="0", device=None, health_gating=True, slo=None):
+                 replica="0", device=None, health_gating=True, slo=None,
+                 kv_dtype=None, weight_dtype=None):
         self._model = model
+        # quantized serving (serving/quant, README "Quantized serving"):
+        # kv_dtype="int8" stores the paged KV pools as int8 with parallel
+        # per-(page slot, head) scale pools — quant fused into the pool
+        # writes, dequant into the paged-attention kernels, ~2x resident
+        # slots per HBM byte; weight_dtype="int8" converts the model's
+        # Linears to Int8Linear in place (idempotent — cluster replicas
+        # over one shared model convert once).  The default (None /
+        # "native" / "bf16") is byte-identical to the unquantized engine.
+        kv_dtype = str(kv_dtype).lower() if kv_dtype is not None else "native"
+        if kv_dtype in ("native", "bf16", "bfloat16", "float32", "fp32"):
+            kv_dtype = "native"
+        elif kv_dtype != "int8":
+            raise ValueError(f"kv_dtype must be None/'native' or 'int8', "
+                             f"got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
+        self.weight_dtype = str(weight_dtype).lower() \
+            if weight_dtype is not None else "native"
+        if self.weight_dtype not in ("native", "int8"):
+            raise ValueError(f"weight_dtype must be None/'native' or "
+                             f"'int8', got {weight_dtype!r}")
+        if self.weight_dtype == "int8":
+            from .quant.weights import quantize_model_weights
+
+            quantize_model_weights(model)
+        # quantized program families get their own perf-attribution names
+        # (decode@int8, prefill/<bucket>@int8, verify/k<k>@int8) so the
+        # roofline table can judge the dequant-fused programs separately
+        self._fam_suffix = "@int8" if kv_dtype == "int8" else ""
         # replica identity (cluster serving): stamps every serving.* metric
         # series with a replica= label so N engines in one process don't
         # overwrite each other, keys the /statusz|/healthz provider
@@ -256,8 +297,14 @@ class ServingEngine:
         # the 503 fold instead (one dead replica must not fail the fleet)
         self._health_gating = bool(health_gating)
         self._device = device
-        self._adapter = adapter if adapter is not None \
-            else GPTAdapter(model, page_size)
+        if adapter is not None:
+            self._adapter = adapter
+        elif kv_dtype == "int8":
+            from .quant.adapter import QuantizedGPTAdapter
+
+            self._adapter = QuantizedGPTAdapter(model, page_size)
+        else:
+            self._adapter = GPTAdapter(model, page_size)
         self.page_size = int(page_size)
         self.num_slots = int(num_slots)
         cap = self._adapter.max_model_len
@@ -268,14 +315,19 @@ class ServingEngine:
             num_pages = self.num_slots * self.table_width  # full residency
         self._num_pages = int(num_pages)
         self._prefix_sharing = bool(prefix_sharing)
-        self._bm = BlockManager(num_pages, self.page_size,
-                                prefix_sharing=prefix_sharing,
-                                replica=self.replica)
+        # HBM accounting (quantized serving satellite): every page costs
+        # adapter.page_bytes() across all layers, K+V, scale pools
+        # included — BlockManager carries it so capacity math, stats()
+        # and /statusz all read one number
+        self._bytes_per_page = int(self._adapter.page_bytes())
+        self._pool_dtype = "int8" if self.kv_dtype == "int8" \
+            else str(self._adapter.dtype)
+        self._bm = self._new_block_manager()
         # pool row num_pages is the SCRATCH page: inactive decode slots and
         # padded table tails point at it (every table entry must be a valid
         # pool row; junk written there is never attended)
         self._scratch = int(num_pages)
-        self._pools = self._adapter.init_pools(num_pages + 1)
+        self._pools = tuple(self._adapter.init_pools(num_pages + 1))
         self._params, self._bufs = self._adapter.params_and_buffers()
         if device is not None:
             # dp-replica placement: commit this replica's params/buffers and
@@ -449,6 +501,30 @@ class ServingEngine:
             "speculative acceptance: spec_accepted / spec_proposed")
         self._m_verify_traces = _c(
             "serving.verify_traces", "verify-step program traces")
+        # quantized-serving occupancy gauges: bytes one token position
+        # costs in the KV pools (layers x K+V, scale pools included) and
+        # the allocated pool HBM, labelled by pool dtype
+        self._m_kv_bytes_tok = _g(
+            "serving.kv_bytes_per_token",
+            "KV-cache HBM bytes per token position (all layers, K+V, "
+            "scale pools included)")
+        self._m_pool_bytes = _g(
+            "serving.pool_bytes",
+            "allocated KV page-pool HBM bytes (scratch page included)")
+        self._set_pool_gauges()
+
+    def _new_block_manager(self):
+        return BlockManager(self._num_pages, self.page_size,
+                            prefix_sharing=self._prefix_sharing,
+                            replica=self.replica,
+                            bytes_per_page=self._bytes_per_page,
+                            pool_dtype=self._pool_dtype)
+
+    def _set_pool_gauges(self):
+        self._m_kv_bytes_tok.set(self._bytes_per_page / self.page_size)
+        self._m_pool_bytes.set(
+            float(sum(int(p.nbytes) for p in self._pools)),
+            dtype=self._pool_dtype)
 
     # ------------------------------------------------------------ lifecycle
     def start(self):
@@ -742,17 +818,19 @@ class ServingEngine:
     def _step_program(self):
         key = ("serve_step", self.num_slots, self.table_width,
                self._pools[0].shape, str(self._pools[0].dtype), self._top)
+        n = len(self._pools)  # pools are DONATED; count is adapter-defined
 
         def build():
             traces = [0]
             adapter, sampler = self._adapter, self._sampler
 
-            @functools.partial(jax.jit, donate_argnums=(3, 4))
-            def step(params, bufs, last, kp, vp, table, lens, temps, rkey):
+            @functools.partial(jax.jit,
+                               donate_argnums=tuple(range(3, 3 + n)))
+            def step(params, bufs, last, *rest):
                 traces[0] += 1  # python side effect: runs at TRACE time only
-                logits, kp, vp = adapter.step(params, bufs, last, kp, vp,
-                                              table, lens)
-                return sampler(logits, temps, rkey), kp, vp
+                pools, (table, lens, temps, rkey) = rest[:n], rest[n:]
+                out = adapter.step(params, bufs, last, *pools, table, lens)
+                return (sampler(out[0], temps, rkey),) + tuple(out[1:])
 
             return step, traces
 
@@ -766,20 +844,21 @@ class ServingEngine:
         k_pad = self._spec_k
         key = ("verify", k_pad, self.num_slots, self.table_width,
                self._pools[0].shape, str(self._pools[0].dtype), self._top)
+        n = len(self._pools)
 
         def build():
             traces = [0]
             adapter, verifier = self._adapter, self._verifier
 
-            @functools.partial(jax.jit, donate_argnums=(3, 4))
-            def verify(params, bufs, ids, kp, vp, table, lens, dlen, temps,
-                       rkey):
+            @functools.partial(jax.jit,
+                               donate_argnums=tuple(range(3, 3 + n)))
+            def verify(params, bufs, ids, *rest):
                 traces[0] += 1
-                logits, kp, vp = adapter.verify(params, bufs, ids, kp, vp,
-                                                table, lens)
-                targets, accept = verifier(logits, ids[:, 1:], dlen, temps,
+                pools, (table, lens, dlen, temps, rkey) = rest[:n], rest[n:]
+                out = adapter.verify(params, bufs, ids, *pools, table, lens)
+                targets, accept = verifier(out[0], ids[:, 1:], dlen, temps,
                                            rkey)
-                return targets, accept, kp, vp
+                return (targets, accept) + tuple(out[1:])
 
             return verify, traces
 
@@ -803,17 +882,19 @@ class ServingEngine:
     def _prefill_program(self, s_pad):
         key = ("serve_prefill", s_pad, self.table_width,
                self._pools[0].shape, str(self._pools[0].dtype), self._top)
+        n = len(self._pools)
 
         def build():
             traces = [0]
             adapter, sampler = self._adapter, self._sampler
 
-            @functools.partial(jax.jit, donate_argnums=(3, 4))
-            def prefill(params, bufs, ids, kp, vp, table, lens, temps, rkey):
+            @functools.partial(jax.jit,
+                               donate_argnums=tuple(range(3, 3 + n)))
+            def prefill(params, bufs, ids, *rest):
                 traces[0] += 1
-                logits, kp, vp = adapter.prefill(params, bufs, ids, kp, vp,
-                                                 table, lens)
-                return sampler(logits, temps, rkey), kp, vp
+                pools, (table, lens, temps, rkey) = rest[:n], rest[n:]
+                out = adapter.prefill(params, bufs, ids, *pools, table, lens)
+                return (sampler(out[0], temps, rkey),) + tuple(out[1:])
 
             return prefill, traces
 
@@ -893,12 +974,13 @@ class ServingEngine:
             inflight.append((pending, 0))
         # fresh device state: the page pools were donated into the crashed
         # dispatch; re-admission prefills rewrite every sequence's K/V
-        self._bm = BlockManager(self._num_pages, self.page_size,
-                                prefix_sharing=self._prefix_sharing,
-                                replica=self.replica)
-        self._pools = self._adapter.init_pools(self._num_pages + 1)
+        # (a quantized engine rebuilds int8 + scale pools the same way —
+        # the adapter owns the layout)
+        self._bm = self._new_block_manager()
+        self._pools = tuple(self._adapter.init_pools(self._num_pages + 1))
         if self._device is not None:
             self._pools = jax.device_put(self._pools, self._device)
+        self._set_pool_gauges()
         self._reset_host_buffers()
         with self._lock:
             for req, produced in reversed(inflight):
@@ -989,7 +1071,7 @@ class ServingEngine:
         prog, traces = self._prefill_program(s_pad)
         n0 = traces[0]
         rkey = self._next_key()
-        fam = f"prefill/{s_pad}"
+        fam = f"prefill/{s_pad}{self._fam_suffix}"
         if _perf.needs_cost(fam):
             # capture arg shapes ONCE per family; the cost_analysis
             # re-lower+compile itself runs lazily, off this thread
@@ -1006,9 +1088,9 @@ class ServingEngine:
                                trace_id=req.handle.trace_id,
                                request_id=req.handle.request_id,
                                slot=slot_idx, prompt_len=S0):
-                tok, kp, vp = prog(self._params, self._bufs, ids,
+                tok, *pools = prog(self._params, self._bufs, ids,
                                    *self._pools, table, lens, temps, rkey)
-                self._pools = (kp, vp)
+                self._pools = tuple(pools)
                 tok = int(np.asarray(tok)[0])
         finally:
             self._compiling = False
@@ -1070,8 +1152,9 @@ class ServingEngine:
         prog, traces = self._step_program()
         n0 = traces[0]
         rkey = self._step_key()
-        if _perf.needs_cost("decode"):
-            _perf.register_cost_thunk("decode", _perf.jit_cost_thunk(
+        fam = f"decode{self._fam_suffix}"
+        if _perf.needs_cost(fam):
+            _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
                 prog, (self._params, self._bufs, self._h_last, *self._pools,
                        self._h_table, self._h_lens, self._h_temps, rkey)))
         if _tracing._ACTIVE:
@@ -1088,10 +1171,10 @@ class ServingEngine:
         t0 = time.perf_counter()
         try:
             with cm:
-                tok, kp, vp = prog(self._params, self._bufs, self._h_last,
+                tok, *pools = prog(self._params, self._bufs, self._h_last,
                                    *self._pools, self._h_table, self._h_lens,
                                    self._h_temps, rkey)
-                self._pools = (kp, vp)
+                self._pools = tuple(pools)
                 tok = np.asarray(tok)
         finally:
             self._compiling = False
@@ -1099,7 +1182,7 @@ class ServingEngine:
         if traces[0] > n0:
             self._m_step_traces.inc(traces[0] - n0)
         else:
-            _perf.record("decode", time.perf_counter() - t0)
+            _perf.record(fam, time.perf_counter() - t0)
         self._m_step_seconds.observe(time.perf_counter() - t0)
         self._iteration += 1
         for i in active:
@@ -1146,7 +1229,7 @@ class ServingEngine:
         prog, traces = self._verify_program()
         n0 = traces[0]
         rkey = self._step_key()
-        fam = f"verify/k{K}"
+        fam = f"verify/k{K}{self._fam_suffix}"
         if _perf.needs_cost(fam):
             _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
                 prog, (self._params, self._bufs, self._h_ids, *self._pools,
@@ -1164,11 +1247,11 @@ class ServingEngine:
         t0 = time.perf_counter()
         try:
             with cm:
-                targets, accept, kp, vp = prog(
+                targets, accept, *pools = prog(
                     self._params, self._bufs, self._h_ids, *self._pools,
                     self._h_table, self._h_lens, self._h_dlen,
                     self._h_temps, rkey)
-                self._pools = (kp, vp)
+                self._pools = tuple(pools)
                 targets = np.asarray(targets)
                 accept = np.asarray(accept)
         finally:
@@ -1389,6 +1472,13 @@ class ServingEngine:
             "num_pages": self._bm.num_pages,
             "page_utilization": self._bm.utilization(),
             "step_traces": self.step_traces,
+            # quantized-serving surface: what the pools are made of and
+            # what a page/token costs in HBM (scale pools included)
+            "kv_dtype": self.kv_dtype,
+            "weight_dtype": self.weight_dtype,
+            "pool_dtype": self._pool_dtype,
+            "bytes_per_page": self._bytes_per_page,
+            "kv_bytes_per_token": self._bytes_per_page / self.page_size,
         }
         if self._spec_k:
             st["speculative"] = {
@@ -1403,6 +1493,7 @@ class ServingEngine:
         """/statusz provider: stats + the live slot table (diagnostic
         snapshot — reads race the scheduler thread benignly)."""
         st = self.stats()
+        st["kv_cache"] = self._bm.stats()   # pool dtype + bytes/page live
         st["started"] = self._started
         st["error"] = repr(self._error) if self._error is not None else None
         st["health"] = self.health_state()

@@ -1,0 +1,37 @@
+"""paddle_tpu.serving.quant — quantized serving as a first-class subsystem
+(README "Quantized serving").
+
+Int8 paged KV-cache pages and int8 weights for the serving stack:
+
+- :class:`QuantizedGPTAdapter` — int8 page pools + parallel per-(page
+  slot, head) float32 scale pools, quant fused into every pool write and
+  dequant into the paged-attention kernels (``ops.paged_attention``'s int8
+  section).  ``ServingEngine(kv_dtype="int8")`` builds one automatically;
+  prefill, decode, speculative verify and chunked writes all run through
+  the quantized programs (``prefill/<bucket>@int8``, ``decode@int8``,
+  ``verify/k<k>@int8`` families in the perf table).
+- :func:`quantize_model_weights` — in-place ``Int8Linear`` conversion of
+  the decoder Linears on the shared symmetric grid
+  (``quantization.quantize_absmax``); ``ServingEngine(weight_dtype=
+  "int8")`` applies it, idempotently, so cluster replicas over one model
+  convert once.
+- :func:`calibrate` — the accuracy harness: runs a calibration batch
+  through the full-precision engine, measures per-layer KV/weight
+  round-trip error, picks scales (absmax or percentile), then reports
+  top-1 agreement and the occupancy win of the int8 engine.
+
+Why: decode is bandwidth-bound (BENCH_r04 roofline, PR-7 per-program
+attribution) — halving cache bytes is both raw inter-token latency AND
+~2x resident requests per chip at a fixed page-pool HBM budget.
+"""
+
+from .adapter import QuantizedGPTAdapter  # noqa: F401
+from .calibrate import (  # noqa: F401
+    calibrate, choose_scale, kv_quant_error, top1_agreement,
+)
+from .weights import quantize_model_weights, weight_quant_error  # noqa: F401
+
+__all__ = [
+    "QuantizedGPTAdapter", "quantize_model_weights", "weight_quant_error",
+    "calibrate", "choose_scale", "kv_quant_error", "top1_agreement",
+]

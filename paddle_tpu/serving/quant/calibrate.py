@@ -1,0 +1,179 @@
+"""Calibration / accuracy harness for quantized serving.
+
+``calibrate(model, prompts)`` answers the question an operator asks before
+flipping ``kv_dtype="int8"`` in production: *what does the int8 path cost
+in accuracy, and what does it buy in HBM?*  It
+
+1. runs the CALIBRATION BATCH through the full-precision engine first
+   (greedy), recording every request's token stream — the reference;
+2. measures per-layer K/V round-trip error on the calibration prompts
+   (dense forward capturing each layer's K/V, quantized onto the pool
+   grid and compared back) and per-layer weight round-trip error;
+3. picks weight scales (``method="absmax"`` or outlier-robust
+   ``"percentile"``) and — when ``weight_dtype="int8"`` — converts the
+   model via :func:`~.weights.quantize_model_weights`;
+4. runs the SAME prompts through the int8 engine
+   (``ServingEngine(kv_dtype="int8")``) and reports **top-1 agreement**:
+   the fraction of generated positions whose greedy token matches the
+   full-precision stream;
+5. reports the occupancy side: bytes per KV token for both layouts and
+   the resident-slot ratio at an identical page-pool HBM budget.
+
+The reference runs BEFORE any conversion, so one model object suffices —
+weight conversion is in-place (see ``weights.py``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def choose_scale(x, axis=None, method="absmax", pct=99.9, bits=8, eps=1e-8):
+    """Scale selection for a symmetric int grid: ``absmax`` covers every
+    value (no clipping, coarser grid); ``percentile`` clips the top
+    ``100 - pct`` percent of magnitudes for a finer grid on the bulk —
+    the better trade when outliers are rare (asserted in the round-trip
+    unit tests).  Returns the scale with ``keepdims`` semantics matching
+    :func:`paddle_tpu.quantization.absmax_scale`."""
+    from ...quantization import absmax_scale
+
+    if method == "absmax":
+        return absmax_scale(x, axis=axis, bits=bits, eps=eps)
+    if method != "percentile":
+        raise ValueError(f"method must be 'absmax' or 'percentile', "
+                         f"got {method!r}")
+    qmax = 2.0 ** (bits - 1) - 1
+    a = jnp.abs(x.astype(jnp.float32))
+    m = jnp.percentile(a, pct) if axis is None \
+        else jnp.percentile(a, pct, axis=axis, keepdims=True)
+    return jnp.maximum(m, jnp.float32(eps)) / jnp.float32(qmax)
+
+
+def kv_quant_error(model, prompts, bits=8):
+    """Per-layer K/V round-trip error on the calibration prompts.
+
+    Runs each prompt densely through the decoder with the legacy
+    concat-cache variant (which hands back every layer's raw K/V — exactly
+    the tensors the paged writes would quantize), rounds them onto the
+    pool grid (per-position-per-head absmax, ``ops.paged_attention.
+    quantize_kv``'s layout) and returns the relative L2 error per layer."""
+    from ...framework.state import no_grad_ctx
+    from ...ops.quant import dequantize, quantize_absmax
+    from ...tensor.tensor import Tensor
+
+    gpt = model.gpt
+    L = len(gpt.layers)
+    blk = gpt.layers[0]
+    qkv_w = getattr(blk.qkv, "weight", None)
+    if qkv_w is None:
+        qkv_w = blk.qkv.weight_int8
+    h = qkv_w.shape[-1] // (3 * blk.head_dim)
+    sq_err = np.zeros(L)
+    sq_ref = np.zeros(L)
+    for p in prompts:
+        ids = Tensor(jnp.asarray(np.asarray(p, np.int64)[None, :]))
+        empty = jnp.zeros((1, 0, h, blk.head_dim),
+                          gpt.word_embeddings.weight._value.dtype)
+        lc = [(Tensor(empty), Tensor(empty)) for _ in range(L)]
+        with no_grad_ctx():
+            _, new_cache = gpt(ids, cache=lc)
+        for i, (k, v) in enumerate(new_cache):
+            for t in (k._value, v._value):
+                t = t.astype(jnp.float32)
+                q, scale = quantize_absmax(t, axis=-1, bits=bits)
+                d = dequantize(q, scale) - t
+                sq_err[i] += float(jnp.sum(d * d))
+                sq_ref[i] += float(jnp.sum(t * t))
+    return [float(np.sqrt(e / max(r, 1e-12)))
+            for e, r in zip(sq_err, sq_ref)]
+
+
+def _run_engine(model, prompts, max_new_tokens, kv_dtype, page_size,
+                num_slots, timeout, engine_kwargs):
+    from ..engine import ServingEngine
+
+    max_len = max(len(p) for p in prompts) + max_new_tokens
+    eng = ServingEngine(model, num_slots=num_slots, page_size=page_size,
+                        max_model_len=max_len, kv_dtype=kv_dtype,
+                        **(engine_kwargs or {}))
+    with eng:
+        handles = [eng.submit(p, max_new_tokens=max_new_tokens)
+                   for p in prompts]
+        ids = [h.result(timeout=timeout) for h in handles]
+        stats = eng.stats()
+    return ids, stats
+
+
+def top1_agreement(ref_ids, got_ids):
+    """Fraction of generated positions whose token matches the reference
+    stream, over all requests (compared up to the shorter stream)."""
+    match = total = 0
+    for r, g in zip(ref_ids, got_ids):
+        n = min(len(r), len(g))
+        total += max(len(r), len(g))
+        match += sum(1 for i in range(n) if r[i] == g[i])
+    return match / total if total else 1.0
+
+
+def calibrate(model, prompts, max_new_tokens=32, weight_dtype=None,
+              scale_method="absmax", pct=99.9, bits=8, page_size=16,
+              num_slots=4, engine_kwargs=None, timeout=600):
+    """Run the calibration workflow (module docstring) and return the
+    report dict.  ``weight_dtype="int8"`` additionally converts the
+    model's Linears in place (reference is captured first)."""
+    from ..adapter import GPTAdapter
+    from .adapter import QuantizedGPTAdapter
+    from .weights import quantize_model_weights, weight_quant_error
+
+    prompts = [[int(t) for t in np.asarray(p).reshape(-1)] for p in prompts]
+
+    # 1. full-precision reference FIRST (weight conversion is in-place)
+    ref_ids, ref_stats = _run_engine(
+        model, prompts, max_new_tokens, None, page_size, num_slots,
+        timeout, engine_kwargs)
+
+    # 2. per-layer round-trip errors on the calibration batch
+    per_layer_kv = kv_quant_error(model, prompts, bits=bits)
+    per_layer_w = weight_quant_error(model, bits=bits)
+
+    # 3. weight scales (+ optional in-place conversion)
+    converted = 0
+    scales = None
+    if weight_dtype is not None and str(weight_dtype).lower() == "int8":
+        from ...nn import Linear
+
+        scales = {}
+        for name, sub in model.named_sublayers(include_self=False):
+            if isinstance(sub, Linear):
+                scales[name] = float(choose_scale(
+                    sub.weight._value, method=scale_method, pct=pct,
+                    bits=bits))
+        converted = quantize_model_weights(model, scales=scales, bits=bits)
+
+    # 4. the int8 engine on the same prompts
+    q_ids, q_stats = _run_engine(
+        model, prompts, max_new_tokens, "int8", page_size, num_slots,
+        timeout, engine_kwargs)
+    agreement = top1_agreement(ref_ids, q_ids)
+
+    # 5. occupancy: bytes/token and resident slots at an equal HBM budget
+    base = GPTAdapter(model, page_size)
+    quant = QuantizedGPTAdapter(model, page_size)
+    bpt = {"reference": base.page_bytes() / page_size,
+           "int8": quant.page_bytes() / page_size}
+    return {
+        "requests": len(prompts),
+        "max_new_tokens": max_new_tokens,
+        "top1_agreement": agreement,
+        "per_layer_kv_error": per_layer_kv,
+        "per_layer_weight_error": per_layer_w,
+        "weight_scales": scales,
+        "weights_converted": converted,
+        "kv_bytes_per_token": bpt,
+        "occupancy_ratio": bpt["reference"] / bpt["int8"],
+        "reference_stats": ref_stats,
+        "quantized_stats": q_stats,
+        "reference_ids": ref_ids,
+        "quantized_ids": q_ids,
+    }

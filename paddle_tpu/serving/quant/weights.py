@@ -1,0 +1,85 @@
+"""Int8 weight path for the serving engine.
+
+``quantize_model_weights(model)`` converts every decoder ``nn.Linear`` to
+a :class:`paddle_tpu.quantization.Int8Linear` IN PLACE — weights live in
+HBM as int8 buffers, matmuls run int8 x int8 -> int32 on the MXU, and the
+shared grid (``quantization.quantize`` / ``quantize_absmax``) guarantees
+the scales agree with the KV-pool path.  ``ServingEngine(weight_dtype=
+"int8")`` calls this before building its adapter; the conversion is
+idempotent, so N cluster replicas over one shared model convert it once.
+
+Scales come from, in priority order:
+
+1. an explicit ``scales`` dict ``{sublayer_name: w_scale}`` — e.g. the
+   output of :func:`paddle_tpu.quantization.extract_scales` after a
+   PTQ/QAT pass, with the ``.weight_quanter`` suffix accepted too, or the
+   calibration harness (``serving.quant.calibrate``);
+2. per-layer absmax over the current weight values (the PTQ-free default).
+
+Activations quantize dynamically per call (Int8Linear's ``act_scale=None``
+path) unless ``scales`` carries ``<name>.act_quanter`` entries.
+
+NOTE: conversion mutates the model the caller passed in — generate() and
+every engine sharing it see int8 weights afterwards.  To compare against
+the full-precision model, run the reference BEFORE converting (what
+``serving.quant.calibrate`` does).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _resolve_parent(model, name):
+    parent = model
+    parts = name.split(".")
+    for p in parts[:-1]:
+        parent = getattr(parent, p)
+    return parent, parts[-1]
+
+
+def quantize_model_weights(model, scales=None, bits=8):
+    """Convert the model's ``nn.Linear`` sublayers to int8 (see module
+    docstring).  Returns the number of layers converted this call (0 when
+    the model was already converted — the idempotence the cluster's
+    shared-model replicas rely on)."""
+    from ...nn import Linear
+    from ...quantization import Int8Linear, absmax_scale
+
+    scales = scales or {}
+    converted = 0
+    for name, sub in list(model.named_sublayers(include_self=False)):
+        if not isinstance(sub, Linear):
+            continue
+        w_scale = scales.get(name, scales.get(f"{name}.weight_quanter"))
+        if w_scale is None:
+            w_scale = float(absmax_scale(sub.weight._value, bits=bits))
+        if w_scale <= 1e-7:
+            # degenerate scale (un-calibrated observer floor): converting
+            # would saturate every weight — leave this layer full precision
+            continue
+        act_scale = scales.get(f"{name}.act_quanter")
+        parent, attr = _resolve_parent(model, name)
+        setattr(parent, attr,
+                Int8Linear(sub, w_scale, act_scale, bits=bits))
+        converted += 1
+    return converted
+
+
+def weight_quant_error(model, bits=8):
+    """Per-Linear relative round-trip error ``||deq(q(w)) - w|| / ||w||``
+    for every not-yet-converted ``nn.Linear`` — the per-layer accuracy
+    preview the calibration report carries."""
+    from ...nn import Linear
+    from ...quantization import dequantize, quantize_absmax
+
+    out = {}
+    for name, sub in model.named_sublayers(include_self=False):
+        if not isinstance(sub, Linear):
+            continue
+        w = sub.weight._value.astype(jnp.float32)
+        q, scale = quantize_absmax(w, bits=bits)
+        err = jnp.linalg.norm(dequantize(q, scale) - w) \
+            / jnp.maximum(jnp.linalg.norm(w), 1e-12)
+        out[name] = float(err)
+    return out

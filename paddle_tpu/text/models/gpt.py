@@ -96,6 +96,70 @@ class GPTDecoderLayer(Layer):
         heads_here = qkv.shape[-1] // (3 * self.head_dim)
         qkv = qkv.reshape([B, S, heads_here, 3, self.head_dim])
         q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        if cache is not None and len(cache) == 7 \
+                and cache[0] in ("served_q", "served_chunk_q"):
+            # QUANTIZED paged serving (paddle_tpu.serving.quant): the same
+            # global-pool/page-table/per-slot-lens contract as the "served"
+            # and "served_chunk" variants below, but the pools hold int8
+            # payloads with parallel per-(slot, head) scale pools — quant
+            # is fused into every pool write and dequant into the paged
+            # attention consumers (ops.paged_attention int8 section), so a
+            # full-precision cache copy never materializes in HBM.
+            from ...ops.paged_attention import (
+                paged_attention_quantized, paged_chunk_attend_quant,
+                paged_table_chunk_write_quant, paged_table_prefill_write_quant,
+                paged_table_token_write_quant)
+
+            tag, kp, vp, ks, vs, table, lens = cache
+            if tag == "served_chunk_q":
+                # speculative verify chunk: C tokens per slot, one
+                # quantizing scatter each for K and V, then every position
+                # attends with its own valid length
+                kp, ks = _apply(paged_table_chunk_write_quant, kp, ks, k,
+                                table, lens, n_outs=None,
+                                op_name="paged_write")
+                vp, vs = _apply(paged_table_chunk_write_quant, vp, vs, v,
+                                table, lens, n_outs=None,
+                                op_name="paged_write")
+                attn = _apply(paged_chunk_attend_quant, q, kp, vp, ks, vs,
+                              table, lens, op_name="paged_attention")
+            elif S > 1:
+                # admit-time prefill: dense causal attention over the
+                # full-precision prompt activations (only the CACHE is
+                # quantized), quantizing page writes
+                attn = F.scaled_dot_product_attention(
+                    q, k, v, is_causal=True, dropout_p=0.0, training=False)
+                kp, ks = _apply(paged_table_prefill_write_quant, kp, ks, k,
+                                table, n_outs=None, op_name="paged_write")
+                vp, vs = _apply(paged_table_prefill_write_quant, vp, vs, v,
+                                table, n_outs=None, op_name="paged_write")
+            else:
+                kp, ks = _apply(
+                    lambda pool, sp, kk, tb, ln:
+                        paged_table_token_write_quant(pool, sp, kk[:, 0],
+                                                      tb, ln),
+                    kp, ks, k, table, lens, n_outs=None,
+                    op_name="paged_write")
+                vp, vs = _apply(
+                    lambda pool, sp, vv, tb, ln:
+                        paged_table_token_write_quant(pool, sp, vv[:, 0],
+                                                      tb, ln),
+                    vp, vs, v, table, lens, n_outs=None,
+                    op_name="paged_write")
+                attn = _apply(
+                    lambda qq, kpl, vpl, ksc, vsc, tb, ln:
+                        paged_attention_quantized(
+                            qq[:, 0], kpl, vpl, ksc, vsc, tb,
+                            ln.astype(jnp.int32) + 1)[:, None],
+                    q, kp, vp, ks, vs, table, lens,
+                    op_name="paged_attention")
+            attn = attn.reshape([B, S, heads_here * self.head_dim])
+            x = residual + self.dropout(self.out_proj(attn))
+            residual = x
+            h = self.ln2(x)
+            h = self.ffn2(self.act(self.ffn1(h)))
+            x = residual + self.dropout(h)
+            return x, (tag, kp, vp, ks, vs, table, lens)
         if cache is not None and len(cache) == 5 and cache[0] == "served_chunk":
             # SPECULATIVE VERIFY chunk (paddle_tpu.serving.speculative): the
             # S tokens of each row are the slot's last sampled token plus

@@ -5,6 +5,7 @@ parallelism on the fake 8-device mesh, matching unsharded training
 import numpy as np
 
 import jax
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.optimizer as opt
@@ -145,6 +146,7 @@ def test_gpt_pipe_interleaved_trains():
         topo.set_hybrid_communicate_group(None)
 
 
+@pytest.mark.slow  # ~24s schedule-parity sweep; tier-1 budget (PR-2 rule)
 def test_gpt_pipe_1f1b_matches_gpipe():
     """schedule='1f1b' (O(S)-memory backward) trains identically to gpipe."""
     from paddle_tpu.distributed import topology as topo

@@ -336,6 +336,7 @@ def test_voc2012_dataset(tmp_path):
         VOC2012(data_file=str(tmp_path / "nowhere"))
 
 
+@pytest.mark.slow  # ~17s transforms+models sweep; tier-1 budget (PR-2 rule)
 def test_transforms_affine_perspective_and_models():
     from paddle_tpu.vision import transforms as T
     from paddle_tpu.vision.transforms import functional as TF

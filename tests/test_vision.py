@@ -32,6 +32,7 @@ def test_small_model_forwards(ctor):
     assert out.shape[1] in (7, 10)
 
 
+@pytest.mark.slow  # ~21s training loop; tier-1 budget (PR-2 rule)
 def test_resnet_train_loss_decreases():
     paddle.seed(0)
     m = models.ResNet(models.BasicBlock, 18, num_classes=4)
