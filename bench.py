@@ -423,6 +423,158 @@ def _serving_quant_report(kv_dtype="int8"):
     return out
 
 
+_BENCH_MT_SCHEMA = {"type": "object",
+                    "properties": {"x": {"type": "integer"},
+                                   "ok": {"type": "boolean"}}}
+
+
+def _bench_mt_vocab(vocab_size):
+    """A token-string map over the model's ids so grammar rows are
+    spellable: JSON machinery chars first, filler for the rest, EOS
+    last.  The cyclic training stream only uses ids 1..period, so the
+    mapping is free to spend the rest of the id space on JSON."""
+    chars = list("0123456789{}[]\",:-abcdefghijklmnopqrstuvwxyz. _")
+    vocab = ["<pad>"] + chars + ["true", "false", "null"]
+    vocab += [f"<u{i}>" for i in range(vocab_size - 1 - len(vocab))]
+    return vocab + ["<eos>"]
+
+
+def _measure_serving_multitenant(mode="multi", n_adapters=2,
+                                 reqs_per_adapter=8, n_constrained=4,
+                                 S0=24, page_size=8, max_new=64,
+                                 train_steps=150, model_kwargs=None):
+    """ONE arm of the multi-tenant comparison (ISSUE-9 satellite):
+
+    - ``multi``: ONE MultiTenantEngine serves every adapter's requests
+      plus the schema-constrained rows — per-row paged adapter gather in
+      one batched decode program;
+    - ``dedicated``: N per-adapter engines (plus the constrained rows on
+      engine 0) at the SAME total HBM budget — the multi engine gets
+      2N+2 decode slots, the dedicated fleet 2 slots per adapter + 2,
+      with full-residency page pools either way, so pool HBM is equal by
+      construction.
+
+    Reports aggregate tokens/sec, per-adapter ITL p95 (computed from the
+    caller-observed token timelines, since the shared histograms carry no
+    adapter label), schema-validity rate over the constrained rows, and
+    the full per-request ids so the parent can assert the multi batch is
+    greedy-identical to the dedicated engines."""
+    import time
+
+    from paddle_tpu.serving.multitenant import (
+        LoRAAdapter, LoRAStore, MultiTenantEngine, compile_json_schema)
+
+    kw = dict(model_kwargs or {})
+    m, cyc, period = _overfit_cyclic_gpt(kw, train_steps=train_steps)
+    vocab = _bench_mt_vocab(int(m.gpt.word_embeddings.weight.shape[0]))
+    grammar = compile_json_schema(_BENCH_MT_SCHEMA, vocab, len(vocab) - 1)
+    names = [f"tenant-{i}" for i in range(n_adapters)]
+    max_len = S0 + max_new
+
+    def adapters_for(model, subset):
+        store = LoRAStore(model, capacity=max(len(subset), 2), ranks=(4,),
+                          targets=("qkv", "out_proj"))
+        for n in subset:
+            store.register(LoRAAdapter.random(
+                model, n, rank=4, seed=100 + names.index(n), scale=0.05))
+        return store
+
+    gen_work = [(n, cyc[(3 * i) % period:(3 * i) % period + S0].tolist())
+                for n in names for i in range(reqs_per_adapter)]
+    con_prompts = [cyc[i % period:i % period + S0].tolist()
+                   for i in range(n_constrained)]
+
+    def eng(model, store, slots):
+        return MultiTenantEngine(model, lora_store=store, num_slots=slots,
+                                 page_size=page_size, max_model_len=max_len)
+
+    if mode == "multi":
+        e = eng(m, adapters_for(m, names), 2 * n_adapters + 2)
+        engines = {n: e for n in names}
+        con_engine = e
+        all_engines = [e]
+    else:
+        all_engines = []
+        engines = {}
+        for i, n in enumerate(names):
+            slots = 4 if i == 0 else 2      # engine 0 also serves grammar
+            engines[n] = eng(m, adapters_for(m, [n]), slots)
+            all_engines.append(engines[n])
+        con_engine = all_engines[0]
+    for e in all_engines:
+        e.start()
+        e.generate(gen_work[0][1], max_new_tokens=4, timeout=600)  # compile
+    con_engine.generate(con_prompts[0], max_new_tokens=8, grammar=grammar,
+                        timeout=600)        # grammar path shares programs
+    try:
+        t0 = time.time()
+        handles = [(n, engines[n].submit(p, max_new_tokens=max_new,
+                                         adapter=n))
+                   for n, p in gen_work]
+        con_handles = [con_engine.submit(p, max_new_tokens=max_new,
+                                         grammar=grammar)
+                       for p in con_prompts]
+        ids = [(n, h.result(timeout=600)) for n, h in handles]
+        con_ids = [h.result(timeout=600) for h in con_handles]
+        dt = time.time() - t0
+        itl = {}
+        for n in names:                     # caller-observed per-adapter ITL
+            gaps = []
+            for nn, h in handles:
+                if nn == n and len(h.token_times) > 1:
+                    ts = h.token_times
+                    gaps += [ts[j + 1] - ts[j] for j in range(len(ts) - 1)]
+            itl[n] = round(float(np.percentile(gaps, 95)), 6) if gaps \
+                else None
+        valid = sum(1 for r in con_ids if grammar.matches(r))
+    finally:
+        for e in all_engines:
+            e.stop()
+    total = len(gen_work) * max_new + sum(len(r) for r in con_ids)
+    return {
+        "mode": mode,
+        "n_adapters": n_adapters,
+        "tokens": total,
+        "tokens_per_sec": round(total / dt, 2),
+        "per_adapter_itl_p95_s": itl,
+        "schema_validity": round(valid / max(len(con_ids), 1), 4),
+        "ids": [[n, list(map(int, r))] for n, r in ids],
+    }
+
+
+def _serving_multitenant_report(n_adapters):
+    """Both arms (separate subprocesses) + the ISSUE-9 numbers: one
+    engine serving N adapters vs N dedicated engines at the same pool
+    HBM budget — aggregate tokens/sec, per-adapter ITL p95, 100% schema
+    validity, and greedy identity of the multi batch against the
+    dedicated engines."""
+    multi = _section("serving_lora", BENCH_LORA_MODE="multi",
+                     BENCH_LORA_N=str(n_adapters))
+    ded = _section("serving_lora", BENCH_LORA_MODE="dedicated",
+                   BENCH_LORA_N=str(n_adapters))
+    identical = {tuple(k) for k in map(tuple, (
+        (n, tuple(r)) for n, r in multi["ids"]))} == \
+        {tuple(k) for k in map(tuple, ((n, tuple(r))
+                                       for n, r in ded["ids"]))}
+    return {
+        "n_adapters": n_adapters,
+        "multi_tokens_per_sec": multi["tokens_per_sec"],
+        "dedicated_tokens_per_sec": ded["tokens_per_sec"],
+        "multi_vs_dedicated": round(
+            multi["tokens_per_sec"] / max(ded["tokens_per_sec"], 1e-9), 3),
+        "per_adapter_itl_p95_s": multi["per_adapter_itl_p95_s"],
+        "dedicated_itl_p95_s": ded["per_adapter_itl_p95_s"],
+        "schema_validity": min(multi["schema_validity"],
+                               ded["schema_validity"]),
+        "greedy_identical": identical,
+        "note": ("ONE MultiTenantEngine (paged multi-LoRA, per-row "
+                 "adapter gather, 2N+2 slots) vs N dedicated per-adapter "
+                 "engines (2 slots each + 2) at the same full-residency "
+                 "page-pool HBM; schema rows ride both arms and must be "
+                 "100% valid"),
+    }
+
+
 def _measure_serving_cluster(replicas=1, policy="affinity", n_requests=16,
                              num_slots=4, S0=48, page_size=16, max_new=64,
                              prefix_groups=4, model_kwargs=None,
@@ -739,6 +891,12 @@ def _run_section(name):
 
         return _measure_serving_quant(
             kv_dtype=os.environ.get("BENCH_KV_DTYPE", "bf16"))
+    if name == "serving_lora":
+        import os
+
+        return _measure_serving_multitenant(
+            mode=os.environ.get("BENCH_LORA_MODE", "multi"),
+            n_adapters=int(os.environ.get("BENCH_LORA_N", "2")))
     if name == "serving_cluster":
         import os
 
@@ -1043,7 +1201,14 @@ def main():
         spec_k = _spec_k_from_argv()
         n_replicas = _replicas_from_argv()
         kv_dtype = _argv_value("--kv-dtype")
-        if n_replicas:
+        lora_n = _argv_value("--lora")
+        if lora_n:
+            # --lora N: ONE multi-tenant engine serving N LoRA adapters
+            # (+ schema-constrained rows) vs N dedicated engines at the
+            # same pool HBM budget
+            out = {"serving_multitenant":
+                   _serving_multitenant_report(int(lora_n))}
+        elif n_replicas:
             # --replicas N: the multi-replica cluster (prefix-affinity
             # router) vs a single replica and vs random routing
             out = {"serving_cluster": _serving_cluster_report(n_replicas)}

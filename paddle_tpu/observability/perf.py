@@ -145,16 +145,46 @@ def is_quantized_family(family):
     return "@int8" in family
 
 
+def is_lora_family(family):
+    """True for the multi-tenant LoRA program families — the engine
+    attributes them as ``decode@lora-r<r>``, ``prefill/<bucket>@lora-r<r>``
+    (rank-bucket suffix; adapter count never appears)."""
+    return "@lora-r" in family
+
+
+def is_encode_family(family):
+    """True for the embed/score passthrough families
+    (``prefill/<bucket>@embed`` / ``@score``)."""
+    return "@embed" in family or "@score" in family
+
+
 def candidate_hint(family, regime):
     """The regime-driven recommendation :meth:`ProgramTable.report` prints
     for a top device-time program.  Recognizes the quantized serving
     families: a bandwidth-bound UNQUANTIZED serving program's first lever
     is int8 KV pools (dequant fuses into the paged kernel — the
     serving.quant subsystem); an ``@int8`` family has already pulled it,
-    so the hint points at the remaining byte traffic instead."""
+    so the hint points at the remaining byte traffic instead.  Also the
+    multi-tenant families: ``@lora-r<r>`` programs carry the per-row
+    paged adapter gather, ``@embed``/``@score`` are prefill-shaped
+    one-shot encodes."""
     quant = is_quantized_family(family)
     serving = family.split("@")[0].startswith(_KV_BOUND_FAMILIES)
     if regime == "bandwidth-bound":
+        if is_lora_family(family):
+            if quant:
+                return ("HBM-bound int8 multi-LoRA program: KV dequant "
+                        "fused; the remaining levers are the adapter "
+                        "pools — fewer/lower rank buckets, fewer LoRA "
+                        "targets, or bf16 adapter pools")
+            return ("HBM-bound multi-LoRA serving program: the per-row "
+                    "adapter gather rides the decode bytes — shrink rank "
+                    "buckets / targets, then quantize the KV pools "
+                    "(kv_dtype=\"int8\")")
+        if is_encode_family(family):
+            return ("HBM-bound embed/score encode: prefill-shaped one-shot "
+                    "— batch more rows per dispatch or share prefix "
+                    "compute with generate admissions")
         if quant:
             return ("HBM-bound int8 serving program: KV dequant already "
                     "fused in-kernel — cut the remaining bytes (int8 "

@@ -1,0 +1,73 @@
+"""Paged LoRA adapter gather — the device side of multi-tenant serving.
+
+S-LoRA-style layout (Sheng et al., "S-LoRA: Serving Thousands of
+Concurrent LoRA Adapters"): adapter weights live in GLOBAL rank-bucketed
+pools shared by every request, and each batch row gathers ITS adapter's
+low-rank pair by id inside the compiled program — so one decode dispatch
+serves many fine-tunes and the program count is a function of the rank
+buckets, never of the adapter count.
+
+Layout per (decoder Linear target, rank bucket r):
+
+    A_pool [L, C+1, d_in,  r]   down-projections, one row per adapter slot
+    B_pool [L, C+1, r, d_out]   up-projections, SCALING PRE-FOLDED into B
+    aid    [B] int32            per-batch-row adapter slot (0 = the null
+                                slot: all-zero weights, i.e. base model)
+
+Row 0 of every pool is the reserved NULL adapter (zeros) — exactly the
+scratch-page trick the paged KV pools use: every gather index is valid,
+and a base-model row's delta is an exact zero.
+
+The delta is the standard LoRA bypass ``(x @ A) @ B`` (scaling alpha/r
+folded into B at registration), batched per row::
+
+    gather_adapter(pool[l], aid)      [C+1, i, r][aid] -> [B, i, r]
+    lora_delta(x, A_sel, B_sel, ...)  [B, S, i] -> [B, S, o]
+
+Ranks are BUCKETED: an adapter of rank r registers into the smallest
+configured bucket >= r with zero-padded A columns / B rows — zero columns
+contribute exact zeros to the contraction, so bucketing never changes the
+math, only the pool shapes (and therefore the compiled-program family:
+``decode@lora-r<r>``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gather_adapter(pool, aid):
+    """Per-row adapter gather: ``pool [C+1, ...]`` indexed by ``aid [B]``
+    int32 -> ``[B, ...]``.  Inside a compiled program this lowers to one
+    dynamic-gather over the slot axis (the pool stays resident in HBM; no
+    per-adapter program specialization)."""
+    return pool[aid.astype(jnp.int32)]
+
+
+def lora_delta(x, *pairs):
+    """Sum of low-rank bypass deltas for one Linear call.
+
+    ``x [B, S, d_in]``; ``pairs`` = alternating per-row gathered
+    ``A [B, d_in, r]``, ``B [B, r, d_out]`` (one pair per rank bucket —
+    a row's adapter lives in exactly one bucket; its rows in the other
+    buckets are the null slot, contributing exact zeros).  Returns
+    ``[B, S, d_out]`` in f32-accumulated then cast back to ``x.dtype``
+    (bf16 LoRA over an int8 base keeps the bypass math in full precision).
+    """
+    if len(pairs) % 2:
+        raise ValueError("pairs must be alternating A, B arrays")
+    out = None
+    xf = x.astype(jnp.float32)
+    for i in range(0, len(pairs), 2):
+        a = pairs[i].astype(jnp.float32)
+        b = pairs[i + 1].astype(jnp.float32)
+        d = (xf @ a) @ b                       # [B,S,i]@[B,i,r]@[B,r,o]
+        out = d if out is None else out + d
+    return out.astype(x.dtype)
+
+
+def apply_lora(x, y, *pairs):
+    """``y + lora_delta(x, *pairs)`` — the fused spelling the decoder
+    layer calls through ``tensor.dispatch.apply`` (x is the Linear's
+    input, y its base output)."""
+    return y + lora_delta(x, *pairs).astype(y.dtype)

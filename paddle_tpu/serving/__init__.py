@@ -23,6 +23,12 @@ cache (ROADMAP north star: "serves heavy traffic from millions of users").
   "int8")``), the :func:`quantize_model_weights` Int8Linear weight path,
   and the :func:`calibrate` accuracy harness (README "Quantized
   serving").
+- :mod:`.multitenant` — multi-tenant serving: paged multi-LoRA
+  (:class:`LoRAStore` rank-bucketed adapter pools with per-row gather
+  inside the compiled programs), grammar-constrained decoding
+  (:func:`compile_json_schema` / :func:`compile_regex` token FSMs masking
+  the batched sampler), and embed/score request modes — all batched by
+  ONE :class:`MultiTenantEngine` (README "Multi-tenant serving").
 
 Metrics (PR-1 registry, README "Serving"): ``serving.*`` histograms /
 gauges / counters — TTFT, inter-token latency, queue depth, slot
@@ -46,6 +52,10 @@ from .cluster import (  # noqa: F401
 from .quant import (  # noqa: F401
     QuantizedGPTAdapter, calibrate, quantize_model_weights,
 )
+from .multitenant import (  # noqa: F401
+    CompiledGrammar, LoRAAdapter, LoRAStore, MultiTenantEngine,
+    compile_json_schema, compile_regex,
+)
 
 __all__ = [
     "ServingEngine", "Request", "RequestHandle", "RequestRejectedError",
@@ -54,4 +64,6 @@ __all__ = [
     "make_verifier", "ServingCluster", "ClusterHandle", "ReplicaPool",
     "PrefixAffinityRouter", "RouteDecision", "SLOPolicy",
     "QuantizedGPTAdapter", "quantize_model_weights", "calibrate",
+    "MultiTenantEngine", "LoRAStore", "LoRAAdapter", "CompiledGrammar",
+    "compile_regex", "compile_json_schema",
 ]

@@ -104,7 +104,8 @@ class GPTAdapter:
                 jnp.stack([c[2]._value for c in new_cache]))
 
     # ------------------------------------------------------------- closures
-    def _run(self, params, bufs, ids, pools, table, lens, pos_ids, tag):
+    def _run(self, params, bufs, ids, pools, table, lens, pos_ids, tag,
+             lora=None):
         from ..framework import random as _rng
         from ..framework.state import no_grad_ctx
         from ..tensor.tensor import Tensor
@@ -114,7 +115,7 @@ class GPTAdapter:
                 self.model.bind(params, bufs):
             lc = self._layer_caches(pools, table, lens, tag)
             x, new_cache = gpt(Tensor(ids), position_ids=Tensor(pos_ids),
-                               cache=lc)
+                               cache=lc, lora=lora)
             w = gpt.word_embeddings.weight._value
             return x._value, w, self._stack_pools(new_cache)
 
@@ -126,23 +127,50 @@ class GPTAdapter:
                 f"arrays + table + lens; got {len(args)} trailing args")
         return tuple(args[:self.n_pools]), args[-2], args[-1]
 
-    def prefill(self, params, bufs, ids, *args):
+    def _split_extra(self, args):
+        """``(pools, table, lens, lora)`` — THE extension hook: an
+        adapter carrying extra trailing dispatch args (multi-tenant LoRA:
+        per-row adapter ids + the rank-bucketed pools) overrides this one
+        method; the prefill/step/verify/encode closure bodies below stay
+        single-copy."""
         pools, table, lens = self._split(args)
+        return pools, table, lens, None
+
+    def prefill(self, params, bufs, ids, *args):
+        pools, table, lens, lora = self._split_extra(args)
         S = ids.shape[1]
         pos_ids = jnp.arange(S, dtype=jnp.int64)[None, :]
         x, w, pools = self._run(params, bufs, ids, pools, table, lens,
-                                pos_ids, self.tag)
+                                pos_ids, self.tag, lora=lora)
         # logits at each row's LAST REAL position (rows are right-padded)
         idx = (lens.astype(jnp.int32) - 1)[:, None, None]
         h = jnp.take_along_axis(x, idx, axis=1)[:, 0]
         logits = h.astype(jnp.float32) @ w.T.astype(jnp.float32)
         return (logits,) + pools
 
+    def encode(self, params, bufs, ids, *args):
+        """Embedding/scoring forward (multi-tenant serving's
+        ``mode="embed"|"score"`` requests): run the (right-padded) prompts
+        like :meth:`prefill` but return the FULL hidden states and the
+        tied LM-head weights instead of last-position logits — the embed
+        program pools them, the score program turns them into per-token
+        logprobs.  K/V still flows through the pool writes (the caller
+        points every table row at the scratch page, so nothing is
+        allocated and the junk is never attended).
+
+        Returns ``(hidden [B, S, H] f32, w [V, H] f32, *pools)``."""
+        pools, table, lens, lora = self._split_extra(args)
+        S = ids.shape[1]
+        pos_ids = jnp.arange(S, dtype=jnp.int64)[None, :]
+        x, w, pools = self._run(params, bufs, ids, pools, table, lens,
+                                pos_ids, self.tag, lora=lora)
+        return (x.astype(jnp.float32), w.astype(jnp.float32)) + pools
+
     def step(self, params, bufs, last, *args):
-        pools, table, lens = self._split(args)
+        pools, table, lens, lora = self._split_extra(args)
         pos_ids = lens[:, None].astype(jnp.int64)
         x, w, pools = self._run(params, bufs, last, pools, table, lens,
-                                pos_ids, self.tag)
+                                pos_ids, self.tag, lora=lora)
         logits = x[:, -1].astype(jnp.float32) @ w.T.astype(jnp.float32)
         return (logits,) + pools
 
@@ -157,7 +185,7 @@ class GPTAdapter:
         accepting/rejecting draft t+1 needs.
 
         Returns ``(logits [B, C, V] f32, *pools)``."""
-        pools, table, lens = self._split(args)
+        pools, table, lens, lora = self._split_extra(args)
         C = ids.shape[1]
         pos_ids = lens[:, None].astype(jnp.int64) \
             + jnp.arange(C, dtype=jnp.int64)[None, :]
@@ -166,6 +194,6 @@ class GPTAdapter:
         # junk the engine never reads (draft lengths are capped host-side)
         pos_ids = jnp.minimum(pos_ids, self.max_model_len - 1)
         x, w, pools = self._run(params, bufs, ids, pools, table, lens,
-                                pos_ids, self.chunk_tag)
+                                pos_ids, self.chunk_tag, lora=lora)
         logits = x.astype(jnp.float32) @ w.T.astype(jnp.float32)
         return (logits,) + pools

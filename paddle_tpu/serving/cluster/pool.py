@@ -33,9 +33,18 @@ class ReplicaPool:
     """
 
     def __init__(self, model, replicas=None, devices=None, replica_prefix="",
-                 **engine_kwargs):
+                 engine_cls=None, **engine_kwargs):
         from ..engine import ServingEngine
 
+        if engine_cls is None:
+            # multi-tenant kwargs (shared LoRAStore) pick the multi-tenant
+            # engine automatically; an explicit engine_cls= overrides
+            if "lora_store" in engine_kwargs:
+                from ..multitenant import MultiTenantEngine
+
+                engine_cls = MultiTenantEngine
+            else:
+                engine_cls = ServingEngine
         if devices == "auto":
             devices = list(jax.devices())
         if devices is not None and not devices:
@@ -50,7 +59,7 @@ class ReplicaPool:
         self.engines = []
         for i in range(replicas):
             dev = devices[i % len(devices)] if devices is not None else None
-            self.engines.append(ServingEngine(
+            self.engines.append(engine_cls(
                 model, replica=f"{replica_prefix}{i}", device=dev,
                 **engine_kwargs))
 

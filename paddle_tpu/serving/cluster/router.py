@@ -65,6 +65,19 @@ def prefix_key(prompt_ids, affinity_tokens):
     return (",".join(map(str, head))).encode()
 
 
+def routing_key(prompt_ids, affinity_tokens, adapter=None):
+    """The rendezvous key a request hashes on: **adapter affinity** when
+    the request names a LoRA tenant (multi-tenant serving — same-tenant
+    requests land together so the adapter is paged into ONE replica's
+    pools instead of occupying a slot on all of them), else the prompt's
+    prefix key (prefix-page affinity).  The two namespaces cannot
+    collide: adapter keys carry a ``adapter|`` prefix no token spelling
+    produces."""
+    if adapter is not None:
+        return b"adapter|" + str(adapter).encode()
+    return prefix_key(prompt_ids, affinity_tokens)
+
+
 class PrefixAffinityRouter:
     """See module docstring.
 
@@ -99,10 +112,11 @@ class PrefixAffinityRouter:
         return max(range(self.n_replicas),
                    key=lambda i: self._score(key, i))
 
-    def affine_index(self, prompt_ids):
-        """The prefix's rendezvous winner over ALL replica indices."""
+    def affine_index(self, prompt_ids, adapter=None):
+        """The request's rendezvous winner over ALL replica indices
+        (adapter affinity when ``adapter`` names a tenant)."""
         return self._affine_for_key(
-            prefix_key(prompt_ids, self.affinity_tokens))
+            routing_key(prompt_ids, self.affinity_tokens, adapter))
 
     # -------------------------------------------------------------- policy
     @staticmethod
@@ -123,15 +137,16 @@ class PrefixAffinityRouter:
                    key=lambda i: (self._load(states[i]),
                                   -self._score(key, i)))
 
-    def route(self, prompt_ids, states):
+    def route(self, prompt_ids, states, adapter=None):
         """Pick a replica for this prompt given live state snapshots
         (dicts with ``state``/``stalled``/``queue_depth``/``active``/
-        ``num_slots``).  Returns ``None`` when no replica is routable —
-        the caller sheds the request."""
+        ``num_slots``).  ``adapter`` switches the rendezvous key to the
+        tenant's (see :func:`routing_key`).  Returns ``None`` when no
+        replica is routable — the caller sheds the request."""
         if len(states) != self.n_replicas:
             raise ValueError(f"router built for {self.n_replicas} replicas, "
                              f"got {len(states)} states")
-        key = prefix_key(prompt_ids, self.affinity_tokens)
+        key = routing_key(prompt_ids, self.affinity_tokens, adapter)
         affine = self._affine_for_key(key)
         routable = [i for i, st in enumerate(states)
                     if st.get("state") in ROUTABLE_STATES]

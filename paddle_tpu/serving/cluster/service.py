@@ -66,13 +66,20 @@ class ClusterHandle(RequestHandle):
     served it (length > 1 ⇒ it survived a replica loss)."""
 
     def __init__(self, request_id, prompt, max_new_tokens, sampling,
-                 eos_token_id, deadline):
+                 eos_token_id, deadline, adapter=None, grammar=None,
+                 mode="generate", pooling="mean"):
         super().__init__(request_id, len(prompt))
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.sampling = sampling
         self.eos_token_id = eos_token_id
         self.deadline = deadline            # absolute time.time(), or None
+        # multi-tenant fields ride the outer handle so failover legs
+        # re-submit with the same tenant/grammar/mode
+        self.adapter = adapter
+        self.grammar = grammar
+        self.mode = mode
+        self.pooling = pooling
         self.replica_history = []
         self._inner = None                  # current leg's engine handle
         self._legs = 0
@@ -279,9 +286,14 @@ class ServingCluster:
 
     # ------------------------------------------------------------------ api
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
-               eos_token_id=None, deadline_s=None, sampling=None):
+               eos_token_id=None, deadline_s=None, sampling=None,
+               adapter=None, grammar=None, mode="generate", pooling="mean"):
         """Route one request onto a replica; returns a
-        :class:`ClusterHandle` immediately."""
+        :class:`ClusterHandle` immediately.  ``adapter`` (LoRA tenant),
+        ``grammar`` (constrained decoding) and ``mode`` (generate | embed
+        | score) forward to the replica engines — multi-tenant pools only
+        (``ReplicaPool(lora_store=...)``); adapter-named requests route by
+        ADAPTER affinity so a tenant's weights page into one replica."""
         prompt = ServingEngine._normalize_prompt(prompt_ids)
         if not prompt:
             raise ValueError("empty prompt")
@@ -292,9 +304,12 @@ class ServingCluster:
             else SamplingParams(temperature=temperature)
         deadline = time.time() + deadline_s if deadline_s is not None \
             else None
+        if grammar is not None and eos_token_id is None:
+            eos_token_id = grammar.eos_token_id
         h = ClusterHandle(f"c{next(self._rid)}", prompt,
                           int(max_new_tokens), sampling, eos_token_id,
-                          deadline)
+                          deadline, adapter=adapter, grammar=grammar,
+                          mode=mode, pooling=pooling)
         # register BEFORE the leg, atomically with the stopping check: a
         # submit racing stop() either rejects here or its handle is seen
         # by stop()'s leftover sweep — never a live handle nobody pumps
@@ -307,11 +322,17 @@ class ServingCluster:
             self._m_inflight.set(len(self._inflight))
         try:
             self._submit_leg(h, prompt, h.max_new_tokens, deadline_s)
-        except RequestRejectedError as e:
+        except BaseException as e:
+            # EVERY failed first leg must unregister the handle — not
+            # just engine rejections: a multi-tenant validation error
+            # (ValueError/KeyError from an unknown adapter or a
+            # mismatched grammar) would otherwise leave a never-finished
+            # handle in _inflight for the monitor to pump forever
             with self._lock:
                 self._inflight.discard(h)
                 self._m_inflight.set(len(self._inflight))
-            self._m_rejected.inc(reason=e.reason)
+            if isinstance(e, RequestRejectedError):
+                self._m_rejected.inc(reason=e.reason)
             raise
         return h
 
@@ -327,7 +348,7 @@ class ServingCluster:
         from the chosen engine (bounded queue, deadline shed) spills to
         the next-best routable replica before surfacing."""
         states = self._pool.states()
-        dec = self._router.route(prompt, states)
+        dec = self._router.route(prompt, states, adapter=h.adapter)
         self._m_routable.set(sum(1 for st in states
                                  if st["state"] in ROUTABLE_STATES))
         if dec is None:
@@ -353,10 +374,19 @@ class ServingCluster:
                                hit=idx == dec.affine, policy=dec.policy,
                                reason=dec.reason, leg=h._legs + 1):
                 try:
+                    fsm_state = None
+                    if h.grammar is not None and h.token_ids:
+                        # failover resume: replay the emitted tokens so
+                        # the new leg's FSM starts mid-document, exactly
+                        # where the lost replica left the grammar
+                        fsm_state = h.grammar.advance_seq(
+                            h.grammar.start, h.token_ids)
                     inner = eng.submit(
                         prompt, max_new_tokens=max_new,
                         eos_token_id=h.eos_token_id, deadline_s=deadline_s,
-                        sampling=h.sampling, _autostart=False)
+                        sampling=h.sampling, adapter=h.adapter,
+                        grammar=h.grammar, mode=h.mode, pooling=h.pooling,
+                        _fsm_state=fsm_state, _autostart=False)
                 except (RequestRejectedError, RuntimeError) as e:
                     # RequestRejectedError: engine shed it (bounded queue,
                     # deadline, draining).  RuntimeError (incl. Engine-
@@ -434,6 +464,7 @@ class ServingCluster:
             return
         h._inner = None
         h._error = inner._error
+        h.value = inner.value           # embed vector / score list
         self._finish_outer(h, status)
 
     def _try_reroute(self, h):
@@ -445,7 +476,8 @@ class ServingCluster:
         routable, every survivor rejected it)."""
         if h._legs > self._max_reroutes:
             return False
-        remaining = h.max_new_tokens - len(h.token_ids)
+        remaining = h.max_new_tokens - len(h.token_ids) \
+            if h.mode == "generate" else 1    # embed/score: just re-run
         if remaining <= 0:   # it had finished; the loss beat the retire
             h._inner = None
             self._finish_outer(h, "completed")
@@ -470,7 +502,8 @@ class ServingCluster:
     def _finish_outer(self, h, status):
         h.status = status
         h.finished_at = time.time()
-        if self._slo is not None and status in ("completed", "expired"):
+        if self._slo is not None and status in ("completed", "expired") \
+                and h.mode == "generate":
             self._slo.observe(h, met_override=False
                               if status == "expired" else None)
         with self._lock:
@@ -520,6 +553,23 @@ class ServingCluster:
     @property
     def engines(self):
         return self._pool.engines
+
+    def register_adapter(self, adapter):
+        """Register a LoRA adapter on every distinct store behind the
+        fleet (one shared store registers once) — multi-tenant pools
+        only."""
+        stores = []
+        for e in self._pool.engines:
+            store = getattr(e, "lora_store", None)
+            if store is None:
+                raise ValueError(
+                    f"replica {e.replica} has no lora_store; build the "
+                    "cluster with ReplicaPool(lora_store=...)")
+            if not any(store is s for s in stores):
+                stores.append(store)
+        for store in stores:
+            store.register(adapter)
+        return adapter.name
 
     def affinity_hit_rate(self):
         total = self._aff_hits + self._aff_misses

@@ -135,6 +135,17 @@ class Request:
     eos_token_id: int | None
     deadline: float | None      # absolute time.time() seconds
     handle: "RequestHandle"
+    # multi-tenant serving (serving/multitenant; every field defaults to
+    # the single-tenant base-model request, so the plain engine's paths
+    # are untouched): the tenant's registered LoRA adapter name, the
+    # compiled token-FSM constraining this row's output, the request kind
+    # (generate | embed | score), the embed pooling, and the store lease
+    # held while the request is admitted
+    adapter: str | None = None
+    grammar: object = None
+    mode: str = "generate"
+    pooling: str = "mean"
+    lease: object = None
 
 
 class RequestHandle:
@@ -148,6 +159,15 @@ class RequestHandle:
     def __init__(self, request_id, prompt_len):
         self.request_id = request_id
         self.prompt_len = prompt_len
+        # multi-tenant surface: request kind, the non-generate result
+        # payload (embed vector / score list), the tenant's adapter name,
+        # and the constrained row's live FSM state (kept on the HANDLE so
+        # an engine restart's re-admission resumes the grammar where the
+        # emitted tokens left it)
+        self.mode = "generate"
+        self.value = None
+        self.adapter = None
+        self._fsm_state = None
         # distributed-tracing identity: every span this request touches
         # (submit -> prefill -> each decode iteration) carries/links it
         self.trace_id = _tracing.new_trace_id()
@@ -179,7 +199,9 @@ class RequestHandle:
         return self._done.is_set()
 
     def result(self, timeout=None):
-        """Generated token ids (blocks until the request finishes)."""
+        """Generated token ids (blocks until the request finishes).
+        ``mode="embed"`` requests return the pooled hidden-state vector,
+        ``mode="score"`` the per-token logprob list."""
         if not self._done.wait(timeout):
             raise TimeoutError(
                 f"request {self.request_id} not finished after {timeout}s")
@@ -187,6 +209,8 @@ class RequestHandle:
             if isinstance(self._error, EngineStoppedError):
                 raise self._error
             raise RuntimeError("serving engine failed") from self._error
+        if self.mode != "generate":
+            return self.value
         return list(self.token_ids)
 
     def stream(self):
@@ -219,9 +243,10 @@ class RequestHandle:
 class _Slot:
     __slots__ = ("handle", "req", "alloc", "table_row", "length", "last",
                  "produced", "temp", "eos", "max_new", "deadline",
-                 "last_token_t")
+                 "last_token_t", "idx")
 
     def __init__(self, req, alloc, table_row):
+        self.idx = None                     # batch lane (set at admission)
         self.handle = req.handle
         self.req = req
         self.alloc = alloc
@@ -595,6 +620,7 @@ class ServingEngine:
         for i, s in enumerate(self._slots):
             if s is not None:
                 self._bm.free(s.alloc)
+                self._release_tenant(s.req)
                 self._slots[i] = None
                 self._fail_stopped(s.handle)
         self._reset_host_buffers()
@@ -690,10 +716,22 @@ class ServingEngine:
     # ------------------------------------------------------------------ api
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
                eos_token_id=None, deadline_s=None, sampling=None,
-               _autostart=True):
+               adapter=None, grammar=None, mode="generate", pooling="mean",
+               _fsm_state=None, _autostart=True):
         """Queue one request; returns a :class:`RequestHandle` immediately.
         ``deadline_s`` is a wall-clock budget from now — a sequence still
         queued or decoding past it is retired with status ``expired``.
+
+        Multi-tenant parameters (:class:`MultiTenantEngine` only — the
+        base engine rejects non-defaults loudly): ``adapter`` names a
+        registered LoRA adapter serving this row; ``grammar`` is a
+        :class:`~.multitenant.grammar.CompiledGrammar` constraining the
+        row's output (``_fsm_state`` resumes it mid-document — the
+        cluster failover path); ``mode`` picks generate | embed | score
+        (embed/score ride the scheduler and prefill programs but retire
+        without decode slots or pages); ``pooling`` (mean | last) shapes
+        the embed vector.
+
         ``_autostart=False`` (the cluster's leg path) never starts a
         stopped engine: the submit is rejected instead, atomically with
         the enqueue, so a leg racing ``stop()`` cannot resurrect the
@@ -703,11 +741,28 @@ class ServingEngine:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        eos_token_id = self._validate_tenant(adapter, grammar, mode, pooling,
+                                             eos_token_id)
         sampling = sampling if sampling is not None \
             else SamplingParams(temperature=temperature)
+        if mode != "generate":
+            max_new_tokens = 1          # no decode slot is ever occupied
         total = len(prompt) + int(max_new_tokens)
         handle = RequestHandle(next(self._rid_counter), len(prompt))
-        if total > self.max_model_len \
+        handle.mode = mode
+        handle.adapter = adapter
+        if grammar is not None:
+            handle._fsm_state = _fsm_state if _fsm_state is not None \
+                else grammar.start
+        if mode != "generate":
+            # embed/score: the prompt runs through the prefill programs
+            # against the scratch page — no pages, no decode positions
+            if len(prompt) > self.max_model_len:
+                self._m_requests.inc(status="rejected")
+                raise RequestRejectedError(
+                    f"{mode} prompt {len(prompt)} exceeds max_model_len "
+                    f"{self.max_model_len}", reason="unservable")
+        elif total > self.max_model_len \
                 or self._bm.pages_for(total) > self._bm.num_pages:
             self._m_requests.inc(status="rejected")
             raise RequestRejectedError(
@@ -743,11 +798,26 @@ class ServingEngine:
                     if deadline_s is not None else None
                 self._queue.append(Request(prompt, int(max_new_tokens),
                                            sampling, eos_token_id, deadline,
-                                           handle))
+                                           handle, adapter=adapter,
+                                           grammar=grammar, mode=mode,
+                                           pooling=pooling))
                 self._m_requests.inc(status="submitted")
                 self._m_queue_depth.set(len(self._queue))
                 self._cv.notify_all()
         return handle
+
+    def _validate_tenant(self, adapter, grammar, mode, pooling,
+                         eos_token_id):
+        """Submit-time validation of the multi-tenant parameters; the
+        base engine serves exactly one tenant in one mode, so anything
+        non-default is rejected here (MultiTenantEngine overrides).
+        Returns the effective ``eos_token_id``."""
+        if adapter is not None or grammar is not None \
+                or mode != "generate" or pooling != "mean":
+            raise ValueError(
+                "adapter=/grammar=/mode=/pooling= need a multi-tenant "
+                "engine (paddle_tpu.serving.multitenant.MultiTenantEngine)")
+        return eos_token_id
 
     def _shed(self, reason, message):
         """Reject at admission with a distinct, machine-readable reason
@@ -967,8 +1037,11 @@ class ServingEngine:
         for i, s in enumerate(self._slots):
             if s is not None:
                 self._slots[i] = None
+                self._release_tenant(s.req)
                 inflight.append((s.req, s.produced))
         pending, self._admitting = self._admitting, None
+        if pending is not None:
+            self._release_tenant(pending)
         if pending is not None and \
                 all(req.handle is not pending.handle for req, _ in inflight):
             inflight.append((pending, 0))
@@ -998,20 +1071,29 @@ class ServingEngine:
                     ([int(t) for t in h.token_ids[-produced:]]
                      if produced else [])
                 h.status = "queued"
-                self._queue.appendleft(Request(
-                    prompt, remaining, req.sampling, req.eos_token_id,
-                    req.deadline, h))
+                # dataclasses.replace keeps the multi-tenant fields
+                # (adapter / grammar / mode) riding across the restart;
+                # the LEASE is dropped — re-admission re-acquires against
+                # the rebuilt adapter pools.  The grammar state needs no
+                # replay: it lives on the HANDLE, already advanced through
+                # every emitted token.
+                self._queue.appendleft(dataclasses.replace(
+                    req, prompt=prompt, max_new_tokens=remaining,
+                    lease=None))
                 self._m_requeued.inc()
             self._m_queue_depth.set(len(self._queue))
 
     def _abort_all(self, exc):
         pending, self._admitting = self._admitting, None
+        if pending is not None:
+            self._release_tenant(pending)
         if pending is not None and not pending.handle.done:
             pending.handle._error = exc
             self._finish(pending.handle, "error")
         for i, s in enumerate(self._slots):
             if s is not None:
                 self._bm.free(s.alloc)
+                self._release_tenant(s.req)
                 self._slots[i] = None
                 s.handle._error = exc
                 self._finish(s.handle, "error")
@@ -1024,10 +1106,6 @@ class ServingEngine:
 
     def _admit(self):
         while True:
-            free_slot = next((i for i, s in enumerate(self._slots)
-                              if s is None), None)
-            if free_slot is None:
-                return
             with self._lock:
                 req = None
                 while self._queue:
@@ -1045,18 +1123,60 @@ class ServingEngine:
                     break
                 if req is None:
                     return
-                alloc = self._bm.allocate(
-                    req.prompt, len(req.prompt) + req.max_new_tokens)
-                if alloc is None:
-                    # FIFO admission: park until a retirement frees pages
-                    self._m_blocked.inc()
-                    return
-                self._queue.popleft()
-                self._m_queue_depth.set(len(self._queue))
-                # between dequeue and slot assignment the request lives in
-                # _admitting so a crash mid-prefill can still requeue it
-                self._admitting = req
-            self._prefill(req, alloc, free_slot)
+                if req.mode != "generate":
+                    # embed/score: no decode slot, no pages — runs one
+                    # prefill-family dispatch against the scratch page and
+                    # retires immediately (multi-tenant engine only; the
+                    # base engine's submit validation never queues these)
+                    if not self._acquire_tenant(req):
+                        return          # adapter slots pinned: stay queued
+                    self._queue.popleft()
+                    self._m_queue_depth.set(len(self._queue))
+                    self._admitting = req
+                    alloc = free_slot = None
+                else:
+                    free_slot = next((i for i, s in enumerate(self._slots)
+                                      if s is None), None)
+                    if free_slot is None:
+                        return
+                    alloc = self._bm.allocate(
+                        req.prompt, len(req.prompt) + req.max_new_tokens)
+                    if alloc is None:
+                        # FIFO admission: park until a retirement frees
+                        # pages
+                        self._m_blocked.inc()
+                        return
+                    if not self._acquire_tenant(req):
+                        # adapter pool pinned solid: the adapter analog of
+                        # page exhaustion — stay queued, release the pages
+                        self._bm.free(alloc)
+                        self._m_blocked.inc()
+                        return
+                    self._queue.popleft()
+                    self._m_queue_depth.set(len(self._queue))
+                    # between dequeue and slot assignment the request lives
+                    # in _admitting so a crash mid-prefill can still
+                    # requeue it
+                    self._admitting = req
+            if req.mode != "generate":
+                self._run_passthrough(req)
+            else:
+                self._prefill(req, alloc, free_slot)
+
+    def _acquire_tenant(self, req):
+        """Pin the request's tenant resources (LoRA adapter slot) for its
+        lifetime; False parks the request in the queue.  Base engine: no
+        tenants, always True (MultiTenantEngine overrides)."""
+        return True
+
+    def _release_tenant(self, req):
+        """Counterpart of :meth:`_acquire_tenant` at retirement."""
+
+    def _run_passthrough(self, req):
+        """Execute a non-generate (embed/score) request.  Unreachable in
+        the base engine — submit validation rejects those modes."""
+        raise RuntimeError(
+            f"mode={req.mode!r} request reached the base engine scheduler")
 
     def _prefill(self, req, alloc, slot_idx):
         S0 = len(req.prompt)
@@ -1071,13 +1191,14 @@ class ServingEngine:
         prog, traces = self._prefill_program(s_pad)
         n0 = traces[0]
         rkey = self._next_key()
-        fam = f"prefill/{s_pad}{self._fam_suffix}"
+        extra = self._prefill_extra(req)
+        fam = self._prefill_family(s_pad)
         if _perf.needs_cost(fam):
             # capture arg shapes ONCE per family; the cost_analysis
             # re-lower+compile itself runs lazily, off this thread
             _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
                 prog, (self._params, self._bufs, ids, *self._pools,
-                       table, lens, temps, rkey)))
+                       table, lens, temps, rkey, *extra)))
         # first dispatch of this program = minutes-long XLA compile: flag it
         # so the serving watchdog doesn't read a legitimate compile stall
         # as a wedged scheduler
@@ -1089,7 +1210,8 @@ class ServingEngine:
                                request_id=req.handle.request_id,
                                slot=slot_idx, prompt_len=S0):
                 tok, *pools = prog(self._params, self._bufs, ids,
-                                   *self._pools, table, lens, temps, rkey)
+                                   *self._pools, table, lens, temps, rkey,
+                                   *extra)
                 self._pools = tuple(pools)
                 tok = int(np.asarray(tok)[0])
         finally:
@@ -1103,6 +1225,7 @@ class ServingEngine:
             _perf.record(fam, time.perf_counter() - t0)
         self._m_prefill_seconds.observe(time.perf_counter() - t0)
         slot = _Slot(req, alloc, table_row)
+        slot.idx = slot_idx
         slot.last = tok
         slot.produced = 1
         req.handle.status = "running"
@@ -1116,6 +1239,7 @@ class ServingEngine:
         self._h_lens[i] = slot.length
         self._h_temps[i] = slot.temp
         self._h_last[i, 0] = tok
+        self._on_admitted(slot, slot_idx)
         if slot.temp > 0:
             self._n_temp += 1
         if self._drafter is not None:
@@ -1148,15 +1272,59 @@ class ServingEngine:
             return self._verify_once(active)
         return self._plain_step(active)
 
+    # ----------------------------------------------- multi-tenant hooks
+    # Extension points MultiTenantEngine fills in; the base engine's
+    # returns keep every dispatch signature and program family unchanged.
+    def _prefill_family(self, s_pad):
+        return f"prefill/{s_pad}{self._fam_suffix}"
+
+    def _decode_family(self):
+        return f"decode{self._fam_suffix}"
+
+    def _verify_family(self):
+        return f"verify/k{self._spec_k}{self._fam_suffix}"
+
+    def _prefill_extra(self, req):
+        """Host arrays appended to the prefill dispatch (adapter ids,
+        grammar mask, adapter pools)."""
+        return ()
+
+    def _step_extra(self):
+        """Host arrays appended to the decode dispatch."""
+        return ()
+
+    def _verify_extra(self, active):
+        """Host arrays appended to the verify dispatch (reads the draft
+        buffers _h_ids/_h_dlen the caller just filled)."""
+        return ()
+
+    def _filter_draft(self, i, draft):
+        """Trim a slot's n-gram draft before verification (a constrained
+        row truncates at the first grammar-illegal token)."""
+        return draft
+
+    def _on_admitted(self, slot, i):
+        """A request landed in decode lane ``i`` (persistent host rows
+        already rebuilt)."""
+
+    def _budget_status(self, slot):
+        """Terminal status when ``max_new_tokens`` runs out.  The base
+        engine's budget exhaustion IS completion; a grammar-constrained
+        row cut off mid-document reports ``truncated`` instead
+        (MultiTenantEngine)."""
+        return "completed"
+
     def _plain_step(self, active):
         prog, traces = self._step_program()
         n0 = traces[0]
         rkey = self._step_key()
-        fam = f"decode{self._fam_suffix}"
+        extra = self._step_extra()
+        fam = self._decode_family()
         if _perf.needs_cost(fam):
             _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
                 prog, (self._params, self._bufs, self._h_last, *self._pools,
-                       self._h_table, self._h_lens, self._h_temps, rkey)))
+                       self._h_table, self._h_lens, self._h_temps, rkey,
+                       *extra)))
         if _tracing._ACTIVE:
             # one span per batched iteration, LINKING every active
             # request's trace id (a decode step serves many traces at once
@@ -1173,7 +1341,7 @@ class ServingEngine:
             with cm:
                 tok, *pools = prog(self._params, self._bufs, self._h_last,
                                    *self._pools, self._h_table, self._h_lens,
-                                   self._h_temps, rkey)
+                                   self._h_temps, rkey, *extra)
                 self._pools = tuple(pools)
                 tok = np.asarray(tok)
         finally:
@@ -1217,6 +1385,7 @@ class ServingEngine:
             cap = min(K, s.max_new - s.produced - 1,
                       self.max_model_len - s.length - 1)
             d = self._drafter.propose(i, cap) if cap > 0 else []
+            d = self._filter_draft(i, d)
             if d:
                 self._h_ids[i, 1:1 + len(d)] = d
             self._h_dlen[i] = len(d)
@@ -1229,12 +1398,13 @@ class ServingEngine:
         prog, traces = self._verify_program()
         n0 = traces[0]
         rkey = self._step_key()
-        fam = f"verify/k{K}{self._fam_suffix}"
+        extra = self._verify_extra(active)
+        fam = self._verify_family()
         if _perf.needs_cost(fam):
             _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
                 prog, (self._params, self._bufs, self._h_ids, *self._pools,
                        self._h_table, self._h_lens, self._h_dlen,
-                       self._h_temps, rkey)))
+                       self._h_temps, rkey, *extra)))
         if _tracing._ACTIVE:
             cm = _tracing.span(
                 "serving.verify_step", iteration=self._iteration,
@@ -1250,7 +1420,7 @@ class ServingEngine:
                 targets, accept, *pools = prog(
                     self._params, self._bufs, self._h_ids, *self._pools,
                     self._h_table, self._h_lens, self._h_dlen,
-                    self._h_temps, rkey)
+                    self._h_temps, rkey, *extra)
                 self._pools = tuple(pools)
                 targets = np.asarray(targets)
                 accept = np.asarray(accept)
@@ -1329,13 +1499,14 @@ class ServingEngine:
         elif slot.eos is not None and slot.last == slot.eos:
             status = "completed"
         elif slot.produced >= slot.max_new:
-            status = "completed"
+            status = self._budget_status(slot)
         elif slot.deadline is not None and time.time() > slot.deadline:
             status = "expired"
             self._m_preempt.inc()
         if status is None:
             return False
         self._bm.free(slot.alloc)
+        self._release_tenant(slot.req)
         self._slots[i] = None
         self._clear_slot_row(i, slot)
         self._finish(h, status)
@@ -1379,7 +1550,8 @@ class ServingEngine:
             dur = handle.finished_at - handle.submitted_at
             self._ema_request_s = dur if self._ema_request_s is None \
                 else 0.8 * self._ema_request_s + 0.2 * dur
-        if self._slo is not None and status in ("completed", "expired"):
+        if self._slo is not None and status in ("completed", "expired") \
+                and handle.mode == "generate":
             # expired = the deadline preempted it: an SLO miss by
             # definition, whatever its timeline says.  cancelled/stopped/
             # error requests are excluded — they measure the caller or the

@@ -1,0 +1,474 @@
+"""Paged multi-LoRA: adapter definitions, the rank-bucketed LoRAStore,
+and the LoRA-aware engine adapters.
+
+S-LoRA-style serving (Sheng et al.): every registered fine-tune's low-rank
+pairs live in GLOBAL rank-bucketed device pools — one ``A [L, C+1, d_in,
+r]`` / ``B [L, C+1, r, d_out]`` pair per (decoder Linear target, rank
+bucket) — and each batch row gathers ITS adapter by slot id INSIDE the
+compiled prefill/decode/verify programs (:mod:`paddle_tpu.ops.lora`).
+The compiled-program count is a function of the CONFIGURED rank buckets,
+never of the adapter population: registering, evicting or hot-swapping
+adapters at runtime changes pool *contents* (same shapes), so no program
+is ever re-traced for it.
+
+Slot management follows the BlockManager pattern at adapter granularity
+(:class:`_SlotAllocator` = refcounted active set + idle-LRU cache +
+free list): an adapter is *registered* host-side (cheap), *paged in* to a
+device slot on first acquire, refcounted while any live request uses it,
+parked idle on release, and evicted LRU when the pool needs the slot —
+an idle re-acquire is a pure refcount bump, no device write.  Slot row 0
+of every pool is the reserved NULL adapter (zeros): base-model rows gather
+exact-zero deltas, so one batch freely mixes tenants and the base model.
+
+Composition: pools default to the MODEL dtype but can pin ``dtype=``
+(e.g. bf16 adapters over an int8-weight base — the bypass runs on the
+Int8Linear's output, see ``GPTDecoderLayer._lin``), and
+:class:`LoRAQuantizedGPTAdapter` runs the same gathers over int8 KV
+pools, so quantized serving and multi-LoRA stack.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.lora import gather_adapter
+from ..adapter import GPTAdapter
+from ..quant.adapter import QuantizedGPTAdapter
+
+#: decoder Linear targets a LoRA pair may attach to, in pool order
+TARGETS = ("qkv", "out_proj", "ffn1", "ffn2")
+
+
+class LoRAAdapter:
+    """One tenant's fine-tune: per-(layer, target) low-rank pairs.
+
+    ``weights[(layer_idx, target)] = (A [d_in, rank], B [rank, d_out])``
+    host arrays; targets may cover any subset of :data:`TARGETS` (missing
+    (layer, target) pairs contribute nothing — their pool rows stay the
+    null zeros).  ``scaling`` (the classic alpha/rank) is folded into B
+    when the adapter is paged in."""
+
+    def __init__(self, name, rank, weights, scaling=1.0):
+        self.name = str(name)
+        self.rank = int(rank)
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {rank}")
+        self.scaling = float(scaling)
+        self.weights = {}
+        for (layer, target), (a, b) in weights.items():
+            if target not in TARGETS:
+                raise ValueError(f"unknown LoRA target {target!r} "
+                                 f"(expected one of {TARGETS})")
+            a = np.asarray(a)
+            b = np.asarray(b)
+            if a.shape[1] != self.rank or b.shape[0] != self.rank:
+                raise ValueError(
+                    f"({layer}, {target}): A {a.shape} / B {b.shape} do not "
+                    f"carry rank {self.rank}")
+            self.weights[(int(layer), target)] = (a, b)
+
+    @classmethod
+    def random(cls, model, name, rank, targets=("qkv", "out_proj"),
+               seed=0, scale=0.02, scaling=1.0):
+        """A seeded random adapter over every decoder layer — the test /
+        example / bench stand-in for a real fine-tune."""
+        rng = np.random.RandomState(seed)
+        shapes = target_shapes(model)
+        weights = {}
+        for layer in range(num_decoder_layers(model)):
+            for t in targets:
+                d_in, d_out = shapes[t]
+                weights[(layer, t)] = (
+                    rng.normal(0, scale, (d_in, rank)),
+                    rng.normal(0, scale, (rank, d_out)))
+        return cls(name, rank, weights, scaling=scaling)
+
+    def __repr__(self):
+        return (f"LoRAAdapter({self.name!r}, rank={self.rank}, "
+                f"pairs={len(self.weights)})")
+
+
+def _linear_shape(blk, target):
+    lin = getattr(blk, target)
+    w = getattr(lin, "weight", None)
+    if w is None:                       # Int8Linear (weight_dtype="int8")
+        w = lin.weight_int8
+    return (int(w.shape[0]), int(w.shape[1]))
+
+
+def target_shapes(model):
+    """(d_in, d_out) per LoRA target for this model's decoder blocks."""
+    blk = model.gpt.layers[0]
+    return {t: _linear_shape(blk, t) for t in TARGETS}
+
+
+def num_decoder_layers(model):
+    return len(model.gpt.layers)
+
+
+class _SlotAllocator:
+    """BlockManager's allocation pattern at adapter-slot granularity:
+    refcounted active rows, an idle LRU of resident-but-unused rows, and
+    a free list.  Rows are 0-based; the store maps them to pool row+1
+    (pool row 0 is the null adapter)."""
+
+    def __init__(self, capacity):
+        self.capacity = int(capacity)
+        self._free = collections.deque(range(self.capacity))
+        self._active = {}                       # name -> [row, refs]
+        self._idle = collections.OrderedDict()  # name -> row (LRU)
+
+    def acquire(self, name):
+        """-> (row, resident, evicted_name) or None when every slot is
+        pinned by live requests."""
+        ent = self._active.get(name)
+        if ent is not None:
+            ent[1] += 1
+            return ent[0], True, None
+        if name in self._idle:
+            row = self._idle.pop(name)
+            self._active[name] = [row, 1]
+            return row, True, None
+        evicted = None
+        if self._free:
+            row = self._free.popleft()
+        elif self._idle:
+            evicted, row = self._idle.popitem(last=False)
+        else:
+            return None                 # all slots pinned by live requests
+        self._active[name] = [row, 1]
+        return row, False, evicted
+
+    def release(self, name):
+        ent = self._active[name]
+        ent[1] -= 1
+        if ent[1] == 0:
+            del self._active[name]
+            self._idle[name] = ent[0]
+
+    def forget(self, name):
+        """Drop an idle residency (explicit evict)."""
+        if name in self._idle:
+            self._free.append(self._idle.pop(name))
+
+    def refs(self, name):
+        ent = self._active.get(name)
+        return ent[1] if ent is not None else 0
+
+    def resident(self, name):
+        return name in self._active or name in self._idle
+
+    def reset(self):
+        self._free = collections.deque(range(self.capacity))
+        self._active.clear()
+        self._idle.clear()
+
+
+class TenantLease:
+    """One live request's hold on a paged-in adapter (released at
+    retirement; refcounts are per-request, mirroring prefix pages)."""
+
+    __slots__ = ("name", "bucket", "row")
+
+    def __init__(self, name, bucket, row):
+        self.name = name
+        self.bucket = int(bucket)
+        self.row = int(row)             # pool row (null row 0 excluded)
+
+
+class LoRAStore:
+    """See module docstring.  ``ranks`` fixes the bucket set (and with it
+    every compiled program's signature) up front; ``capacity`` is adapter
+    slots PER bucket; ``targets`` the decoder Linears carrying pairs.
+
+    Thread model: ``register``/``evict`` run on caller threads (host
+    registry only); ``acquire``/``release``/device writes run on engine
+    scheduler threads.  One lock covers both — a shared store serves
+    several cluster replicas."""
+
+    def __init__(self, model, capacity=8, ranks=(8,), targets=None,
+                 dtype=None):
+        self.model = model
+        self.capacity = int(capacity)
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.ranks = tuple(sorted(int(r) for r in ranks))
+        if not self.ranks or any(r < 1 for r in self.ranks):
+            raise ValueError(f"ranks must be positive, got {ranks}")
+        self.targets = tuple(targets) if targets is not None \
+            else ("qkv", "out_proj")
+        for t in self.targets:
+            if t not in TARGETS:
+                raise ValueError(f"unknown target {t!r}")
+        self.num_layers = num_decoder_layers(model)
+        self._shapes = target_shapes(model)
+        if dtype is None:
+            dtype = model.gpt.word_embeddings.weight._value.dtype
+        self.dtype = jnp.dtype(dtype)
+        self._lock = threading.RLock()
+        self._registry = {}     # name -> (bucket_idx, padded host {t: (A,B)})
+        self._alloc = [_SlotAllocator(self.capacity) for _ in self.ranks]
+        self._row_owner = [dict() for _ in self.ranks]  # row -> name
+        self._pools = self._init_pools()
+        from ...profiler import metrics as _metrics
+
+        self._m_swaps = _metrics.counter(
+            "serving.lora_swaps",
+            "adapter page-ins (device pool writes); an idle re-acquire is "
+            "a refcount bump, not a swap")
+        self._m_resident = _metrics.gauge(
+            "serving.lora_resident", "adapters resident in the device pools")
+        self._m_registered = _metrics.gauge(
+            "serving.lora_registered", "adapters in the host registry")
+
+    # ------------------------------------------------------------- identity
+    def signature(self):
+        """Static tuple baked into every compiled program key: programs
+        depend on pool SHAPES (buckets, capacity, targets, dtype), never
+        on which adapters currently occupy them."""
+        return (self.ranks, self.capacity, self.targets, str(self.dtype),
+                self.num_layers)
+
+    @property
+    def n_args(self):
+        """Device arrays :meth:`device_args` contributes per dispatch."""
+        return 2 * len(self.targets) * len(self.ranks)
+
+    def family_suffix(self):
+        """Perf-attribution suffix for the LoRA program families, e.g.
+        ``@lora-r8`` / ``@lora-r4+16`` (one decode program per rank-bucket
+        SET — adapter count never appears)."""
+        return "@lora-r" + "+".join(str(r) for r in self.ranks)
+
+    def _init_pools(self):
+        pools = []
+        for r in self.ranks:
+            for t in self.targets:
+                d_in, d_out = self._shapes[t]
+                pools.append(jnp.zeros(
+                    (self.num_layers, self.capacity + 1, d_in, r),
+                    self.dtype))
+                pools.append(jnp.zeros(
+                    (self.num_layers, self.capacity + 1, r, d_out),
+                    self.dtype))
+        return tuple(pools)
+
+    def pool_bytes(self):
+        return int(sum(int(p.nbytes) for p in self._pools))
+
+    def device_args(self):
+        """The flat pool tuple appended to every engine dispatch (read-
+        only in the programs — NOT donated; a register/page-in between
+        steps swaps array references, never shapes)."""
+        return self._pools
+
+    # ------------------------------------------------------------- registry
+    def bucket_for(self, rank):
+        for i, r in enumerate(self.ranks):
+            if rank <= r:
+                return i
+        raise ValueError(
+            f"rank {rank} exceeds every configured bucket {self.ranks}; "
+            "rank buckets are fixed at store construction (they define "
+            "the compiled-program family)")
+
+    def register(self, adapter: LoRAAdapter):
+        """Host-side registration (cheap; device page-in is deferred to
+        first acquire).  Re-registering a name replaces its weights: the
+        old residency is invalidated, so the NEXT request picks up the
+        new weights without an engine restart.  Raises while live
+        requests hold the old weights — an in-flight tenant must not see
+        its pair swapped mid-decode (release them or use a new name)."""
+        bi = self.bucket_for(adapter.rank)
+        rb = self.ranks[bi]
+        padded = {}
+        for t in self.targets:
+            d_in, d_out = self._shapes[t]
+            a = np.zeros((self.num_layers, d_in, rb), np.float64)
+            b = np.zeros((self.num_layers, rb, d_out), np.float64)
+            for layer in range(self.num_layers):
+                pair = adapter.weights.get((layer, t))
+                if pair is None:
+                    continue
+                a[layer, :, :adapter.rank] = pair[0]
+                b[layer, :adapter.rank, :] = pair[1] * adapter.scaling
+            padded[t] = (a.astype(self.dtype), b.astype(self.dtype))
+        with self._lock:
+            old = self._registry.get(adapter.name)
+            if old is not None and self._alloc[old[0]].refs(adapter.name):
+                raise RuntimeError(
+                    f"adapter {adapter.name!r} is held by live request(s); "
+                    "re-register after they retire, or use a new name")
+            self._invalidate_rows(adapter.name)
+            self._registry[adapter.name] = (bi, padded)
+            self._m_registered.set(len(self._registry))
+        return adapter.name
+
+    def _invalidate_rows(self, name):
+        for bi, owners in enumerate(self._row_owner):
+            rows = [row for row, n in owners.items() if n == name]
+            for row in rows:
+                del owners[row]
+            self._alloc[bi].forget(name)
+
+    def evict(self, name):
+        """Drop an adapter from the registry AND its idle residency.
+        Raises while live requests still hold it (release them first —
+        an in-flight tenant must not lose its weights mid-decode)."""
+        with self._lock:
+            if name not in self._registry:
+                raise KeyError(f"adapter {name!r} is not registered")
+            bi = self._registry[name][0]
+            if self._alloc[bi].refs(name):
+                raise RuntimeError(
+                    f"adapter {name!r} is held by "
+                    f"{self._alloc[bi].refs(name)} live request(s)")
+            self._invalidate_rows(name)
+            del self._registry[name]
+            self._m_registered.set(len(self._registry))
+            self._update_resident_gauge()
+
+    def registered(self, name):
+        return name in self._registry
+
+    @property
+    def names(self):
+        return sorted(self._registry)
+
+    # ------------------------------------------------------------ residency
+    def acquire(self, name):
+        """Pin ``name`` into a device slot for one request.  Returns a
+        :class:`TenantLease`, or ``None`` when every slot of the bucket is
+        pinned by live requests (the engine keeps the request queued —
+        the adapter analog of page-pool admission control)."""
+        with self._lock:
+            ent = self._registry.get(name)
+            if ent is None:
+                raise KeyError(f"adapter {name!r} is not registered")
+            bi, padded = ent
+            got = self._alloc[bi].acquire(name)
+            if got is None:
+                return None
+            row, resident, evicted = got
+            owners = self._row_owner[bi]
+            if evicted is not None and owners.get(row) == evicted:
+                del owners[row]
+            if not resident or owners.get(row) != name:
+                self._page_in(bi, row, padded)
+                owners[row] = name
+                self._m_swaps.inc()
+            self._update_resident_gauge()
+            return TenantLease(name, bi, row + 1)
+
+    def release(self, lease: TenantLease):
+        with self._lock:
+            self._alloc[lease.bucket].release(lease.name)
+
+    def _page_in(self, bi, row, padded):
+        pools = list(self._pools)
+        base = 2 * len(self.targets) * bi
+        for ti, t in enumerate(self.targets):
+            a, b = padded[t]
+            k = base + 2 * ti
+            pools[k] = pools[k].at[:, row + 1].set(jnp.asarray(a))
+            pools[k + 1] = pools[k + 1].at[:, row + 1].set(jnp.asarray(b))
+        self._pools = tuple(pools)
+
+    def _update_resident_gauge(self):
+        self._m_resident.set(sum(
+            sum(1 for n in self._registry if al.resident(n))
+            for al in self._alloc))
+
+    # NOTE: there is deliberately no reset-on-restart hook.  The adapter
+    # pools are read-only in the compiled programs and NEVER donated, so
+    # unlike the KV pools they survive an engine crash intact; the
+    # engine's recovery path releases every in-flight lease and
+    # re-admission re-acquires them (an idle resurrection — no device
+    # write), which is what keeps restarted output byte-identical.
+
+    # ----------------------------------------------------------- device side
+    def gather_layers(self, aid, lw, dtype=None):
+        """Build the per-layer ``lora=`` structure the GPT forward
+        consumes, gathering per-row pairs from the dispatch's pool
+        arrays.  ``aid [n_buckets, B]`` int32 slot rows (0 = null);
+        ``lw`` the flat array tuple in :meth:`device_args` order.  Runs
+        INSIDE the compiled programs."""
+        n = self.n_args
+        if len(lw) != n:
+            raise TypeError(f"expected {n} adapter pool arrays, "
+                            f"got {len(lw)}")
+        out = []
+        for layer in range(self.num_layers):
+            d = {}
+            for ti, t in enumerate(self.targets):
+                flat = []
+                for bi in range(len(self.ranks)):
+                    rows = aid[bi]
+                    k = 2 * len(self.targets) * bi + 2 * ti
+                    flat.append(gather_adapter(lw[k][layer], rows))
+                    flat.append(gather_adapter(lw[k + 1][layer], rows))
+                d[t] = tuple(flat)
+            out.append(d)
+        return out
+
+    # ------------------------------------------------------------- insight
+    def stats(self):
+        with self._lock:
+            tenants = {}
+            for name, (bi, _) in self._registry.items():
+                al = self._alloc[bi]
+                tenants[name] = {
+                    "rank_bucket": self.ranks[bi],
+                    "resident": al.resident(name),
+                    "refs": al.refs(name),
+                }
+            return {
+                "ranks": list(self.ranks),
+                "capacity": self.capacity,
+                "targets": list(self.targets),
+                "dtype": str(self.dtype),
+                "pool_bytes": self.pool_bytes(),
+                "adapters": tenants,
+            }
+
+
+# ------------------------------------------------------- engine adapters
+class _LoRAAdapterMixin:
+    """Extends an engine adapter's closures with the trailing multi-LoRA
+    args ``(aid [n_buckets, B] int32, *adapter_pools)`` and threads the
+    per-row gathered pairs into the GPT forward (``lora=``) — all through
+    the base adapter's single ``_split_extra`` hook, so the
+    prefill/step/verify/encode closure bodies (and any future fix to
+    them) stay in ONE place.  KV pool handling (incl. the quantized
+    4-array layout) is inherited untouched."""
+
+    def __init__(self, model, page_size, store: LoRAStore):
+        super().__init__(model, page_size)
+        self.store = store
+
+    def _split_extra(self, args):
+        n = self.n_pools
+        want = n + 3 + self.store.n_args
+        if len(args) != want:
+            raise TypeError(
+                f"{type(self).__name__} closures take {n} pools + table + "
+                f"lens + aid + {self.store.n_args} adapter pools; got "
+                f"{len(args)} trailing args")
+        pools, table, lens = self._split(args[:n + 2])
+        aid, lw = args[n + 2], args[n + 3:]
+        return pools, table, lens, \
+            self.store.gather_layers(aid.astype(jnp.int32), lw)
+
+
+class LoRAGPTAdapter(_LoRAAdapterMixin, GPTAdapter):
+    """Multi-LoRA over full-precision paged KV pools."""
+
+
+class LoRAQuantizedGPTAdapter(_LoRAAdapterMixin, QuantizedGPTAdapter):
+    """Multi-LoRA over int8 paged KV pools (+ scale pools): quantized
+    serving and multi-tenant LoRA compose — the adapter gathers ride the
+    same programs that fuse quant into the pool writes."""

@@ -176,3 +176,22 @@ def make_verifier(top_k=0, top_p=1.0):
         return targets, accept
 
     return verify
+
+
+def make_masked_verifier(top_k=0, top_p=1.0):
+    """Constrained-decoding twin of :func:`make_verifier` (multi-tenant
+    serving): per-position token-FSM masks ``allowed [B, K+1, V]`` bool
+    are applied to the verification logits BEFORE acceptance/resampling,
+    so a draft token that exits the grammar is rejected by construction —
+    the masked distribution's argmax (greedy) / support (temperature)
+    cannot contain it — and the bonus/resample token at the first
+    rejection is drawn from the masked distribution, i.e. is always
+    grammar-legal.  Unconstrained rows carry all-True masks and verify
+    bit-identically to :func:`make_verifier`."""
+    inner = make_verifier(top_k, top_p)
+
+    def verify(logits, allowed, drafts, dlen, temps, key):
+        return inner(jnp.where(allowed, logits, jnp.float32(-1e30)),
+                     drafts, dlen, temps, key)
+
+    return verify

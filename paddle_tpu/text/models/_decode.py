@@ -82,6 +82,25 @@ def make_batched_sampler(top_k=0, top_p=1.0):
     return sample
 
 
+def make_masked_batched_sampler(top_k=0, top_p=1.0):
+    """Constrained-decoding twin of :func:`make_batched_sampler`: the
+    multi-tenant engine's per-row token-FSM masks (``allowed [B, V]``
+    bool, computed host-side each step — serving/multitenant/grammar.py)
+    are applied BEFORE greedy/temperature sampling, so a schema-
+    constrained row can only ever emit grammar-legal tokens while
+    unconstrained rows (all-True mask) sample bit-identically to the
+    unmasked path (``where`` with an all-True predicate is the identity).
+    Disallowed entries get a large negative constant rather than -inf so
+    a temperature row's softmax stays NaN-free by construction."""
+    inner = make_batched_sampler(top_k, top_p)
+
+    def sample(logits, allowed, temps, key):
+        return inner(jnp.where(allowed, logits, jnp.float32(-1e30)),
+                     temps, key)
+
+    return sample
+
+
 def decode_loop(model, fwd, ids0, max_new_tokens, init_cache,
                 temperature=1.0, top_k=0, top_p=1.0, seed=None,
                 program_key=None):

@@ -84,10 +84,27 @@ class GPTDecoderLayer(Layer):
         self.attn_dropout = attn_dropout
         self.act = getattr(F, act)
 
-    def forward(self, x, cache=None):
+    def _lin(self, name, x, lora):
+        """One decoder Linear call with an optional per-row LoRA bypass.
+
+        ``lora`` is this layer's multi-tenant adapter slice (or None): a
+        dict mapping target name -> flat tuple of per-row gathered
+        ``(A [B, d_in, r], B [B, r, d_out])`` pairs, one pair per rank
+        bucket (serving.multitenant; ops.lora).  The base projection may
+        be an Int8Linear (weight_dtype="int8") — the bypass rides on its
+        output either way, which is exactly how int8 base + full-precision
+        LoRA compose."""
+        y = getattr(self, name)(x)
+        if lora is not None and name in lora:
+            from ...ops.lora import apply_lora
+
+            y = _apply(apply_lora, x, y, *lora[name], op_name="lora")
+        return y
+
+    def forward(self, x, cache=None, lora=None):
         residual = x
         h = self.ln1(x)
-        qkv = self.qkv(h)
+        qkv = self._lin("qkv", h, lora)
         B, S = h.shape[0], h.shape[1]
         # head count derived from the actual projection width: under manual
         # tensor parallelism the local shard carries num_heads/mp heads.
@@ -154,10 +171,10 @@ class GPTDecoderLayer(Layer):
                     q, kp, vp, ks, vs, table, lens,
                     op_name="paged_attention")
             attn = attn.reshape([B, S, heads_here * self.head_dim])
-            x = residual + self.dropout(self.out_proj(attn))
+            x = residual + self.dropout(self._lin("out_proj", attn, lora))
             residual = x
             h = self.ln2(x)
-            h = self.ffn2(self.act(self.ffn1(h)))
+            h = self._lin("ffn2", self.act(self._lin("ffn1", h, lora)), lora)
             x = residual + self.dropout(h)
             return x, (tag, kp, vp, ks, vs, table, lens)
         if cache is not None and len(cache) == 5 and cache[0] == "served_chunk":
@@ -180,10 +197,10 @@ class GPTDecoderLayer(Layer):
             attn = _apply(paged_chunk_attend, q, kp, vp, table, lens,
                           op_name="paged_attention")
             attn = attn.reshape([B, S, heads_here * self.head_dim])
-            x = residual + self.dropout(self.out_proj(attn))
+            x = residual + self.dropout(self._lin("out_proj", attn, lora))
             residual = x
             h = self.ln2(x)
-            h = self.ffn2(self.act(self.ffn1(h)))
+            h = self._lin("ffn2", self.act(self._lin("ffn1", h, lora)), lora)
             x = residual + self.dropout(h)
             return x, ("served_chunk", kp, vp, table, lens)
         if cache is not None and len(cache) == 5 and cache[0] == "served":
@@ -224,10 +241,10 @@ class GPTDecoderLayer(Layer):
                                         ln.astype(jnp.int32) + 1)[:, None],
                     q, kp, vp, table, lens, op_name="paged_attention")
             attn = attn.reshape([B, S, heads_here * self.head_dim])
-            x = residual + self.dropout(self.out_proj(attn))
+            x = residual + self.dropout(self._lin("out_proj", attn, lora))
             residual = x
             h = self.ln2(x)
-            h = self.ffn2(self.act(self.ffn1(h)))
+            h = self._lin("ffn2", self.act(self._lin("ffn1", h, lora)), lora)
             x = residual + self.dropout(h)
             return x, ("served", kp, vp, table, lens)
         if cache is not None and len(cache) == 4 and cache[0] == "paged":
@@ -257,10 +274,10 @@ class GPTDecoderLayer(Layer):
                         paged_decode_attend(qq[:, 0], kps, vps, p)[:, None],
                     q, kp, vp, pos, op_name="paged_attention")
             attn = attn.reshape([B, S, heads_here * self.head_dim])
-            x = residual + self.dropout(self.out_proj(attn))
+            x = residual + self.dropout(self._lin("out_proj", attn, lora))
             residual = x
             h = self.ln2(x)
-            h = self.ffn2(self.act(self.ffn1(h)))
+            h = self._lin("ffn2", self.act(self._lin("ffn1", h, lora)), lora)
             x = residual + self.dropout(h)
             return x, ("paged", kp, vp, pos)
         if cache is not None and len(cache) == 3:
@@ -289,10 +306,10 @@ class GPTDecoderLayer(Layer):
                 q, k_buf, v_buf, attn_mask=mask, dropout_p=0.0,
                 training=False)
             attn = attn.reshape([B, S, heads_here * self.head_dim])
-            x = residual + self.dropout(self.out_proj(attn))
+            x = residual + self.dropout(self._lin("out_proj", attn, lora))
             residual = x
             h = self.ln2(x)
-            h = self.ffn2(self.act(self.ffn1(h)))
+            h = self._lin("ffn2", self.act(self._lin("ffn1", h, lora)), lora)
             x = residual + self.dropout(h)
             return x, (k_buf, v_buf, pos)
         if cache is not None:
@@ -305,10 +322,10 @@ class GPTDecoderLayer(Layer):
             q, k, v, is_causal=cache is None, dropout_p=self.attn_dropout,
             training=self.training)
         attn = attn.reshape([B, S, heads_here * self.head_dim])
-        x = residual + self.dropout(self.out_proj(attn))
+        x = residual + self.dropout(self._lin("out_proj", attn, lora))
         residual = x
         h = self.ln2(x)
-        h = self.ffn2(self.act(self.ffn1(h)))
+        h = self._lin("ffn2", self.act(self._lin("ffn1", h, lora)), lora)
         x = residual + self.dropout(h)
         return x if cache is None else (x, cache)
 
@@ -341,15 +358,19 @@ class GPTModel(Layer):
                          + self.position_embeddings(position_ids))
 
     def forward(self, input_ids, position_ids=None, attention_mask=None,
-                use_cache=False, cache=None):
+                use_cache=False, cache=None, lora=None):
+        # ``lora``: per-layer multi-tenant adapter slices (see
+        # GPTDecoderLayer._lin / paddle_tpu.serving.multitenant) — a list
+        # of per-layer dicts, or None for the base model
         x = self.embed(input_ids, position_ids)
         new_cache = []
         for i, layer in enumerate(self.layers):
+            li = lora[i] if lora is not None else None
             if cache is not None:
-                x, c = layer(x, cache[i])
+                x, c = layer(x, cache[i], lora=li)
                 new_cache.append(c)
             else:
-                x = layer(x)
+                x = layer(x, lora=li)
         x = self.final_ln(x)
         return (x, new_cache) if cache is not None else x
 

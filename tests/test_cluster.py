@@ -327,12 +327,30 @@ def test_replica_loss_mid_decode_reroutes_greedy_identical(model):
         ref_a = _ref_tokens(model, pa, 14)
         ref_b = _ref_tokens(model, pb, 12)
 
-        def bug():
-            raise ValueError("injected fatal replica crash")
+        # Deflake (ISSUE-9): the old at_trips={4} schedule raced the
+        # caller thread — a fast scheduler could burn its 4 iterations on
+        # request A alone (or, under load, A could even finish) before B
+        # was admitted, so B's replica_history read ["1"] and the reroute
+        # counters came up short.  Fire on ENGINE STATE instead (both
+        # requests co-resident on replica 0 with a decode step each), and
+        # PACE the scheduler with a 1ms yield while the second admission
+        # is still in flight — the same fault-plan-hook pacing as the
+        # PR-5 stop()-inflight chaos fix.
+        crash = {"armed": True}
 
-        # trips 1+2 are the two admission prefills' decode iterations —
-        # fire on a later decode step so tokens are already in flight
-        faults.inject("serving.step_crash@0", fn=bug, at_trips={4})
+        def bug():
+            e0 = cluster.engines[0]
+            slots = [s for s in e0._slots if s is not None]
+            if not crash["armed"]:
+                return
+            if len(slots) < 2:
+                time.sleep(0.001)   # let the caller thread land request B
+                return
+            if all(s.produced >= 2 for s in slots):
+                crash["armed"] = False
+                raise ValueError("injected fatal replica crash")
+
+        faults.inject("serving.step_crash@0", fn=bug)
         try:
             ha = cluster.submit(pa, max_new_tokens=14)
             hb = cluster.submit(pb, max_new_tokens=12)
