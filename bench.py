@@ -140,6 +140,30 @@ def _metric_quantile(name, q, **labels):
     return (c.quantile(q) if c is not None and c.count else None)
 
 
+def _bench_memory_section(engine):
+    """The bench ``memory`` section (memory-observability satellite):
+    ledger owner table reconciled against ``jax.live_arrays()`` plus the
+    engine's pool/capacity math.  Captured while the engine is live —
+    each arm runs in its own subprocess, so the process ledger is this
+    arm's engines and nothing else."""
+    from paddle_tpu.observability import memory as _obs_memory
+
+    rep = _obs_memory.ledger().report()
+    owners = {}
+    for r in rep["owners"]:
+        owners[r["owner"]] = owners.get(r["owner"], 0) + r["bytes"]
+    return {
+        "owners": owners,
+        "pool_bytes_by_dtype": engine.pool_bytes_by_dtype(),
+        "bytes_per_page": engine._bytes_per_page,
+        "max_resident_slots": engine.block_manager.max_resident_sequences(
+            engine.max_model_len),
+        "tracked_bytes": rep["tracked_bytes"],
+        "untracked_bytes": rep["untracked_bytes"],
+        "untracked_frac": round(rep["untracked_frac"], 6),
+    }
+
+
 def _measure_serving(n_requests=8, num_slots=4, S0=32, page_size=32,
                      max_news=None, model_kwargs=None, warm_tokens=4):
     """Continuous batching vs sequential generate() on a mixed-length
@@ -200,6 +224,7 @@ def _measure_serving(n_requests=8, num_slots=4, S0=32, page_size=32,
             h.result(timeout=600)
         t_engine = time.time() - t0
         step_traces = engine.step_traces
+        mem = _bench_memory_section(engine)
 
     ttft_n = ttft_h.count - ttft_n0
     ttft_mean = (ttft_h.sum - ttft_sum0) / ttft_n if ttft_n else None
@@ -225,6 +250,7 @@ def _measure_serving(n_requests=8, num_slots=4, S0=32, page_size=32,
                                       replica="0"),
         "step_traces": step_traces,
         "program_table": program_table,
+        "memory": mem,
         "note": ("continuous batching over the paged KV pool; sequential "
                  "baseline reuses ONE compiled generate() program pair "
                  "(pinned max_len)"),
@@ -284,10 +310,12 @@ def _measure_serving_speculative(spec_k=0, n_requests=8, num_slots=4, S0=32,
         ids = [h.result(timeout=600) for h in handles]
         dt = time.time() - t0
         rate = engine.acceptance_rate
+        mem = _bench_memory_section(engine)
 
     total = n_requests * max_new
     return {
         "spec_k": spec_k,
+        "memory": mem,
         "tokens": total,
         "tokens_per_sec": round(total / dt, 2),
         "itl_p50_s": _metric_quantile("serving.inter_token_seconds", 0.5,
@@ -360,6 +388,7 @@ def _measure_serving_quant(kv_dtype="bf16", n_requests=60, budget_slots=4,
         resident = engine.block_manager.max_resident_sequences(
             max_len, budget_bytes=budget_bytes)
         stats = engine.stats()
+        mem = _bench_memory_section(engine)
 
     total = n_requests * max_new
     return {
@@ -377,6 +406,7 @@ def _measure_serving_quant(kv_dtype="bf16", n_requests=60, budget_slots=4,
         "num_pages_at_budget": int(num_pages),
         "num_slots": num_slots,
         "max_resident_slots_at_budget": resident,
+        "memory": mem,
         "ids": [list(map(int, r)) for r in ids],
     }
 
@@ -527,12 +557,14 @@ def _measure_serving_multitenant(mode="multi", n_adapters=2,
             itl[n] = round(float(np.percentile(gaps, 95)), 6) if gaps \
                 else None
         valid = sum(1 for r in con_ids if grammar.matches(r))
+        mem = _bench_memory_section(all_engines[0])
     finally:
         for e in all_engines:
             e.stop()
     total = len(gen_work) * max_new + sum(len(r) for r in con_ids)
     return {
         "mode": mode,
+        "memory": mem,
         "n_adapters": n_adapters,
         "tokens": total,
         "tokens_per_sec": round(total / dt, 2),
@@ -646,6 +678,11 @@ def _measure_serving_cluster(replicas=1, policy="affinity", n_requests=16,
         ids = [h.result(timeout=900) for h in handles]
         dt = time.time() - t0
         hit_rate = cluster.affinity_hit_rate()
+        mem = _bench_memory_section(cluster.engines[0])
+        from paddle_tpu.observability import memory as _obs_memory
+
+        mem["per_replica"] = _obs_memory.ledger().replica_rollup(
+            [e.replica for e in cluster.engines])
         hits_c = _metrics.get_registry().get("serving.prefix_cache_hits")
         per_replica = {}
         for e in cluster.engines:
@@ -673,6 +710,7 @@ def _measure_serving_cluster(replicas=1, policy="affinity", n_requests=16,
         "prefix_cache_hits": sum(r["prefix_cache_hits"]
                                  for r in per_replica.values()),
         "per_replica": per_replica,
+        "memory": mem,
         "ids": [list(map(int, r)) for r in ids],
     }
 

@@ -29,6 +29,7 @@ import numpy as np
 
 import paddle_tpu as paddle
 import paddle_tpu.optimizer as opt
+from paddle_tpu.observability import memory
 from paddle_tpu.serving import ServingEngine
 from paddle_tpu.serving.quant import calibrate, top1_agreement
 
@@ -51,6 +52,14 @@ def build_model(period=8, train_steps=150):
     ids = paddle.to_tensor(np.stack([cyc[i:i + 64] for i in range(8)]))
     for _ in range(train_steps):
         step({"input_ids": ids, "labels": ids})
+    # drop the training-only device state (AdamW moments, the TrainStep's
+    # donated buffers) before serving: the memory ledger reconciles
+    # against jax.live_arrays(), and optimizer state would sit there as
+    # untracked bytes the serving process never actually needs
+    del o, step, ids
+    import gc
+
+    gc.collect()
     return m.eval(), cyc, period
 
 
@@ -62,6 +71,9 @@ def run_engine(model, prompts, **kw):
         handles = [engine.submit(p, max_new_tokens=MAX_NEW) for p in prompts]
         outs = [h.result(timeout=600) for h in handles]
         stats = engine.stats()
+        # reconcile the device-memory ledger against jax.live_arrays()
+        # while the engine (and hence its pool registrations) is alive
+        stats["memory_report"] = memory.ledger().report()
     return outs, stats
 
 
@@ -106,6 +118,18 @@ def main():
         // -(-tokens // PAGE)
     print(f"resident {tokens}-token slots at that budget: "
           f"{slots_ref} -> {slots_q} ({slots_q / slots_ref:.2f}x)")
+
+    print("\n-- memory ledger (int8 KV + int8 weights arm) --")
+    mrep = full_stats["memory_report"]
+    for row in mrep["owners"]:
+        print(f"  {row['owner']:<22} {row['bytes']:>12,} B  "
+              f"replica={row['replica']} device={row['device']}")
+    frac = mrep["untracked_frac"]
+    print(f"  tracked {mrep['tracked_bytes']:,} B of "
+          f"{mrep['live_bytes']:,} B live -> "
+          f"untracked_frac {frac:.4f} "
+          f"({'OK' if frac <= 0.05 else 'FAIL'}: ledger accounts "
+          f"{(1 - frac) * 100:.1f}% of live device bytes)")
 
     print("\nfirst request, last 12 tokens of each arm:")
     print("  reference:", ref[0][-12:])

@@ -30,9 +30,10 @@ Env flags (README "Distributed tracing & forensics"):
 from __future__ import annotations
 
 from . import (  # noqa: F401
-    faults, flight_recorder, perf, slo, telemetry, tracing, watchdog,
+    faults, flight_recorder, memory, perf, slo, telemetry, tracing, watchdog,
 )
 from .faults import FaultPlan  # noqa: F401
+from .memory import MemoryLedger, MemoryWatchdog  # noqa: F401
 from .perf import ProgramTable  # noqa: F401
 from .slo import RequestTimeline, SLOAccountant, SLOPolicy  # noqa: F401
 from .flight_recorder import (  # noqa: F401
@@ -52,8 +53,8 @@ from .watchdog import (  # noqa: F401
 
 __all__ = [
     "tracing", "flight_recorder", "watchdog", "telemetry", "faults",
-    "perf", "slo", "ProgramTable", "SLOPolicy", "SLOAccountant",
-    "RequestTimeline",
+    "perf", "slo", "memory", "ProgramTable", "SLOPolicy", "SLOAccountant",
+    "RequestTimeline", "MemoryLedger", "MemoryWatchdog",
     "Span", "Tracer", "span", "event", "new_trace_id", "current_trace_id",
     "open_spans", "merge_rank_traces",
     "FlightRecorder", "get_flight_recorder", "install_crash_handlers",

@@ -25,7 +25,10 @@ Sites wired so far:
 - ``serving.scheduler_wedge`` — top of the serving scheduler loop;
 - ``serving.step_crash`` — immediately before the batched decode dispatch
   (:meth:`paddle_tpu.serving.engine.ServingEngine._step_once`);
-- ``chaos.train_step`` — the chaos harness's train-loop site.
+- ``chaos.train_step`` — the chaos harness's train-loop site;
+- ``memory.leak`` — grows the synthetic ``fault.memory_leak`` ledger
+  owner by 8 MiB per trip (:mod:`.memory`; exercised by the
+  :class:`~.memory.MemoryWatchdog` tests — no real allocation).
 
 Armed faults are listed on the telemetry ``/statusz`` page
 (:func:`describe`).

@@ -158,7 +158,7 @@ def is_encode_family(family):
     return "@embed" in family or "@score" in family
 
 
-def candidate_hint(family, regime):
+def candidate_hint(family, regime, temp_bytes=None, pool_bytes=None):
     """The regime-driven recommendation :meth:`ProgramTable.report` prints
     for a top device-time program.  Recognizes the quantized serving
     families: a bandwidth-bound UNQUANTIZED serving program's first lever
@@ -167,9 +167,23 @@ def candidate_hint(family, regime):
     so the hint points at the remaining byte traffic instead.  Also the
     multi-tenant families: ``@lora-r<r>`` programs carry the per-row
     paged adapter gather, ``@embed``/``@score`` are prefill-shaped
-    one-shot encodes."""
+    one-shot encodes.
+
+    Memory attribution (``temp_bytes`` from the family's
+    ``memory_analysis``, ``pool_bytes`` = the ledger's KV pool total):
+    a prefill family whose peak scratch dwarfs the whole paged cache is
+    capacity-bound before it is time-bound — the hint becomes 'chunk the
+    prefill', whatever the roofline regime says."""
     quant = is_quantized_family(family)
     serving = family.split("@")[0].startswith(_KV_BOUND_FAMILIES)
+    if temp_bytes and pool_bytes \
+            and family.split("@")[0].startswith("prefill/") \
+            and temp_bytes > pool_bytes:
+        return (f"prefill peak temp bytes ({temp_bytes / 1e6:.1f} MB) dwarf "
+                f"the paged KV pools ({pool_bytes / 1e6:.1f} MB): chunk the "
+                "prefill — run the prompt through the chunked cache variant "
+                "in page-sized slices so scratch stays O(chunk), and long "
+                "prompts stop spiking HBM at admission")
     if regime == "bandwidth-bound":
         if is_lora_family(family):
             if quant:
@@ -208,7 +222,8 @@ def candidate_hint(family, regime):
 
 class _ProgStats:
     __slots__ = ("family", "calls", "device_seconds", "flops_per_call",
-                 "bytes_per_call", "cost_thunk", "cost_error")
+                 "bytes_per_call", "memory_per_call", "cost_thunk",
+                 "cost_error")
 
     def __init__(self, family):
         self.family = family
@@ -216,7 +231,8 @@ class _ProgStats:
         self.device_seconds = 0.0
         self.flops_per_call = None
         self.bytes_per_call = None
-        self.cost_thunk = None   # lazy () -> (flops, bytes)
+        self.memory_per_call = None  # XLA memory_analysis dict (or None)
+        self.cost_thunk = None   # lazy () -> (flops, bytes[, memory])
         self.cost_error = None   # last thunk failure (kept, not retried)
 
 
@@ -247,6 +263,15 @@ class ProgramTable:
             "perf.program.frac_of_peak",
             "achieved rate over the BINDING peak (HBM when "
             "bandwidth-bound, FLOP/s when compute-bound)")
+        # per-program memory attribution (memory_analysis, resolved off
+        # the dispatch path exactly like the cost thunks)
+        self._m_peak_bytes = reg.gauge(
+            "perf.program.peak_bytes",
+            "XLA memory_analysis peak bytes per call (argument + output "
+            "+ temp - aliased)")
+        self._m_temp_bytes = reg.gauge(
+            "perf.program.temp_bytes",
+            "XLA memory_analysis temp (scratch) bytes per call")
 
     # -------------------------------------------------------------- recording
     def _get(self, family):
@@ -275,11 +300,13 @@ class ProgramTable:
                               and st.cost_thunk is None
                               and st.cost_error is None)
 
-    def set_cost(self, family, flops_per_call, bytes_per_call):
+    def set_cost(self, family, flops_per_call, bytes_per_call, memory=None):
         st = self._get(family)
         with self._lock:
             st.flops_per_call = float(flops_per_call)
             st.bytes_per_call = float(bytes_per_call)
+            if memory is not None:
+                st.memory_per_call = dict(memory)
             st.cost_thunk = None
 
     def register_cost_thunk(self, family, thunk):
@@ -300,8 +327,11 @@ class ProgramTable:
             if thunk is None:
                 continue
             try:
-                flops, nbytes = thunk()
-                self.set_cost(st.family, flops, nbytes)
+                res = thunk()
+                # jit_cost_thunk returns (flops, bytes, memory_analysis);
+                # external 2-tuple thunks stay valid
+                mem = res[2] if len(res) > 2 else None
+                self.set_cost(st.family, res[0], res[1], memory=mem)
             except Exception as e:  # cost analysis is best-effort
                 with self._lock:
                     st.cost_error = repr(e)
@@ -335,15 +365,25 @@ class ProgramTable:
         with self._lock:
             stats = [(st.family, st.calls, st.device_seconds,
                       st.flops_per_call, st.bytes_per_call, st.cost_error,
-                      st.cost_thunk is not None)
+                      st.cost_thunk is not None, st.memory_per_call)
                      for st in self._stats.values()]
-        for family, calls, secs, flops, nbytes, err, pending in stats:
+        for family, calls, secs, flops, nbytes, err, pending, mem in stats:
             row = {"program": family, "calls": calls,
                    "device_seconds": secs,
                    "flops_per_call": flops, "bytes_per_call": nbytes,
                    "achieved_tflops": None, "achieved_gbs": None,
                    "intensity_flop_per_byte": None,
-                   "regime": "unknown", "frac_of_peak": None}
+                   "regime": "unknown", "frac_of_peak": None,
+                   "argument_bytes": None, "output_bytes": None,
+                   "temp_bytes": None, "peak_bytes": None}
+            if mem:
+                for k in ("argument_bytes", "output_bytes", "temp_bytes",
+                          "peak_bytes"):
+                    row[k] = mem.get(k)
+                if row["peak_bytes"] is not None:
+                    self._m_peak_bytes.set(row["peak_bytes"], program=family)
+                if row["temp_bytes"] is not None:
+                    self._m_temp_bytes.set(row["temp_bytes"], program=family)
             if pending:
                 row["cost"] = "pending"
             elif err is not None:
@@ -393,28 +433,42 @@ class ProgramTable:
         when compute-bound)."""
         rows = self.snapshot(resolve=resolve)
         head = (f"{'program':<24}{'calls':>8}{'dev s':>10}{'TFLOP/s':>10}"
-                f"{'GB/s':>9}{'I(F/B)':>9}{'of peak':>9}  regime")
+                f"{'GB/s':>9}{'I(F/B)':>9}{'of peak':>9}{'peak MB':>9}"
+                "  regime")
         lines = ["Per-program roofline attribution", head, "-" * len(head)]
 
         def fmt(v, nd=2):
             return f"{v:.{nd}f}" if v is not None else "-"
 
         for r in rows:
+            peak_mb = r["peak_bytes"] / 1e6 \
+                if r.get("peak_bytes") is not None else None
             lines.append(
                 f"{r['program']:<24}{r['calls']:>8}"
                 f"{r['device_seconds']:>10.3f}"
                 f"{fmt(r['achieved_tflops']):>10}{fmt(r['achieved_gbs'], 1):>9}"
                 f"{fmt(r['intensity_flop_per_byte'], 1):>9}"
-                f"{fmt(r['frac_of_peak'], 3):>9}  {r['regime']}")
+                f"{fmt(r['frac_of_peak'], 3):>9}{fmt(peak_mb, 1):>9}"
+                f"  {r['regime']}")
         cands = [r for r in rows if r["device_seconds"] > 0][:top]
         if cands:
+            # the memory ledger's KV pool total is the denominator for the
+            # chunk-the-prefill hint (best-effort: no ledger, no hint)
+            try:
+                from . import memory as _memory
+
+                pool_bytes = _memory.ledger().kv_pool_bytes()
+            except Exception:
+                pool_bytes = None
             lines.append("")
             lines.append("Top kernel/fusion candidates (by device time):")
             for i, r in enumerate(cands, 1):
+                hint = candidate_hint(r["program"], r["regime"],
+                                      temp_bytes=r.get("temp_bytes"),
+                                      pool_bytes=pool_bytes)
                 lines.append(f"  {i}. {r['program']} "
                              f"({r['device_seconds']:.3f}s over "
-                             f"{r['calls']} calls) — "
-                             f"{candidate_hint(r['program'], r['regime'])}")
+                             f"{r['calls']} calls) — {hint}")
         return "\n".join(lines)
 
     def drop_prefix(self, prefix):
@@ -511,11 +565,45 @@ def _shape_struct(v):
     return jax.ShapeDtypeStruct(tuple(shape), dtype)
 
 
+def _memory_analysis_dict(comp):
+    """One compiled program's ``memory_analysis()`` as a plain dict
+    (argument/output/temp/alias/generated-code bytes + a derived peak:
+    XLA's CompiledMemoryStats has no explicit peak field on every
+    backend, but arguments + outputs + temp − aliased is the live set a
+    dispatch holds at once).  Best-effort: ``None`` when the backend
+    doesn't expose it."""
+    try:
+        ma = comp.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+
+    def g(name):
+        try:
+            return float(getattr(ma, name))
+        except Exception:
+            return 0.0
+
+    arg = g("argument_size_in_bytes")
+    out = g("output_size_in_bytes")
+    temp = g("temp_size_in_bytes")
+    alias = g("alias_size_in_bytes")
+    peak = getattr(ma, "peak_memory_in_bytes", None)
+    if peak is None:
+        peak = max(0.0, arg + out + temp - alias)
+    return {"argument_bytes": arg, "output_bytes": out, "temp_bytes": temp,
+            "alias_bytes": alias,
+            "generated_code_bytes": g("generated_code_size_in_bytes"),
+            "peak_bytes": float(peak)}
+
+
 def jit_cost_thunk(jitted, args):
     """Build a lazy cost thunk for a ``jax.jit``-ed callable from the
     concrete args of one dispatch: shapes/dtypes are captured NOW (cheap;
     donated buffers keep their metadata), the re-lower+compile+
-    cost_analysis runs only when the table resolves costs.
+    cost_analysis+memory_analysis runs only when the table resolves
+    costs.
 
     The program is held by WEAKREF: the process-wide table outlives any
     one engine/model, and a pending thunk must not pin a dead model's
@@ -538,6 +626,7 @@ def jit_cost_thunk(jitted, args):
         ca = comp.cost_analysis()
         ca = ca[0] if isinstance(ca, list) else ca
         return (float(ca.get("flops", 0.0)),
-                float(ca.get("bytes accessed", 0.0)))
+                float(ca.get("bytes accessed", 0.0)),
+                _memory_analysis_dict(comp))
 
     return thunk

@@ -137,7 +137,29 @@ class AsyncCheckpointManager:
         self._m_corrupt = _metrics.counter(
             "resilience.checkpoint_corruptions",
             "checkpoints quarantined after failing their manifest")
+        self._register_memory()
         self._gc_partials()
+
+    def _register_memory(self):
+        """Ledger owner ``checkpoint.snapshot`` (observability/memory.py):
+        queued-but-unwritten snapshots are host numpy, not HBM, so the row
+        registers with ``device="host"`` — visible in the owner table,
+        excluded from the ``jax.live_arrays()`` reconciliation."""
+        import weakref
+
+        from ..observability import memory as _obs_memory
+
+        ref = weakref.ref(self)
+
+        def src():
+            mgr = ref()
+            if mgr is None:
+                return None
+            return sum(int(a.nbytes) for _, _, arrays in mgr._pending
+                       for a in arrays)
+        _obs_memory.ledger().register(
+            "checkpoint.snapshot", src, replica="-", device="host",
+            meta={"kind": "checkpoint"})
 
     # ------------------------------------------------------------- locations
     def _step_dir(self, step):

@@ -90,6 +90,13 @@ class GPTAdapter:
         return (2 * self.num_layers * self.page_size * self.num_kv_heads
                 * self.head_dim * jnp.dtype(self.dtype).itemsize)
 
+    def pool_owners(self):
+        """Memory-ledger owner labels over the pool tuple: ``(owner,
+        pool-index tuple)`` pairs covering EVERY pool array, so the
+        engine's ledger registration attributes payload and scale pools
+        separately (observability.memory owner taxonomy)."""
+        return (("kv.pages", tuple(range(self.n_pools))),)
+
     def _layer_caches(self, pools, table, lens, tag):
         """Per-layer GPTDecoderLayer cache tuples from the pool tuple."""
         from ..tensor.tensor import Tensor

@@ -125,7 +125,42 @@ class BlockManager:
             st["pool_bytes"] = self.num_pages * self.bytes_per_page
             st["used_bytes"] = self.used_pages * self.bytes_per_page
             st["kv_bytes_per_token"] = self.bytes_per_page / self.page_size
+        st["fragmentation"] = self.fragmentation()
         return st
+
+    def fragmentation(self):
+        """Free-list fragmentation snapshot (memory observability): runs
+        of CONTIGUOUS free page indices, their largest length, and a
+        power-of-two run-length histogram, plus the evictable idle
+        prefix pages sitting outside the free list.  Paged attention is
+        indifferent to contiguity (any row works), so this is a
+        diagnostic for allocator churn and for future contiguous-DMA
+        kernels, not an admission input."""
+        runs = []
+        run = 0
+        prev = None
+        for p in sorted(self._free):
+            if prev is not None and p == prev + 1:
+                run += 1
+            else:
+                if run:
+                    runs.append(run)
+                run = 1
+            prev = p
+        if run:
+            runs.append(run)
+        hist = {}
+        for r in runs:
+            lo = 1 << (r.bit_length() - 1)
+            key = f"{lo}" if lo == 1 else f"{lo}-{2 * lo - 1}"
+            hist[key] = hist.get(key, 0) + 1
+        return {
+            "free_pages": len(self._free),
+            "free_runs": len(runs),
+            "largest_free_run": max(runs, default=0),
+            "run_histogram": hist,
+            "evictable_idle_pages": len(self._idle),
+        }
 
     def max_resident_sequences(self, tokens_per_seq, budget_bytes=None):
         """Capacity math: how many sequences of ``tokens_per_seq`` worst

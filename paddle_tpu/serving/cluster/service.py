@@ -582,6 +582,8 @@ class ServingCluster:
         # (PR-3 signal-path rule, asserted by the telemetry-under-load
         # test)
         inflight = len(self._inflight)
+        from ...observability import memory as _obs_memory
+
         return {
             "replicas": self._pool.stats(),
             "policy": self._router.policy,
@@ -591,6 +593,10 @@ class ServingCluster:
             "affinity": {"hits": self._aff_hits,
                          "misses": self._aff_misses,
                          "hit_rate": self.affinity_hit_rate()},
+            # per-replica device-memory rollup off the process ledger —
+            # owner_rows only, no live-array walk, still lockless
+            "memory": _obs_memory.ledger().replica_rollup(
+                [e.replica for e in self._pool.engines]),
         }
 
     def _statusz(self):

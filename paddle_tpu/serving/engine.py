@@ -81,11 +81,13 @@ import os
 import queue as _queue
 import threading
 import time
+import weakref
 
 import jax
 import numpy as np
 
 from ..observability import faults as _faults
+from ..observability import memory as _obs_memory
 from ..observability import perf as _perf
 from ..observability import tracing as _tracing
 from ..resilience.retry import EngineStoppedError, classify_failure  # noqa: F401 — re-exported
@@ -537,6 +539,76 @@ class ServingEngine:
             "serving.pool_bytes",
             "allocated KV page-pool HBM bytes (scratch page included)")
         self._set_pool_gauges()
+        # memory observability (observability/memory.py): every long-lived
+        # device allocation this engine owns registers with the process
+        # ledger, and admission pre-flight projects new requests against
+        # PADDLE_HBM_BUDGET_BYTES — fixed bytes (params + buffers) plus
+        # pages already committed to admitted-but-unfinished requests
+        self._fixed_bytes = int(
+            sum(int(v.nbytes) for v in self._params.values())
+            + sum(int(v.nbytes) for v in self._bufs.values()))
+        self._committed_pages = 0
+        self._commit_lock = threading.Lock()
+        self._mem_regs = []
+        self._register_memory()
+
+    def _register_memory(self):
+        """Register this engine's device allocations with the process
+        MemoryLedger.  Sources close over a weakref — the ledger never
+        pins the engine, and every read resolves the CURRENT pool tuple,
+        so a post-crash ``_recover()`` rebuild needs no re-registration."""
+        led = _obs_memory.ledger()
+        ref = weakref.ref(self)
+
+        def _pools_src(idx):
+            def src():
+                eng = ref()
+                if eng is None:
+                    return None
+                return [eng._pools[i] for i in idx]
+            return src
+
+        for owner, idx in self._adapter.pool_owners():
+            meta = None
+            if owner == "kv.pages":
+                meta = {
+                    "kind": "kv",
+                    "bytes_per_page": self._bytes_per_page,
+                    "page_size": self.page_size,
+                    "num_pages": self._num_pages,
+                    "max_model_len": self.max_model_len,
+                    "max_resident_slots":
+                        self._bm.max_resident_sequences(self.max_model_len),
+                }
+            elif owner == "kv.scales":
+                meta = {"kind": "kv_scales"}
+            self._mem_regs.append(led.register(
+                owner, _pools_src(idx), replica=self.replica, meta=meta))
+
+        def _named_src(which, pred):
+            def src():
+                eng = ref()
+                if eng is None:
+                    return None
+                d = eng._params if which == "params" else eng._bufs
+                return [v for k, v in d.items() if pred(k)]
+            return src
+
+        # int8-converted weights get their own owner row; everything else
+        # (f32/bf16 params, residual buffers, Int8Linear biases) is
+        # model.params.  Int8Linear stores its payload in a buffer named
+        # ``<sublayer>.weight_int8`` (quantization.Int8Linear).
+        is_q = lambda k: k.endswith("weight_int8")  # noqa: E731
+        self._mem_regs.append(led.register(
+            "model.params", _named_src("params", lambda k: True),
+            replica=self.replica, meta={"kind": "weights"}))
+        self._mem_regs.append(led.register(
+            "model.params", _named_src("bufs", lambda k: not is_q(k)),
+            replica=self.replica, meta={"kind": "weights"}))
+        if self.weight_dtype == "int8":
+            self._mem_regs.append(led.register(
+                "model.weights_int8", _named_src("bufs", is_q),
+                replica=self.replica, meta={"kind": "weights_int8"}))
 
     def _new_block_manager(self):
         return BlockManager(self._num_pages, self.page_size,
@@ -547,9 +619,26 @@ class ServingEngine:
 
     def _set_pool_gauges(self):
         self._m_kv_bytes_tok.set(self._bytes_per_page / self.page_size)
-        self._m_pool_bytes.set(
-            float(sum(int(p.nbytes) for p in self._pools)),
-            dtype=self._pool_dtype)
+        # one series PER POOL DTYPE: the quantized engine's f32 scale
+        # pools are real device residency — folding them into the int8
+        # series used to make serving.pool_bytes disagree with what the
+        # arrays actually occupy (ISSUE 12 satellite fix)
+        by_dtype = {}
+        for p in self._pools:
+            dt = str(p.dtype)
+            by_dtype[dt] = by_dtype.get(dt, 0) + int(p.nbytes)
+        for dt, b in by_dtype.items():
+            self._m_pool_bytes.set(float(b), dtype=dt)
+
+    def pool_bytes_by_dtype(self):
+        """Actual pool-tuple device bytes, keyed by array dtype (payload
+        AND scale pools — what /statusz and the bench memory section
+        reconcile against the ledger)."""
+        out = {}
+        for p in self._pools:
+            dt = str(p.dtype)
+            out[dt] = out.get(dt, 0) + int(p.nbytes)
+        return out
 
     # ------------------------------------------------------------ lifecycle
     def start(self):
@@ -794,6 +883,7 @@ class ServingEngine:
                                f"admission queue full ({self._max_queue})")
                 if deadline_s is not None:
                     self._check_deadline_meetable(float(deadline_s))
+                self._preflight_hbm(handle, prompt, total, mode)
                 deadline = time.time() + deadline_s \
                     if deadline_s is not None else None
                 self._queue.append(Request(prompt, int(max_new_tokens),
@@ -825,6 +915,47 @@ class ServingEngine:
         self._m_shed.inc(reason=reason)
         self._m_requests.inc(status="rejected")
         raise RequestRejectedError(message, reason=reason)
+
+    def _preflight_hbm(self, handle, prompt, total, mode):
+        """OOM forensics' prevention half (observability/memory.py):
+        when ``PADDLE_HBM_BUDGET_BYTES`` is set, project this request's
+        worst-case page need against what the budget leaves after the
+        fixed allocations (params + buffers + the full page pools are
+        already resident; what grows with admission is the COMMITTED
+        page count across admitted-but-unfinished requests).  Shedding
+        here with ``reason="hbm_budget"`` never changes what admitted
+        requests compute — pages either fit or the request never runs —
+        so greedy outputs stay byte-identical to an unbudgeted engine."""
+        if mode != "generate":
+            return                      # no pages are ever committed
+        budget = _obs_memory.hbm_budget_bytes()
+        if budget is None:
+            return
+        need = self._bm.pages_for(total)
+        headroom = int(budget) - self._fixed_bytes
+        page_budget = headroom // self._bytes_per_page if headroom > 0 else 0
+        # pools cap the committed total too: never promise pages past P
+        page_budget = min(page_budget, self._num_pages)
+        with self._commit_lock:
+            if self._committed_pages + need > page_budget:
+                self._shed(
+                    "hbm_budget",
+                    f"request needs {need} pages "
+                    f"({need * self._bytes_per_page} B) but "
+                    f"{self._committed_pages}/{page_budget} budgeted pages "
+                    f"are committed (PADDLE_HBM_BUDGET_BYTES={budget}, "
+                    f"fixed {self._fixed_bytes} B)")
+            self._committed_pages += need
+            handle._hbm_pages = need
+
+    def _release_hbm(self, handle):
+        """Idempotent un-commit of a handle's pre-flight page reservation
+        (every terminal path funnels through ``_finish``)."""
+        n = getattr(handle, "_hbm_pages", 0)
+        if n:
+            handle._hbm_pages = 0
+            with self._commit_lock:
+                self._committed_pages -= n
 
     def _check_deadline_meetable(self, deadline_s):
         """Deadline-aware admission (called under the cv lock): shed NOW if
@@ -997,6 +1128,15 @@ class ServingEngine:
                     continue
                 self._step_once()
             except BaseException as e:
+                # OOM forensics FIRST, while the allocation state that
+                # produced the failure is still live: one flight dump
+                # carrying the ledger owner table and per-program peak
+                # bytes (observability/memory.py), then normal recovery
+                if _obs_memory.is_oom_error(e):
+                    try:
+                        _obs_memory.oom_dump(e, replica=self.replica)
+                    except Exception:
+                        pass
                 # the budget is a burst limit, not a lifetime one: a full
                 # cooldown of healthy operation since the last restart
                 # heals it (3 recovered blips spread over weeks must not
@@ -1542,6 +1682,7 @@ class ServingEngine:
             self._drafter.reset()
 
     def _finish(self, handle, status):
+        self._release_hbm(handle)
         handle.status = status
         handle.finished_at = time.time()
         handle.finished_iteration = self._iteration
@@ -1666,6 +1807,17 @@ class ServingEngine:
         snapshot — reads race the scheduler thread benignly)."""
         st = self.stats()
         st["kv_cache"] = self._bm.stats()   # pool dtype + bytes/page live
+        # memory observability: this replica's ledger owner rows (cheap —
+        # no live-array walk; signal-path rule: no engine lock is held),
+        # the pool tuple's actual per-dtype residency, and the admission
+        # pre-flight state
+        st["memory"] = {
+            "owners": _obs_memory.ledger().owner_rows(replica=self.replica),
+            "pool_bytes_by_dtype": self.pool_bytes_by_dtype(),
+            "fixed_bytes": self._fixed_bytes,
+            "committed_pages": self._committed_pages,
+            "hbm_budget_bytes": _obs_memory.hbm_budget_bytes(),
+        }
         st["started"] = self._started
         st["error"] = repr(self._error) if self._error is not None else None
         st["health"] = self.health_state()

@@ -224,6 +224,32 @@ class LoRAStore:
             "serving.lora_resident", "adapters resident in the device pools")
         self._m_registered = _metrics.gauge(
             "serving.lora_registered", "adapters in the host registry")
+        self._register_memory()
+
+    def _register_memory(self):
+        """Per-rank-bucket ledger owners ``lora.r<r>`` (observability/
+        memory.py): each bucket's A/B pool slice registers as one owner
+        so the /statusz owner table shows where multi-tenant HBM goes by
+        rank.  Sources close over a weakref — the ledger never pins the
+        store.  replica="shared": one store serves N cluster replicas."""
+        import weakref
+
+        from ...observability import memory as _obs_memory
+
+        led = _obs_memory.ledger()
+        ref = weakref.ref(self)
+        per_bucket = 2 * len(self.targets)
+        for bi, r in enumerate(self.ranks):
+            def src(bi=bi):
+                st = ref()
+                if st is None:
+                    return None
+                return list(
+                    st._pools[bi * per_bucket:(bi + 1) * per_bucket])
+            led.register(f"lora.r{r}", src, replica="shared",
+                         meta={"kind": "lora", "rank": r,
+                               "capacity": self.capacity,
+                               "targets": list(self.targets)})
 
     # ------------------------------------------------------------- identity
     def signature(self):

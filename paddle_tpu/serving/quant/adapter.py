@@ -56,6 +56,12 @@ class QuantizedGPTAdapter(GPTAdapter):
         return (2 * self.num_layers * self.page_size * self.num_kv_heads
                 * per_pos_head)
 
+    def pool_owners(self):
+        """int8 payload pools and f32 scale pools get separate ledger
+        owners — the scale pools are real device residency that the
+        payload-only view used to hide (ISSUE 12 satellite fix)."""
+        return (("kv.pages", (0, 1)), ("kv.scales", (2, 3)))
+
     def _layer_caches(self, pools, table, lens, tag):
         from ...tensor.tensor import Tensor
 

@@ -1,0 +1,500 @@
+"""Memory observability (ISSUE 12): the device-memory ledger, per-program
+XLA memory attribution, the fragmentation/leak watchdogs, OOM forensics
+and the HBM-budget admission pre-flight.
+
+Suite marker: ``mem``.  Heavy engine runs (compiles) are also marked
+``slow`` so tier-1 stays inside its budget; the light tests exercise the
+ledger/watchdog/fragmentation units and a SINGLE shared tiny engine.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import (
+    MemoryLedger, MemoryWatchdog, faults, flight_recorder,
+    memory as obs_memory, perf, telemetry,
+)
+from paddle_tpu.profiler import metrics as prof_metrics
+
+pytestmark = pytest.mark.mem
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MAXLEN = 64
+PS = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_memory_state(tmp_path):
+    """Fresh fault/flight state per test; the process ledger keeps the
+    long-lived registrations (module-scoped engines) but fault-leak
+    bookkeeping resets."""
+    faults.clear()
+    rec = flight_recorder.get_flight_recorder()
+    old_dir, old_last = rec.dir, rec.last_dump_path
+    rec.dir = str(tmp_path / "flight")
+    yield
+    rec.dir, rec.last_dump_path = old_dir, old_last
+    faults.clear()
+    # drop only the synthetic fault owner; other registrations are owned
+    # by live fixtures and must survive across tests
+    led = obs_memory.ledger()
+    for reg in list(led._regs):
+        if reg.owner == "fault.memory_leak":
+            led.unregister(reg)
+    with obs_memory._LOCK:
+        obs_memory._fault_leak_bytes = 0
+        obs_memory._fault_leak_trips_seen = 0
+        obs_memory._fault_leak_registered = False
+    telemetry.shutdown()
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    from paddle_tpu.text.models.gpt import GPTForCausalLM
+
+    return GPTForCausalLM(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                          num_attention_heads=2,
+                          max_position_embeddings=MAXLEN).eval()
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    """ONE compiled tiny engine shared by the in-budget tests (compiling
+    per test would blow the tier-1 budget)."""
+    from paddle_tpu.serving import ServingEngine
+
+    eng = ServingEngine(model, num_slots=2, page_size=PS,
+                        max_model_len=MAXLEN)
+    with eng:
+        eng.generate([1, 2, 3, 4], max_new_tokens=4, timeout=600)  # compile
+        yield eng
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# ================================================================ ledger unit
+def test_ledger_register_report_reconcile_and_evict():
+    led = MemoryLedger(registry=prof_metrics.MetricsRegistry())
+    a = jnp.zeros((16, 16), jnp.float32)      # 1024 B
+    b = jnp.zeros((8,), jnp.float32)          # 32 B
+
+    class Holder:
+        pass
+
+    h = Holder()
+    h.arrs = [a]
+    import weakref
+
+    ref = weakref.ref(h)
+    led.register("kv.pages", lambda: (ref().arrs if ref() is not None
+                                      else None), replica="0",
+                 meta={"kind": "kv", "bytes_per_page": 64, "page_size": PS,
+                       "num_pages": 4, "max_model_len": MAXLEN,
+                       "max_resident_slots": 2})
+    led.register("model.params", lambda: [a, b], replica="0")
+    led.register("checkpoint.snapshot", lambda: 4096, replica="-",
+                 device="host")
+    rep = led.report()
+    by = {}
+    for r in rep["owners"]:
+        by.setdefault(r["owner"], 0)
+        by[r["owner"]] += r["bytes"]
+    # per-owner rows report their full view...
+    assert by["kv.pages"] == 1024
+    assert by["model.params"] == 1024 + 32
+    assert by["checkpoint.snapshot"] == 4096
+    # ...but the reconciled total deduplicates the shared array and
+    # excludes host rows
+    assert rep["tracked_bytes"] == 1024 + 32
+    # every tracked array is live -> none of OUR bytes are untracked
+    assert rep["untracked_bytes"] >= 0
+    untracked_row = rep["owners"][-1]
+    assert untracked_row["owner"] == "untracked"
+    # statusz folds budget + the kv capacity math out of the metadata
+    sz = led.statusz()
+    [cap] = sz["kv_capacity"]
+    assert cap["bytes_per_page"] == 64 and cap["max_resident_slots"] == 2
+    # dead source (weakref went away) evicts its row on the next read
+    del h
+    owners = {r["owner"] for r in led.owner_rows()}
+    assert "kv.pages" not in owners and "model.params" in owners
+
+
+def test_ledger_replica_filter_and_rollup():
+    led = MemoryLedger(registry=prof_metrics.MetricsRegistry())
+    a = jnp.zeros((4, 4), jnp.float32)
+    led.register("kv.pages", lambda: [a], replica="0")
+    led.register("kv.pages", lambda: [a], replica="1")
+    led.register("model.params", lambda: [a], replica="1")
+    assert [r["owner"] for r in led.owner_rows(replica="0")] == ["kv.pages"]
+    roll = led.replica_rollup(["0", "1", "2"])
+    assert roll["0"]["bytes"] == 64
+    assert roll["1"]["owners"] == {"kv.pages": 64, "model.params": 64}
+    assert roll["2"]["bytes"] == 0
+    assert led.kv_pool_bytes() == 128            # both replicas' kv rows
+    assert led.owner_totals()["kv.pages"] == 128
+
+
+def test_hbm_budget_env_parsing(monkeypatch):
+    monkeypatch.delenv("PADDLE_HBM_BUDGET_BYTES", raising=False)
+    assert obs_memory.hbm_budget_bytes() is None
+    monkeypatch.setenv("PADDLE_HBM_BUDGET_BYTES", "1.5e9")
+    assert obs_memory.hbm_budget_bytes() == 1_500_000_000
+    monkeypatch.setenv("PADDLE_HBM_BUDGET_BYTES", "not-a-number")
+    assert obs_memory.hbm_budget_bytes() is None  # malformed must not kill
+
+
+def test_is_oom_error_markers():
+    assert obs_memory.is_oom_error(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                     "1073741824 bytes"))
+    assert obs_memory.is_oom_error(ValueError("jaxlib: out of memory"))
+    assert not obs_memory.is_oom_error(RuntimeError("shape mismatch"))
+
+
+def test_oom_dump_carries_owner_table_and_programs(tmp_path):
+    perf.record("decode", 0.01, calls=3)
+    path = obs_memory.oom_dump(
+        RuntimeError("RESOURCE_EXHAUSTED: failed to allocate"), replica="7")
+    assert path and os.path.exists(path)
+    doc = json.loads(open(path).read())
+    assert doc["reason"] == "oom"
+    extra = doc["extra"]
+    assert extra["replica"] == "7"
+    assert "owners" in extra["memory"]          # the full ledger statusz
+    progs = {p["program"] for p in extra["programs"]}
+    assert "decode" in progs                    # perf rows ride along
+    perf.reset()
+
+
+# ============================================================= fragmentation
+def test_block_manager_fragmentation_stats():
+    from paddle_tpu.serving.block_manager import BlockManager
+
+    bm = BlockManager(num_pages=8, page_size=PS)
+    frag = bm.stats()["fragmentation"]
+    assert frag["free_pages"] == 8 and frag["free_runs"] == 1
+    assert frag["largest_free_run"] == 8
+    assert frag["run_histogram"] == {"8-15": 1}
+    # carve holes: allocate 3 sequences of one page, free the middle one
+    a1 = bm.allocate([1] * PS, PS)
+    a2 = bm.allocate([2] * PS, PS)
+    a3 = bm.allocate([3] * PS, PS)
+    bm.free(a2)
+    frag = bm.fragmentation()
+    assert frag["free_pages"] == 6
+    # pages 3..7 contiguous + the freed page 1 -> two runs, largest 5
+    assert frag["free_runs"] == 2 and frag["largest_free_run"] == 5
+    assert frag["run_histogram"] == {"1": 1, "4-7": 1}
+    bm.free(a1), bm.free(a3)
+
+
+# ================================================================== watchdog
+def test_watchdog_leak_fault_fires_exactly_one_dump():
+    """Satellite 3b: the ``memory.leak`` fault grows the synthetic owner
+    every tick; the watchdog fires ONE flight dump for the episode (not
+    one per tick), naming the owner, with the owner table attached."""
+    led = obs_memory.ledger()
+    wd = MemoryWatchdog(led=led, windows=3)
+    faults.inject("memory.leak", every=1)
+    fired = []
+    for _ in range(6):
+        fired += wd.tick()
+    assert len(fired) == 1, f"expected one dump, got {fired}"
+    doc = json.loads(open(fired[0]).read())
+    assert doc["reason"] == "memory_leak"
+    assert doc["extra"]["leaking_owner"] == "fault.memory_leak"
+    assert any(r["owner"] == "fault.memory_leak"
+               for r in doc["extra"]["owners"])
+    assert doc["extra"]["owner_bytes"] >= \
+        3 * obs_memory.FAULT_LEAK_STEP_BYTES
+    # growth stops -> streak resets -> a NEW leak episode re-fires
+    faults.clear()
+    for _ in range(2):
+        assert wd.tick() == []
+    faults.inject("memory.leak", every=1)
+    fired2 = []
+    for _ in range(6):
+        fired2 += wd.tick()
+    assert len(fired2) == 1
+
+
+def test_watchdog_budget_excursion_fires_once(monkeypatch):
+    led = MemoryLedger(registry=prof_metrics.MetricsRegistry())
+    nbytes = {"n": 10_000}
+    led.register("model.params", lambda: nbytes["n"], replica="0")
+    wd = MemoryWatchdog(led=led, windows=100)   # leak path out of the way
+    monkeypatch.setenv("PADDLE_HBM_BUDGET_BYTES", "5000")
+    fired = wd.tick() + wd.tick()
+    assert len(fired) == 1
+    doc = json.loads(open(fired[0]).read())
+    assert doc["reason"] == "hbm_budget"
+    assert doc["extra"]["total_bytes"] == 10_000
+    # back under budget re-arms; a second excursion fires again
+    nbytes["n"] = 1_000
+    assert wd.tick() == []
+    nbytes["n"] = 10_000
+    assert len(wd.tick()) == 1
+
+
+# ============================================== per-program memory attribution
+def test_perf_memory_analysis_resolved_off_dispatch_path():
+    import jax
+
+    t = perf.ProgramTable(registry=prof_metrics.MetricsRegistry())
+    x = jnp.zeros((32, 32), jnp.float32)
+
+    @jax.jit
+    def f(a):
+        return (a @ a).sum()
+
+    f(x)  # compiled once, like a serving program
+    t.record("prefill/32", 0.01, calls=1)
+    t.register_cost_thunk("prefill/32", perf.jit_cost_thunk(f, (x,)))
+    [row] = t.snapshot(resolve=False)
+    assert row["peak_bytes"] is None            # pending until resolved
+    t.resolve_costs()
+    [row] = t.snapshot(resolve=False)
+    assert row["temp_bytes"] is not None and row["temp_bytes"] > 0
+    assert row["argument_bytes"] == x.nbytes
+    assert row["peak_bytes"] >= row["temp_bytes"]
+
+
+def test_candidate_hint_chunks_prefill_on_temp_spike():
+    hint = perf.candidate_hint("prefill/128", "bandwidth-bound",
+                               temp_bytes=100 * 1024 * 1024,
+                               pool_bytes=1024 * 1024)
+    assert "chunk the prefill" in hint
+    # decode families never get the prefill hint
+    hint = perf.candidate_hint("decode", "bandwidth-bound",
+                               temp_bytes=100 * 1024 * 1024,
+                               pool_bytes=1024 * 1024)
+    assert "chunk the prefill" not in (hint or "")
+
+
+# =============================================== engine integration (shared)
+def test_engine_registers_owners_and_statusz_memory(engine):
+    rows = obs_memory.ledger().owner_rows(replica=engine.replica)
+    owners = {r["owner"] for r in rows}
+    assert {"kv.pages", "model.params"} <= owners
+    kv = next(r for r in rows if r["owner"] == "kv.pages")
+    assert kv["bytes"] == sum(int(p.nbytes) for p in engine._pools)
+    assert kv["meta"]["bytes_per_page"] == engine._bytes_per_page
+    assert kv["meta"]["max_resident_slots"] == \
+        engine.block_manager.max_resident_sequences(engine.max_model_len)
+    st = engine._statusz()
+    assert st["memory"]["fixed_bytes"] > 0
+    assert st["memory"]["pool_bytes_by_dtype"] == \
+        engine.pool_bytes_by_dtype()
+    assert st["kv_cache"]["fragmentation"]["free_pages"] >= 0
+
+
+def test_hbm_budget_preflight_sheds_and_releases(engine, monkeypatch):
+    from paddle_tpu.serving import RequestRejectedError
+
+    pages_per_req = engine.block_manager.pages_for(4 + 8)
+    budget = engine._fixed_bytes + pages_per_req * engine._bytes_per_page
+    monkeypatch.setenv("PADDLE_HBM_BUDGET_BYTES", str(budget))
+    h1 = engine.submit([1, 2, 3, 4], max_new_tokens=8)
+    with pytest.raises(RequestRejectedError) as ei:
+        engine.submit([1, 2, 3, 4], max_new_tokens=8)
+    assert ei.value.reason == "hbm_budget"
+    shed = prof_metrics.get_registry().get("serving.load_shed")
+    assert shed.get(reason="hbm_budget", replica=engine.replica) >= 1
+    assert h1.result(timeout=600)               # the admitted one completes
+    # _finish released the committed pages: the next request fits again
+    h2 = engine.submit([1, 2, 3, 4], max_new_tokens=8)
+    assert h2.result(timeout=600)
+    assert engine._committed_pages == 0
+
+
+def test_scrape_bounded_under_lock_with_memory_section(engine):
+    """Satellite 3a (the PR-7 wedged-scheduler pattern): /statusz with the
+    new memory section + the memory.* gauges render in bounded time while
+    the scheduler is parked mid-iteration AND this thread holds the
+    engine's scheduler lock."""
+    srv = telemetry.serve(0)
+    release = threading.Event()
+    site = f"serving.scheduler_wedge@{engine.replica}"
+    faults.inject(site, fn=lambda: release.wait(60), at_trips={3})
+    try:
+        h = engine.submit([1, 2, 3, 4, 5], max_new_tokens=40)
+        t0 = time.time()
+        while not faults.trip_count(site) and time.time() - t0 < 120:
+            time.sleep(0.005)
+        assert faults.trip_count(site)
+        with engine._lock:                      # held by US during the scrape
+            t0 = time.time()
+            code_s, body_s = _get(srv.url + "/statusz")
+            code_m, body_m = _get(srv.url + "/metrics")
+            elapsed = time.time() - t0
+        assert code_s == 200 and code_m == 200
+        assert elapsed < 5.0, f"scrape took {elapsed:.1f}s under lock"
+        sz = json.loads(body_s)
+        mem = sz["memory"]                      # the ledger statusz section
+        assert mem["owners"][-1]["owner"] == "untracked"
+        assert any(r["owner"] == "kv.pages" for r in mem["owners"])
+        assert "memory_device_bytes" in body_m.decode()
+    finally:
+        release.set()
+        faults.clear()
+        h.cancel()
+
+
+def test_checkpoint_snapshot_host_owner(tmp_path):
+    from paddle_tpu.resilience.checkpoint import AsyncCheckpointManager
+
+    mgr = AsyncCheckpointManager(tmp_path / "ckpt")
+    rows = [r for r in obs_memory.ledger().owner_rows()
+            if r["owner"] == "checkpoint.snapshot"]
+    assert rows and rows[0]["device"] == "host"
+    mgr.save(1, {"w": paddle.to_tensor(np.zeros((8, 8), np.float32))},
+             block=True)
+    mgr.close()
+
+
+# ========================================================== metric drift guard
+def test_metric_families_match_readme_reference(engine):
+    """Satellite 5: the README metrics-reference table and the live
+    registry must agree.  Direction 1: every family the exercised code
+    exported appears in the table.  Direction 2: every table row is a
+    real family somewhere in paddle_tpu source (so deleted metrics can't
+    haunt the docs)."""
+    import re
+
+    # exercise the observability surface the engine fixture didn't
+    obs_memory.ledger().report()
+    MemoryWatchdog(led=obs_memory.ledger(), windows=100).tick()
+    readme = open(os.path.join(REPO, "README.md")).read()
+    documented = set(re.findall(r"^\| `([a-z0-9_.]+)` \|", readme,
+                                flags=re.M))
+    assert documented, "README metrics-reference table missing"
+    live = {m.name for m in prof_metrics.get_registry().metrics()}
+    missing_doc = live - documented
+    assert not missing_doc, \
+        f"exported metric families missing from README table: " \
+        f"{sorted(missing_doc)}"
+    import glob
+
+    src = "".join(open(f).read() for f in glob.glob(
+        os.path.join(REPO, "paddle_tpu", "**", "*.py"), recursive=True))
+    stale = {n for n in documented if f'"{n}"' not in src}
+    assert not stale, f"README documents nonexistent metrics: {sorted(stale)}"
+
+
+# ================================================================ slow / e2e
+@pytest.mark.slow
+def test_budget_outputs_byte_identical_to_unbudgeted(model, monkeypatch):
+    """Acceptance: admitted requests under a tight budget produce greedy
+    ids byte-identical to an unbudgeted run — pre-flight only gates
+    admission, never what admitted requests compute."""
+    from paddle_tpu.serving import RequestRejectedError, ServingEngine
+
+    prompts = [[1, 2, 3, 4], [5, 6, 7, 8], [9, 10, 11, 12]]
+    monkeypatch.delenv("PADDLE_HBM_BUDGET_BYTES", raising=False)
+    eng = ServingEngine(model, num_slots=2, page_size=PS,
+                        max_model_len=MAXLEN)
+    with eng:
+        ref = [eng.submit(p, max_new_tokens=8).result(timeout=600)
+               for p in prompts]
+
+    eng2 = ServingEngine(model, num_slots=2, page_size=PS,
+                         max_model_len=MAXLEN)
+    pages = eng2.block_manager.pages_for(4 + 8)
+    monkeypatch.setenv(
+        "PADDLE_HBM_BUDGET_BYTES",
+        str(eng2._fixed_bytes + 2 * pages * eng2._bytes_per_page))
+    got, shed = [], 0
+    with eng2:
+        for p in prompts:                      # sequential: each fits alone
+            got.append(eng2.submit(p, max_new_tokens=8).result(timeout=600))
+        # saturate: two in flight fill the budget, the third sheds
+        hs = [eng2.submit(p, max_new_tokens=8) for p in prompts[:2]]
+        try:
+            eng2.submit(prompts[2], max_new_tokens=8)
+        except RequestRejectedError as e:
+            assert e.reason == "hbm_budget"
+            shed = 1
+        for h in hs:
+            h.result(timeout=600)
+    assert got == ref
+    assert shed == 1
+
+
+@pytest.mark.slow
+def test_quantized_engine_scale_pools_ledgered(model):
+    """Satellite 2 acceptance: the int8 engine's ledger rows and
+    serving.pool_bytes series cover payload AND f32 scale pools, summing
+    to the actual pool-tuple nbytes."""
+    from paddle_tpu.serving import ServingEngine
+
+    eng = ServingEngine(model, num_slots=2, page_size=PS,
+                        max_model_len=MAXLEN, kv_dtype="int8")
+    rows = obs_memory.ledger().owner_rows(replica=eng.replica)
+    by = {r["owner"]: r["bytes"] for r in rows}
+    actual = sum(int(p.nbytes) for p in eng._pools)
+    assert by["kv.pages"] + by["kv.scales"] == actual
+    assert by["kv.scales"] > 0
+    # the gauge agrees, per dtype
+    g = prof_metrics.get_registry().get("serving.pool_bytes")
+    per_dtype = eng.pool_bytes_by_dtype()
+    assert g.get(dtype="int8", replica=eng.replica) == per_dtype["int8"]
+    assert g.get(dtype="float32", replica=eng.replica) == \
+        per_dtype["float32"]
+    assert sum(per_dtype.values()) == actual
+    # bytes_per_page already folds the scale cost in (adapter.page_bytes)
+    assert eng._bytes_per_page == eng._adapter.page_bytes()
+
+
+@pytest.mark.slow
+def test_oom_error_in_loop_dumps_forensics(model):
+    """A RESOURCE_EXHAUSTED failure on the scheduler thread writes one
+    reason="oom" flight dump with the owner table before recovery."""
+    from paddle_tpu.serving import ServingEngine
+
+    eng = ServingEngine(model, num_slots=2, page_size=PS,
+                        max_model_len=MAXLEN)
+    rec = flight_recorder.get_flight_recorder()
+    site = f"serving.step_crash@{eng.replica}"
+
+    def boom():
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 8589934592 bytes")
+
+    with eng:
+        eng.generate([1, 2, 3], max_new_tokens=2, timeout=600)
+        before = rec.last_dump_path
+        faults.inject(site, fn=boom, times=1)
+        h = eng.submit([1, 2, 3, 4], max_new_tokens=4)
+        # classify_failure sees OOM as fatal or transient; either way the
+        # dump must land.  Wait for it rather than the request.
+        t0 = time.time()
+        while rec.last_dump_path == before and time.time() - t0 < 120:
+            time.sleep(0.01)
+        assert rec.last_dump_path != before
+        doc = json.loads(open(rec.last_dump_path).read())
+        assert doc["reason"] == "oom"
+        owners = {r["owner"] for r in doc["extra"]["memory"]["owners"]}
+        assert "kv.pages" in owners
+        try:
+            h.result(timeout=600)
+        except Exception:
+            pass
+    faults.clear()

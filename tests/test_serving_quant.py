@@ -382,9 +382,17 @@ def test_pool_byte_gauges_and_statusz(model):
         bpp = eng.stats()["bytes_per_page"]
         g_tok = reg.get("serving.kv_bytes_per_token").get(replica="q0")
         assert g_tok == bpp / PS
-        g_pool = reg.get("serving.pool_bytes").get(replica="q0",
-                                                   dtype="int8")
-        assert g_pool == sum(int(p.nbytes) for p in eng._pools)
+        # one series per pool dtype: int8 payload pages and the f32
+        # scale pools are reported separately, and together they cover
+        # every live pool byte
+        by_dtype = {}
+        for p in eng._pools:
+            dt = str(p.dtype)
+            by_dtype[dt] = by_dtype.get(dt, 0) + int(p.nbytes)
+        for dt, nb in by_dtype.items():
+            assert reg.get("serving.pool_bytes").get(replica="q0",
+                                                     dtype=dt) == nb
+        assert by_dtype["float32"] > 0  # scale pools are not dropped
         sz = eng._statusz()
         assert sz["kv_cache"]["pool_dtype"] == "int8"
         assert sz["kv_cache"]["bytes_per_page"] == bpp
