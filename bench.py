@@ -860,6 +860,53 @@ def _measure_tracing_overhead(iters=30):
     return out
 
 
+def _measure_numerics_overhead(iters=30):
+    """Probes-enabled vs disabled train-step time (the < 5% enabled-path
+    contract from the numerics-observability PR): the SAME fused TrainStep
+    as the tracing arm, once as the byte-identical unprobed program and
+    once as the probed variant (per-layer stats rows + loss/grad rows +
+    the trailing nan-inject scalar) at the default cadence."""
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.observability import numerics
+
+    def timed_steps(fn, n):
+        fn()  # sync point established by caller
+        t0 = time.time()
+        for _ in range(n):
+            out = fn()
+        jax.block_until_ready(out)
+        return (time.time() - t0) / n
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(256, 512), nn.Tanh(), nn.Linear(512, 64))
+    o = opt.Momentum(learning_rate=0.01, momentum=0.9,
+                     parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss())
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(64, 256).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 64, (64,)).astype("int64"))
+
+    def train():
+        return step(x, y)._value
+
+    numerics.disable_tensor_checker()
+    float(step(x, y))  # compile the unprobed program
+    disabled = timed_steps(train, iters)
+    numerics.enable_tensor_checker(level="warn")
+    try:
+        float(step(x, y))  # compile the probed variant
+        enabled = timed_steps(train, iters)
+    finally:
+        numerics.disable_tensor_checker()
+    # flat keys: the ratchet metric lands as ``numerics.overhead_frac``
+    return {"disabled_s": disabled, "enabled_s": enabled,
+            "overhead_frac": (enabled - disabled) / max(disabled, 1e-12)}
+
+
 def _mfu_fields(flops_per_sec, peak, matmul_tflops):
     out = {"achieved_tflops": round(flops_per_sec / 1e12, 2),
            "frac_of_measured_matmul": round(
@@ -945,6 +992,8 @@ def _run_section(name):
             or None)
     if name == "tracing_overhead":
         return _measure_tracing_overhead()
+    if name == "numerics_overhead":
+        return _measure_numerics_overhead()
     if name == "chaos_smoke":
         from paddle_tpu.resilience.chaos import run_smoke
 
@@ -1214,6 +1263,16 @@ def main():
     if "--tracing-overhead" in sys.argv:
         # standalone: the tracing-enabled vs disabled step-time delta
         out = {"tracing_overhead": _section("tracing_overhead")}
+        print(json.dumps(out))
+        if "--emit-metrics" in sys.argv:
+            emit_metrics(out, out_dir=_metrics_dir_from_argv())
+        return
+
+    if "--numerics-overhead" in sys.argv:
+        # standalone: the probed-variant vs byte-identical-program
+        # train-step delta (the numerics.overhead_frac ratchet metric,
+        # gated by perf_baselines.json under --check-regressions)
+        out = {"numerics": _section("numerics_overhead")}
         print(json.dumps(out))
         if "--emit-metrics" in sys.argv:
             emit_metrics(out, out_dir=_metrics_dir_from_argv())

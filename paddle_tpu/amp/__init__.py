@@ -15,7 +15,17 @@ import jax.numpy as jnp
 
 from ..framework import dtypes as _dt
 from ..framework import state as _state
+from ..profiler import metrics as _metrics
 from ..tensor.tensor import Tensor
+
+# GradScaler state was invisible before ISSUE 13: scale as a gauge, inf
+# detections and scale decreases as counters (README metrics reference)
+_m_loss_scale = _metrics.gauge(
+    "amp.loss_scale", "current dynamic loss scale")
+_m_found_inf = _metrics.counter(
+    "amp.found_inf", "scaler update cycles that saw non-finite grads")
+_m_scale_decr = _metrics.counter(
+    "amp.scale_decr", "dynamic loss-scale decreases")
 
 WHITE_LIST = {
     "matmul", "mm", "bmm", "addmm", "conv1d", "conv2d", "conv3d", "linear",
@@ -118,8 +128,25 @@ class GradScaler:
         self._dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
         self._bad_steps = 0
-        self._found_inf = False
+        # deferred inf/nan verdict: unscale_ leaves the nonfinite count ON
+        # DEVICE; the bool resolves lazily (one host sync per update
+        # cycle, at step()/update(), never inside unscale_) so unscale_
+        # no longer blocks the dispatch queue every step
+        self._found_dev = None
+        self._found_cache = False
         self._unscaled = False
+
+    @property
+    def _found_inf(self):
+        if self._found_dev is not None:
+            self._found_cache = bool(self._found_dev > 0)
+            self._found_dev = None
+        return self._found_cache
+
+    @_found_inf.setter
+    def _found_inf(self, v):
+        self._found_dev = None
+        self._found_cache = bool(v)
 
     def is_enable(self):
         return self._enable
@@ -142,14 +169,17 @@ class GradScaler:
         if not self._enable or self._unscaled:
             return
         inv = 1.0 / self._scale
-        nonfinite = None  # accumulate on device; ONE host sync at the end
+        nonfinite = None  # accumulate on device; NO host sync here
         for p in optimizer._parameter_list:
             if p.grad is not None:
                 g = p.grad._value.astype(jnp.float32) * inv
                 cnt = jnp.sum(~jnp.isfinite(g))
                 nonfinite = cnt if nonfinite is None else nonfinite + cnt
                 p.grad._value = g.astype(p.grad.dtype) if p.grad.dtype != jnp.float32 else g
-        self._found_inf = bool(nonfinite > 0) if nonfinite is not None else False
+        # keep the count on device; the bool read folds into the update
+        # cycle (`_found_inf` property) instead of blocking every unscale_
+        self._found_dev = nonfinite
+        self._found_cache = False
         self._unscaled = True
 
     def step(self, optimizer):
@@ -166,10 +196,12 @@ class GradScaler:
             self._unscaled = False
             return
         if self._found_inf:
+            _m_found_inf.inc()
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:
                 self._scale = max(self._scale * self._decr_ratio, 1.0)
+                _m_scale_decr.inc()
                 self._bad_steps = 0
         else:
             self._good_steps += 1
@@ -177,6 +209,7 @@ class GradScaler:
             if self._good_steps >= self._incr_every:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+        _m_loss_scale.set(self._scale)
         self._unscaled = False
         self._found_inf = False
 

@@ -1,0 +1,40 @@
+"""``paddle.amp.debugging`` facade (reference: python/paddle/amp/debugging.py).
+
+The reference toolkit — ``TensorCheckerConfig`` / ``enable_tensor_checker``
+/ ``check_numerics`` / ``collect_operator_stats`` — re-exported over the
+TPU-native implementation in
+:mod:`paddle_tpu.observability.numerics`, which adds what the eager GPU
+original cannot: the same probes compile INTO jitted train-step and
+serving programs as a distinct program variant (see the README "Numerics
+observability" section).
+
+Quick use::
+
+    from paddle_tpu.amp import debugging as amp_dbg
+
+    amp_dbg.enable_tensor_checker(
+        amp_dbg.TensorCheckerConfig(level="dump", include=("decoder",)))
+    amp_dbg.check_numerics(loss, "loss")        # warn | dump | abort
+
+    with amp_dbg.collect_operator_stats(model) as col:
+        model(x)
+    print(col.report())
+"""
+
+from __future__ import annotations
+
+from ..observability.numerics import (  # noqa: F401
+    STAT_FIELDS, OperatorStatsCollector, TensorCheckerConfig,
+    check_numerics, collect_operator_stats, disable_tensor_checker,
+    enable_tensor_checker, tensor_stats,
+)
+
+# reference-spelled aliases
+enable_operator_stats_collection = collect_operator_stats
+
+__all__ = [
+    "TensorCheckerConfig", "enable_tensor_checker",
+    "disable_tensor_checker", "check_numerics", "collect_operator_stats",
+    "enable_operator_stats_collection", "OperatorStatsCollector",
+    "tensor_stats", "STAT_FIELDS",
+]

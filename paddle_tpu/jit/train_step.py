@@ -23,6 +23,7 @@ in place exactly like the reference.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import os
 import warnings
@@ -35,6 +36,7 @@ import jax.numpy as jnp
 
 from ..framework import random as _rng
 from ..framework.state import no_grad_ctx
+from ..observability import numerics as _numerics
 from ..observability import perf as _perf
 from ..observability import tracing as _tracing
 from ..optimizer.lr import LRScheduler
@@ -190,14 +192,25 @@ class TrainStep:
         leaves, treedef = jax.tree_util.tree_flatten(
             batch, is_leaf=lambda x: isinstance(x, Tensor))
         vals = [x._value if isinstance(x, Tensor) else jnp.asarray(x) for x in leaves]
+        # numerics probes enter the variant key (ISSUE 13): disabled, the
+        # token is 0 and the cached program is byte-identical to a build
+        # that never heard of probes; enabled, every cadence-th step
+        # dispatches a distinct probed variant that also returns the
+        # per-site stats table
+        ptok = _numerics.probe_token()
+        probed = bool(ptok) and \
+            self._step_count % _numerics.probe_cadence() == 0
         avals = (treedef, tuple((v.shape, str(v.dtype)) for v in vals),
-                 bool(self.model.training))
+                 bool(self.model.training), ptok if probed else 0)
         fn = self._compiled.get(avals)
         new_variant = fn is None
         if new_variant:
-            if self._compiled:
+            if self._compiled and not any(a[:3] == avals[:3]
+                                          for a in self._compiled):
                 # a second signature means every step with it pays a full
-                # XLA compile — loud by design (the #1 silent perf killer)
+                # XLA compile — loud by design (the #1 silent perf killer).
+                # A probe toggle over an EXISTING signature is intentional
+                # and stays quiet.
                 self._retrace_count += 1
                 self._m_retraces.inc()
                 warnings.warn(
@@ -207,7 +220,8 @@ class TrainStep:
                     "variant(s) already exist.  Each distinct batch "
                     "shape/dtype compiles a new XLA program — pad or bucket "
                     "batches to avoid recompilation.", stacklevel=2)
-            fn = self._build(treedef, bool(self.model.training))
+            fn = self._build(treedef, bool(self.model.training),
+                             probes=avals[3])
             fn._perf_family = f"{self._perf_tag}.v{len(self._compiled)}"
             self._compiled[avals] = fn
         # avals only, for dist_main_program re-lowering: holding the real
@@ -220,6 +234,11 @@ class TrainStep:
                      self._frozen_params, self._lr_dev, self._rng_carry)
         if self._scaler_state is not None:
             call_args += (self._scaler_state,)
+        # probed variants take one trailing f32 scalar: 0.0 normally, NaN
+        # when the numerics.nan_inject fault site tripped — the program
+        # shape never depends on whether a fault is armed
+        tail = (_numerics.consume_nan_inject(),) \
+            if getattr(fn, "_probed", False) else ()
         t_call = perf_counter()
         if self._last_call_t is not None and not new_variant:
             # steady-state wall time per step (the honest MFU denominator:
@@ -249,9 +268,9 @@ class TrainStep:
         with cm:
             if _prof_events._ACTIVE:
                 with _prof_events.record("TrainStep"):
-                    out = fn(*call_args, *vals)
+                    out = fn(*call_args, *vals, *tail)
             else:
-                out = fn(*call_args, *vals)
+                out = fn(*call_args, *vals, *tail)
         if new_variant:
             # first dispatch of a variant = trace + XLA compile (+ async
             # enqueue); record it and refresh the donation footprint
@@ -287,11 +306,23 @@ class TrainStep:
             # the next call's inter-step dt would include this compile —
             # restart the steady-state clock
             self._last_call_t = None
-        loss, self._diff_params, self._opt_state, self._buffers, outs, \
-            self._rng_carry, scaler_state = out
+        if getattr(fn, "_probed", False):
+            (loss, self._diff_params, self._opt_state, self._buffers, outs,
+             self._rng_carry, scaler_state, probe_stats) = out
+        else:
+            loss, self._diff_params, self._opt_state, self._buffers, outs, \
+                self._rng_carry, scaler_state = out
+            probe_stats = None
         if scaler_state is not None:
             self._scaler_state = scaler_state
         self._step_count += 1
+        if probe_stats is not None:
+            # device table parked for off-dispatch-path resolution (the
+            # PR-7 cost-thunk discipline); maybe_poll() throttles the one
+            # host sync + gauge export + anomaly pass
+            _numerics.submit(self._perf_tag, fn._site_box[0], probe_stats,
+                             step=self._step_count)
+            _numerics.maybe_poll()
         self._rebind()
         loss_t = Tensor(loss, stop_gradient=True)
         if self.return_outputs:
@@ -342,7 +373,9 @@ class TrainStep:
                     self._frozen_params, self._lr_dev, self._rng_carry]
             if self._scaler_state is not None:
                 args.append(self._scaler_state)
-            comp = fn._jitted.lower(*args, *vals).compile()
+            tail = [jax.ShapeDtypeStruct((), jnp.float32)] \
+                if getattr(fn, "_probed", False) else []
+            comp = fn._jitted.lower(*args, *vals, *tail).compile()
             ca = comp.cost_analysis()
             ca = ca[0] if isinstance(ca, list) else ca
             flops = float(ca.get("flops", 0.0))
@@ -358,7 +391,7 @@ class TrainStep:
             self._m_flops.set(flops)
         return out
 
-    def _build(self, treedef, training):
+    def _build(self, treedef, training, probes=0):
         model = self.model
         loss_fn = self.loss_fn
         pnames, bnames = self._pnames, self._bnames
@@ -368,6 +401,17 @@ class TrainStep:
         self_ref = self
 
         tree_box = [None]  # out-treedef recorded at trace time, per variant
+        # numerics probe plumbing (ISSUE 13): per-layer activation capture
+        # rides the nn.Layer tap inside the trace; grads and the loss get
+        # explicit rows.  Site names are recorded host-side at trace time
+        # (site_box), the stats become one extra [n_sites, 6] f32 output.
+        probes = int(probes)
+        probe_acts = bool(probes) and self.accumulate_steps == 1
+        probe_names = _numerics.layer_names(model) if probes else None
+        _pcfg = _numerics.config() if probes else None
+        inject_site = getattr(_pcfg, "nan_inject_site", None)
+        site_box = [()]   # full site order (acts + loss + grads)
+        act_box = [()]    # activation sites recorded by the capture
         use_scaler = self._scaler is not None
         if use_scaler:
             sc = self._scaler
@@ -378,6 +422,10 @@ class TrainStep:
             sc_decr_ratio = float(sc._decr_ratio)
 
         def step(diff_params, opt_state, buffers, frozen, lr, rng_carry, *rest):
+            if probes:
+                inject, rest = rest[-1], rest[:-1]
+            else:
+                inject = None
             if use_scaler:
                 (scale_in, good, bad, _), vals = rest[0], rest[1:]
             else:
@@ -398,9 +446,18 @@ class TrainStep:
 
                 was = model.training
                 model.training = training
+                cap = None
                 try:
-                    with no_grad_ctx(), _rng.rng_scope(key), \
-                            model.bind(bind_p, dict(buffers)):
+                    with contextlib.ExitStack() as _stack:
+                        if probe_acts:
+                            # per-layer stats (and the nan_inject poison
+                            # point) recorded while the traced forward runs
+                            cap = _stack.enter_context(_numerics.capture(
+                                names=probe_names, inject=inject,
+                                inject_site=inject_site))
+                        _stack.enter_context(no_grad_ctx())
+                        _stack.enter_context(_rng.rng_scope(key))
+                        _stack.enter_context(model.bind(bind_p, dict(buffers)))
                         with auto_cast(enable=amp_level is not None,
                                        level=amp_level or "O1", dtype=amp_dtype):
                             args = jax.tree_util.tree_unflatten(
@@ -430,7 +487,12 @@ class TrainStep:
                 tree_box[0] = out_tree
                 out_vals = tuple(o._value if isinstance(o, Tensor) else o
                                  for o in out_leaves)
-                return loss_v.astype(jnp.float32), (newb, out_vals)
+                if cap is not None:
+                    act_sites, act_stats = cap.stack()
+                    act_box[0] = act_sites
+                else:
+                    act_stats = None
+                return loss_v.astype(jnp.float32), (newb, out_vals, act_stats)
 
             def loss_of(dp):
                 l, aux = loss_of_with(dp, vals, buffers, key)
@@ -456,7 +518,7 @@ class TrainStep:
                     mv, mk = xs[:-1], xs[-1]
                     g_acc, l_acc, bufs_c = carry
                     def loss_micro(dp):
-                        loss_v, (nb, _o) = loss_of_with(dp, mv, bufs_c, mk)
+                        loss_v, (nb, _o, _s) = loss_of_with(dp, mv, bufs_c, mk)
                         if use_scaler:
                             loss_v = loss_v * scale_in
                         return loss_v, nb
@@ -472,9 +534,9 @@ class TrainStep:
                     body, (zeros, jnp.zeros((), jnp.float32), buffers),
                     micro_vals + (micro_keys,))
                 grads = jax.tree_util.tree_map(lambda g: g / acc, g_sum)
-                loss, outs = l_sum / acc, ()
+                loss, outs, act_stats = l_sum / acc, (), None
             else:
-                (loss, (newb, outs)), grads = jax.value_and_grad(
+                (loss, (newb, outs, act_stats)), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(diff_params)
             if use_scaler:
                 inv = 1.0 / scale_in
@@ -507,8 +569,30 @@ class TrainStep:
                 scaler_out = (scale_n, good_n, bad_n, found)
             else:
                 scaler_out = None
-            return (loss, new_p, new_s, newb, outs,
-                    (base_key, rng_counter + 1), scaler_out)
+            ret = (loss, new_p, new_s, newb, outs,
+                   (base_key, rng_counter + 1), scaler_out)
+            if not probes:
+                return ret
+            # assemble the device stats table: activation rows (capture
+            # order), the unscaled loss, then one row per grad leaf —
+            # "first offending layer" falls out of this ordering
+            sites = list(act_box[0])
+            rows = [act_stats] if (act_stats is not None and sites) else []
+            if _numerics._match("loss"):
+                sites.append("loss")
+                rows.append(_numerics.stats_row(loss)[None])
+            g_rows = []
+            for k, g in grads.items():
+                nm = "grad/" + k
+                if _numerics._match(nm):
+                    sites.append(nm)
+                    g_rows.append(_numerics.stats_row(g))
+            if g_rows:
+                rows.append(jnp.stack(g_rows))
+            site_box[0] = tuple(sites)
+            stats = jnp.concatenate(rows, axis=0) if rows \
+                else jnp.zeros((0, _numerics.NSTATS), jnp.float32)
+            return ret + (stats,)
 
         if self._donate:
             donate = (0, 1, 2, 5, 6) if use_scaler else (0, 1, 2, 5)
@@ -521,6 +605,8 @@ class TrainStep:
 
         runner._tree_box = tree_box
         runner._jitted = jitted  # exposed for lowering/inspection (profiler, tests)
+        runner._probed = bool(probes)
+        runner._site_box = site_box
         return runner
 
     # ------------------------------------------------------- multi-host SPMD
@@ -615,6 +701,9 @@ class TrainStep:
             self._scaler._scale = float(s)
             self._scaler._good_steps = int(g)
             self._scaler._bad_steps = int(b)
+            from .. import amp as _amp
+
+            _amp._m_loss_scale.set(float(s))
         return self
 
     @property

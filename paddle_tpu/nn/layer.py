@@ -24,6 +24,11 @@ from ..profiler import events as _prof_events
 from ..tensor.tensor import Parameter, Tensor
 from . import initializer as I
 
+# observability.numerics installs its per-layer stats tap here while a
+# capture region is active (same one-global-load discipline as the
+# profiler-events flag below); None means numerics probing is off
+_NUMERICS_TAP = None
+
 
 class Layer:
     def __init__(self, name_scope=None, dtype="float32"):
@@ -54,6 +59,8 @@ class Layer:
                 out = self.forward(*args, **kwargs)
         else:
             out = self.forward(*args, **kwargs)
+        if _NUMERICS_TAP is not None:
+            out = _NUMERICS_TAP(self, out)
         for hook in self._forward_post_hooks.values():
             result = hook(self, args, out)
             if result is not None:

@@ -28,7 +28,12 @@ Sites wired so far:
 - ``chaos.train_step`` — the chaos harness's train-loop site;
 - ``memory.leak`` — grows the synthetic ``fault.memory_leak`` ledger
   owner by 8 MiB per trip (:mod:`.memory`; exercised by the
-  :class:`~.memory.MemoryWatchdog` tests — no real allocation).
+  :class:`~.memory.MemoryWatchdog` tests — no real allocation);
+- ``numerics.nan_inject`` — each trip turns the next
+  :func:`paddle_tpu.observability.numerics.consume_nan_inject` call into
+  a NaN scalar that probed train-step / guarded serving programs add at
+  a configurable tensor site, driving the detect → dump → rollback loop
+  without a real numerical bug (:mod:`.numerics`).
 
 Armed faults are listed on the telemetry ``/statusz`` page
 (:func:`describe`).

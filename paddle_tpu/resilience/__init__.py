@@ -37,8 +37,8 @@ from .checkpoint import (  # noqa: F401
 from .chaos import corrupt_checkpoint, run_smoke  # noqa: F401
 from .emergency import arm_emergency_checkpoint  # noqa: F401
 from .retry import (  # noqa: F401
-    CollectiveTimeoutError, EngineStoppedError, PreemptionError, RetryPolicy,
-    TransientError, classify_failure,
+    CollectiveTimeoutError, EngineStoppedError, NumericFault, PreemptionError,
+    RetryPolicy, TransientError, classify_failure,
 )
 from .supervisor import RecoverySupervisor  # noqa: F401
 
@@ -47,6 +47,7 @@ __all__ = [
     "AsyncCheckpointManager", "CheckpointCorruptionError",
     "RecoverySupervisor", "RetryPolicy", "classify_failure",
     "TransientError", "PreemptionError", "CollectiveTimeoutError",
-    "EngineStoppedError", "arm_emergency_checkpoint", "corrupt_checkpoint",
+    "EngineStoppedError", "NumericFault", "arm_emergency_checkpoint",
+    "corrupt_checkpoint",
     "run_smoke",
 ]

@@ -47,6 +47,22 @@ class EngineStoppedError(RuntimeError):
     request still in flight (``ServingEngine.stop()`` without drain)."""
 
 
+class NumericFault(RuntimeError):
+    """Non-finite values detected by the numerics observability layer
+    (:mod:`paddle_tpu.observability.numerics`).  Neither transient nor
+    fatal: retrying the SAME step replays the NaN, but the job is
+    recoverable — supervisors classify this as ``"numeric"`` and roll
+    back to the last VALID checkpoint instead of blindly retrying or
+    surfacing it."""
+
+    def __init__(self, msg="non-finite values detected", site=None,
+                 stream=None, step=None):
+        super().__init__(msg)
+        self.site = site
+        self.stream = stream
+        self.step = step
+
+
 # substrings (lowercased) in errors from the jax/XLA runtime and the
 # coordination service that indicate the WORLD failed, not the program
 _TRANSIENT_PATTERNS = (
@@ -68,7 +84,12 @@ _TRANSIENT_TYPES = (TransientError, TimeoutError, ConnectionError,
 
 
 def classify_failure(exc) -> str:
-    """``"transient"`` (restart-worthy) or ``"fatal"`` (surface it)."""
+    """``"transient"`` (restart-worthy), ``"numeric"`` (roll back to the
+    last valid checkpoint) or ``"fatal"`` (surface it)."""
+    if isinstance(exc, NumericFault):
+        return "numeric"
+    if isinstance(exc, FloatingPointError):
+        return "numeric"
     if isinstance(exc, _TRANSIENT_TYPES):
         return "transient"
     msg = str(exc).lower()

@@ -49,12 +49,18 @@ class RecoverySupervisor:
     """
 
     def __init__(self, manager, policy=None, max_transient_restarts=5,
-                 max_fatal_restarts=0, on_restart=None, to_tensors=True):
+                 max_fatal_restarts=0, max_numeric_restarts=2,
+                 on_restart=None, to_tensors=True):
         self.manager = manager
         self.policy = policy if policy is not None \
             else RetryPolicy(base_delay=1.0, max_delay=30.0, jitter=0.5)
         self.max_transient_restarts = int(max_transient_restarts)
         self.max_fatal_restarts = int(max_fatal_restarts)
+        # NumericFault (ISSUE 13): a poisoned step is not transient (a
+        # blind retry of the same step replays the NaN) but rollback to
+        # the last VALID checkpoint usually is recoverable — its own
+        # small budget
+        self.max_numeric_restarts = int(max_numeric_restarts)
         self.on_restart = on_restart   # fn(kind, exc, attempt) — test hook
         self.to_tensors = to_tensors
         self.restarts = {"transient": 0, "fatal": 0}
@@ -80,15 +86,18 @@ class RecoverySupervisor:
                 raise
             except BaseException as e:
                 kind = classify_failure(e)
-                self.restarts[kind] += 1
-                budget = self.max_transient_restarts if kind == "transient" \
-                    else self.max_fatal_restarts
+                # "numeric" appears lazily so pre-existing call sites that
+                # compare the dict literally keep seeing {transient, fatal}
+                self.restarts[kind] = self.restarts.get(kind, 0) + 1
+                budget = {"transient": self.max_transient_restarts,
+                          "numeric": self.max_numeric_restarts,
+                          }.get(kind, self.max_fatal_restarts)
                 if self.restarts[kind] > budget:
                     logger.error(
                         "[resilience] %s failure #%d exceeds budget %d; "
                         "surfacing", kind, self.restarts[kind], budget)
                     raise
-                attempt = self.restarts["transient"] + self.restarts["fatal"]
+                attempt = sum(self.restarts.values())
                 delay = self.policy.delay(attempt)
                 self._m_restarts.inc(kind=kind, supervisor="recovery")
                 self._m_backoff.observe(delay)

@@ -84,13 +84,16 @@ import time
 import weakref
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..observability import faults as _faults
 from ..observability import memory as _obs_memory
+from ..observability import numerics as _numerics
 from ..observability import perf as _perf
 from ..observability import tracing as _tracing
-from ..resilience.retry import EngineStoppedError, classify_failure  # noqa: F401 — re-exported
+from ..resilience.retry import (EngineStoppedError, NumericFault,  # noqa: F401 — re-exported
+                                classify_failure)
 from .adapter import GPTAdapter
 from .block_manager import BlockManager
 
@@ -208,7 +211,10 @@ class RequestHandle:
             raise TimeoutError(
                 f"request {self.request_id} not finished after {timeout}s")
         if self._error is not None:
-            if isinstance(self._error, EngineStoppedError):
+            # EngineStoppedError / NumericFault are per-request verdicts
+            # (stopped mid-flight; this row's logits went non-finite) —
+            # surface them as-is, not as an engine-wide failure
+            if isinstance(self._error, (EngineStoppedError, NumericFault)):
                 raise self._error
             raise RuntimeError("serving engine failed") from self._error
         if self.mode != "generate":
@@ -226,7 +232,8 @@ class RequestHandle:
                 else:
                     break
             if self._error is not None:
-                if isinstance(self._error, EngineStoppedError):
+                if isinstance(self._error,
+                              (EngineStoppedError, NumericFault)):
                     raise self._error
                 raise RuntimeError("serving engine failed") from self._error
         finally:
@@ -280,7 +287,7 @@ class ServingEngine:
                  degraded_stall_s=2.0, restart_cooldown_s=10.0,
                  speculative_k=0, draft_max_ngram=3, draft_min_ngram=1,
                  replica="0", device=None, health_gating=True, slo=None,
-                 kv_dtype=None, weight_dtype=None):
+                 kv_dtype=None, weight_dtype=None, numeric_guard=None):
         self._model = model
         # quantized serving (serving/quant, README "Quantized serving"):
         # kv_dtype="int8" stores the paged KV pools as int8 with parallel
@@ -364,10 +371,24 @@ class ServingEngine:
             self._params = jax.device_put(self._params, device)
             self._bufs = jax.device_put(self._bufs, device)
             self._pools = jax.device_put(self._pools, device)
-        from ..text.models._decode import make_batched_sampler
+        from ..text.models._decode import (make_batched_sampler,
+                                           make_guarded_batched_sampler)
 
         self._sampler = make_batched_sampler(top_k, top_p)
         self._top = (int(top_k), float(top_p))
+        # NaN-safe serving (README "Numerics observability"): the guarded
+        # program variant returns a per-row non-finite-logits flag (and a
+        # logits stats row for the numerics stream) next to the sampled
+        # tokens; the scheduler fails exactly the flagged requests with
+        # status="error" / NumericFault while finite rows' token math is
+        # untouched (the guard wraps the SAME sampler, so greedy output
+        # stays byte-identical).  Off — the default, unless the active
+        # TensorCheckerConfig asks for serving_guard — every program is
+        # the pre-guard one: byte-identical keys, traces and dispatches.
+        self._numeric_guard = bool(_numerics.serving_guard_default()
+                                   if numeric_guard is None
+                                   else numeric_guard)
+        self._guard_sampler = make_guarded_batched_sampler(top_k, top_p)
         self._base_key = jax.random.key(int(seed))
         self._key_counter = itertools.count()
         self._rid_counter = itertools.count()
@@ -528,6 +549,19 @@ class ServingEngine:
             "speculative acceptance: spec_accepted / spec_proposed")
         self._m_verify_traces = _c(
             "serving.verify_traces", "verify-step program traces")
+        # numerics observability (ISSUE 13): requests retired because the
+        # guarded program flagged their logits row non-finite, plus a
+        # sampled weight dequant->requant drift gauge for quant engines
+        self._m_numeric_faults = _c(
+            "serving.numeric_faults",
+            "requests failed on non-finite logits (guarded programs)")
+        self._m_quant_drift = _g(
+            "serving.quant_drift",
+            "sampled int8 weight dequant->requant roundtrip error "
+            "(relative, one layer per tick)")
+        self._drift_idx = 0
+        self._drift_t = 0.0
+        self._npoll_t = 0.0
         # quantized-serving occupancy gauges: bytes one token position
         # costs in the KV pools (layers x K+V, scale pools included) and
         # the allocated pool HBM, labelled by pool dtype
@@ -1016,19 +1050,41 @@ class ServingEngine:
             ent = store[key] = build()
         return ent
 
+    def _guard_key(self):
+        """Program-store key component for the numeric-guard variant.
+        Empty when the guard is off so the unguarded keys — and therefore
+        the cached programs and their trace counters — stay byte-for-byte
+        what they were before the guard existed."""
+        return ("nguard",) if self._numeric_guard else ()
+
     def _step_program(self):
         key = ("serve_step", self.num_slots, self.table_width,
-               self._pools[0].shape, str(self._pools[0].dtype), self._top)
+               self._pools[0].shape, str(self._pools[0].dtype),
+               self._top) + self._guard_key()
         n = len(self._pools)  # pools are DONATED; count is adapter-defined
 
         def build():
             traces = [0]
             adapter, sampler = self._adapter, self._sampler
+            guard, gsampler = self._numeric_guard, self._guard_sampler
+            low = _numerics.low_dtype()
 
             @functools.partial(jax.jit,
                                donate_argnums=tuple(range(3, 3 + n)))
             def step(params, bufs, last, *rest):
                 traces[0] += 1  # python side effect: runs at TRACE time only
+                if guard:
+                    # trailing [B] f32 inject vector (zeros disarmed, NaN
+                    # in one lane when numerics.nan_inject trips) keeps
+                    # the program shape independent of fault arming
+                    pools, (table, lens, temps, rkey, inj) = \
+                        rest[:n], rest[n:]
+                    out = adapter.step(params, bufs, last, *pools, table,
+                                       lens)
+                    logits = out[0] + inj[:, None]
+                    tok, bad = gsampler(logits, temps, rkey)
+                    stats = _numerics.stats_row(logits, low)[None]
+                    return (tok, bad, stats) + tuple(out[1:])
                 pools, (table, lens, temps, rkey) = rest[:n], rest[n:]
                 out = adapter.step(params, bufs, last, *pools, table, lens)
                 return (sampler(out[0], temps, rkey),) + tuple(out[1:])
@@ -1044,17 +1100,31 @@ class ServingEngine:
         exactly like the plain decode step."""
         k_pad = self._spec_k
         key = ("verify", k_pad, self.num_slots, self.table_width,
-               self._pools[0].shape, str(self._pools[0].dtype), self._top)
+               self._pools[0].shape, str(self._pools[0].dtype),
+               self._top) + self._guard_key()
         n = len(self._pools)
 
         def build():
             traces = [0]
             adapter, verifier = self._adapter, self._verifier
+            guard = self._numeric_guard
+            low = _numerics.low_dtype()
 
             @functools.partial(jax.jit,
                                donate_argnums=tuple(range(3, 3 + n)))
             def verify(params, bufs, ids, *rest):
                 traces[0] += 1
+                if guard:
+                    pools, (table, lens, dlen, temps, rkey, inj) = \
+                        rest[:n], rest[n:]
+                    out = adapter.verify(params, bufs, ids, *pools, table,
+                                         lens)
+                    logits = out[0] + inj[:, None, None]
+                    targets, accept = verifier(logits, ids[:, 1:], dlen,
+                                               temps, rkey)
+                    bad = ~jnp.all(jnp.isfinite(logits), axis=(-2, -1))
+                    stats = _numerics.stats_row(logits, low)[None]
+                    return (targets, accept, bad, stats) + tuple(out[1:])
                 pools, (table, lens, dlen, temps, rkey) = rest[:n], rest[n:]
                 out = adapter.verify(params, bufs, ids, *pools, table, lens)
                 targets, accept = verifier(out[0], ids[:, 1:], dlen, temps,
@@ -1082,17 +1152,29 @@ class ServingEngine:
 
     def _prefill_program(self, s_pad):
         key = ("serve_prefill", s_pad, self.table_width,
-               self._pools[0].shape, str(self._pools[0].dtype), self._top)
+               self._pools[0].shape, str(self._pools[0].dtype),
+               self._top) + self._guard_key()
         n = len(self._pools)
 
         def build():
             traces = [0]
             adapter, sampler = self._adapter, self._sampler
+            guard, gsampler = self._numeric_guard, self._guard_sampler
+            low = _numerics.low_dtype()
 
             @functools.partial(jax.jit,
                                donate_argnums=tuple(range(3, 3 + n)))
             def prefill(params, bufs, ids, *rest):
                 traces[0] += 1
+                if guard:
+                    pools, (table, lens, temps, rkey, inj) = \
+                        rest[:n], rest[n:]
+                    out = adapter.prefill(params, bufs, ids, *pools, table,
+                                          lens)
+                    logits = out[0] + inj[:, None]
+                    tok, bad = gsampler(logits, temps, rkey)
+                    stats = _numerics.stats_row(logits, low)[None]
+                    return (tok, bad, stats) + tuple(out[1:])
                 pools, (table, lens, temps, rkey) = rest[:n], rest[n:]
                 out = adapter.prefill(params, bufs, ids, *pools, table, lens)
                 return (sampler(out[0], temps, rkey),) + tuple(out[1:])
@@ -1332,26 +1414,34 @@ class ServingEngine:
         n0 = traces[0]
         rkey = self._next_key()
         extra = self._prefill_extra(req)
+        guard = self._numeric_guard
+        tail = (self._numeric_inject(1),) if guard else ()
         fam = self._prefill_family(s_pad)
         if _perf.needs_cost(fam):
             # capture arg shapes ONCE per family; the cost_analysis
             # re-lower+compile itself runs lazily, off this thread
             _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
                 prog, (self._params, self._bufs, ids, *self._pools,
-                       table, lens, temps, rkey, *extra)))
+                       table, lens, temps, rkey, *extra, *tail)))
         # first dispatch of this program = minutes-long XLA compile: flag it
         # so the serving watchdog doesn't read a legitimate compile stall
         # as a wedged scheduler
         self._compiling = n0 == 0
         t0 = time.perf_counter()
+        bad = nstats = None
         try:
             with _tracing.span("serving.prefill",
                                trace_id=req.handle.trace_id,
                                request_id=req.handle.request_id,
                                slot=slot_idx, prompt_len=S0):
-                tok, *pools = prog(self._params, self._bufs, ids,
-                                   *self._pools, table, lens, temps, rkey,
-                                   *extra)
+                if guard:
+                    tok, bad, nstats, *pools = prog(
+                        self._params, self._bufs, ids, *self._pools,
+                        table, lens, temps, rkey, *extra, *tail)
+                else:
+                    tok, *pools = prog(self._params, self._bufs, ids,
+                                       *self._pools, table, lens, temps,
+                                       rkey, *extra)
                 self._pools = tuple(pools)
                 tok = int(np.asarray(tok)[0])
         finally:
@@ -1364,6 +1454,22 @@ class ServingEngine:
             # family (a trace+compile wall is not device time — skipped)
             _perf.record(fam, time.perf_counter() - t0)
         self._m_prefill_seconds.observe(time.perf_counter() - t0)
+        if guard:
+            _numerics.submit(f"serving/{self.replica}", ("logits",), nstats,
+                             step=self._iteration)
+            if bool(np.asarray(bad)[0]):
+                # non-finite first-token logits: fail THIS request before
+                # it ever occupies a decode lane; nothing else is touched
+                h = req.handle
+                h._error = NumericFault(
+                    "non-finite logits at prefill", site="logits",
+                    stream=f"serving/{self.replica}", step=self._iteration)
+                self._m_numeric_faults.inc()
+                self._bm.free(alloc)
+                self._release_tenant(req)
+                self._admitting = None
+                self._finish(h, "error")
+                return
         slot = _Slot(req, alloc, table_row)
         slot.idx = slot_idx
         slot.last = tok
@@ -1454,17 +1560,75 @@ class ServingEngine:
         (MultiTenantEngine)."""
         return "completed"
 
+    # ------------------------------------------------ NaN-safe serving
+    def _numeric_inject(self, B=None):
+        """Trailing ``[B] f32`` inject vector for guarded dispatches: all
+        zeros disarmed (the shape-stable no-op — the program adds it to
+        the logits), NaN in lane :func:`~.numerics.nan_inject_row` when
+        the ``numerics.nan_inject`` fault tripped since the last call."""
+        if B is None:
+            B = self.num_slots
+        inj = np.zeros((B,), np.float32)
+        v = _numerics.consume_nan_inject()
+        if not np.isfinite(v):
+            inj[_numerics.nan_inject_row() % B] = v
+        return inj
+
+    def _fail_numeric(self, i):
+        """Retire decode lane ``i`` with a numeric fault: exactly this
+        request errors (``status="error"``, ``handle._error`` a
+        :class:`NumericFault`), its pages free and the lane backfills at
+        the next admit — the batch's other rows are untouched."""
+        slot = self._slots[i]
+        h = slot.handle
+        h._error = NumericFault(
+            f"non-finite logits in decode lane {i}", site="logits",
+            stream=f"serving/{self.replica}", step=self._iteration)
+        self._m_numeric_faults.inc()
+        self._bm.free(slot.alloc)
+        self._release_tenant(slot.req)
+        self._slots[i] = None
+        self._clear_slot_row(i, slot)
+        self._finish(h, "error")
+
+    def _quant_drift_tick(self):
+        """Sampled quantization-drift gauge (quant engines): one
+        Int8Linear per tick, dequantize its stored payload and measure
+        the requantize-on-fresh-absmax roundtrip error — drift above the
+        rounding floor means the frozen ``w_scale`` no longer matches
+        the weights it quantized."""
+        from ..quantization import Int8Linear
+
+        layers = [m for m in self._model.sublayers()
+                  if isinstance(m, Int8Linear)]
+        if not layers:
+            return
+        m = layers[self._drift_idx % len(layers)]
+        self._drift_idx += 1
+        q = np.asarray(m.weight_int8._value, np.float32)
+        w = q * m.w_scale
+        amax = float(np.abs(w).max())
+        if amax <= 0.0:
+            self._m_quant_drift.set(0.0)
+            return
+        s2 = amax / m._qmax
+        q2 = np.clip(np.rint(w / s2), -m._qmax, m._qmax)
+        drift = float(np.mean(np.abs(q2 * s2 - w))) / amax
+        self._m_quant_drift.set(drift)
+
     def _plain_step(self, active):
         prog, traces = self._step_program()
         n0 = traces[0]
         rkey = self._step_key()
         extra = self._step_extra()
+        guard = self._numeric_guard
+        tail = (self._numeric_inject(),) if guard else ()
         fam = self._decode_family()
         if _perf.needs_cost(fam):
             _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
                 prog, (self._params, self._bufs, self._h_last, *self._pools,
                        self._h_table, self._h_lens, self._h_temps, rkey,
-                       *extra)))
+                       *extra, *tail)))
         if _tracing._ACTIVE:
             # one span per batched iteration, LINKING every active
             # request's trace id (a decode step serves many traces at once
@@ -1477,11 +1641,19 @@ class ServingEngine:
             cm = _tracing.NOOP
         self._compiling = n0 == 0  # first decode dispatch = XLA compile
         t0 = time.perf_counter()
+        bad = nstats = None
         try:
             with cm:
-                tok, *pools = prog(self._params, self._bufs, self._h_last,
-                                   *self._pools, self._h_table, self._h_lens,
-                                   self._h_temps, rkey, *extra)
+                if guard:
+                    tok, bad, nstats, *pools = prog(
+                        self._params, self._bufs, self._h_last,
+                        *self._pools, self._h_table, self._h_lens,
+                        self._h_temps, rkey, *extra, *tail)
+                else:
+                    tok, *pools = prog(self._params, self._bufs,
+                                       self._h_last, *self._pools,
+                                       self._h_table, self._h_lens,
+                                       self._h_temps, rkey, *extra)
                 self._pools = tuple(pools)
                 tok = np.asarray(tok)
         finally:
@@ -1493,7 +1665,16 @@ class ServingEngine:
             _perf.record(fam, time.perf_counter() - t0)
         self._m_step_seconds.observe(time.perf_counter() - t0)
         self._iteration += 1
+        if guard:
+            _numerics.submit(f"serving/{self.replica}", ("logits",), nstats,
+                             step=self._iteration)
+            bad = np.asarray(bad)
         for i in active:
+            if guard and bad[i]:
+                # this lane's logits went non-finite: fail exactly this
+                # request; finite lanes below emit byte-identical tokens
+                self._fail_numeric(i)
+                continue
             s = self._slots[i]
             s.length += 1
             s.produced += 1
@@ -1539,12 +1720,14 @@ class ServingEngine:
         n0 = traces[0]
         rkey = self._step_key()
         extra = self._verify_extra(active)
+        guard = self._numeric_guard
+        tail = (self._numeric_inject(),) if guard else ()
         fam = self._verify_family()
         if _perf.needs_cost(fam):
             _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
                 prog, (self._params, self._bufs, self._h_ids, *self._pools,
                        self._h_table, self._h_lens, self._h_dlen,
-                       self._h_temps, rkey, *extra)))
+                       self._h_temps, rkey, *extra, *tail)))
         if _tracing._ACTIVE:
             cm = _tracing.span(
                 "serving.verify_step", iteration=self._iteration,
@@ -1555,12 +1738,19 @@ class ServingEngine:
             cm = _tracing.NOOP
         self._compiling = n0 == 0
         t0 = time.perf_counter()
+        bad = nstats = None
         try:
             with cm:
-                targets, accept, *pools = prog(
-                    self._params, self._bufs, self._h_ids, *self._pools,
-                    self._h_table, self._h_lens, self._h_dlen,
-                    self._h_temps, rkey, *extra)
+                if guard:
+                    targets, accept, bad, nstats, *pools = prog(
+                        self._params, self._bufs, self._h_ids, *self._pools,
+                        self._h_table, self._h_lens, self._h_dlen,
+                        self._h_temps, rkey, *extra, *tail)
+                else:
+                    targets, accept, *pools = prog(
+                        self._params, self._bufs, self._h_ids, *self._pools,
+                        self._h_table, self._h_lens, self._h_dlen,
+                        self._h_temps, rkey, *extra)
                 self._pools = tuple(pools)
                 targets = np.asarray(targets)
                 accept = np.asarray(accept)
@@ -1573,8 +1763,15 @@ class ServingEngine:
             _perf.record(fam, time.perf_counter() - t0)
         self._m_step_seconds.observe(time.perf_counter() - t0)
         self._iteration += 1
+        if guard:
+            _numerics.submit(f"serving/{self.replica}", ("logits",), nstats,
+                             step=self._iteration)
+            bad = np.asarray(bad)
         proposed = accepted = 0
         for i in active:
+            if guard and bad[i]:
+                self._fail_numeric(i)
+                continue
             s = self._slots[i]
             d = drafts[i]
             a = 0
@@ -1719,6 +1916,18 @@ class ServingEngine:
         self._m_page_util.set(self._bm.utilization())
         self._m_pages_used.set(self._bm.used_pages)
         self._m_health.set(_HEALTH_CODE.get(self.health, 1))
+        if self.weight_dtype == "int8" and now - self._drift_t > 5.0:
+            # quant drift is a slow dashboard (host-side weight walk):
+            # one sampled layer every few seconds, never per step
+            self._drift_t = now
+            self._quant_drift_tick()
+        if self._numeric_guard and now - self._npoll_t > 0.5:
+            # resolve THIS replica's pending numerics table (one small
+            # device sync) so the numerics.* gauges and /statusz stay
+            # fresh; never raising — per-row failure is the guard's job,
+            # an abort-level checker must not kill the scheduler thread
+            self._npoll_t = now
+            _numerics.poll(f"serving/{self.replica}", raise_on_fault=False)
 
     # --------------------------------------------------------------- health
     def health_state(self):
@@ -1792,6 +2001,7 @@ class ServingEngine:
             "pool_dtype": self._pool_dtype,
             "bytes_per_page": self._bytes_per_page,
             "kv_bytes_per_token": self._bytes_per_page / self.page_size,
+            "numeric_guard": self._numeric_guard,
         }
         if self._spec_k:
             st["speculative"] = {

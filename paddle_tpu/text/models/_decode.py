@@ -82,6 +82,24 @@ def make_batched_sampler(top_k=0, top_p=1.0):
     return sample
 
 
+def make_guarded_batched_sampler(top_k=0, top_p=1.0):
+    """NaN-safe twin of :func:`make_batched_sampler` for the serving
+    engine's numeric-guard program variant: returns ``(tokens, bad)``
+    where ``bad [B] bool`` flags rows whose logits contain ANY non-finite
+    value.  The token math is untouched — the flag is a pure extra
+    reduction over the same logits, so every finite row's greedy/sampled
+    token is byte-identical to the unguarded sampler's — which is what
+    lets the engine fail exactly the poisoned requests while the rest of
+    the batch streams on."""
+    inner = make_batched_sampler(top_k, top_p)
+
+    def sample(logits, temps, key):
+        bad = ~jnp.all(jnp.isfinite(logits), axis=-1)
+        return inner(logits, temps, key), bad
+
+    return sample
+
+
 def make_masked_batched_sampler(top_k=0, top_p=1.0):
     """Constrained-decoding twin of :func:`make_batched_sampler`: the
     multi-tenant engine's per-row token-FSM masks (``allowed [B, V]``

@@ -1,0 +1,573 @@
+"""Numerics observability (ISSUE 13): in-program tensor probes, the
+TensorCheckerConfig-shaped checker API, nan-inject forensics (one flight
+dump per episode naming the first offending layer), NaN-safe serving and
+the GradScaler state export.
+
+Suite marker: ``num``.  Heavy end-to-end runs (fresh TrainStep per
+supervisor attempt) are also marked ``slow``; the serving tests share TWO
+module-scoped tiny engines (guarded / unguarded) so tier-1 pays the
+compile once.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import amp
+from paddle_tpu.observability import (
+    faults, flight_recorder, numerics, telemetry,
+)
+from paddle_tpu.profiler import metrics as prof_metrics
+from paddle_tpu.resilience import RecoverySupervisor
+from paddle_tpu.resilience.checkpoint import AsyncCheckpointManager
+from paddle_tpu.resilience.retry import (
+    NumericFault, RetryPolicy, classify_failure,
+)
+
+pytestmark = pytest.mark.num
+
+MAXLEN = 64
+PS = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_numerics_state(tmp_path):
+    """Fresh checker/fault/flight state per test; the module-scoped
+    engines keep their compiled programs."""
+    faults.clear()
+    numerics.reset()
+    rec = flight_recorder.get_flight_recorder()
+    old_dir, old_last = rec.dir, rec.last_dump_path
+    rec.dir = str(tmp_path / "flight")
+    yield
+    rec.dir, rec.last_dump_path = old_dir, old_last
+    faults.clear()
+    numerics.reset()
+    telemetry.shutdown()
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    from paddle_tpu.text.models.gpt import GPTForCausalLM
+
+    return GPTForCausalLM(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                          num_attention_heads=2,
+                          max_position_embeddings=MAXLEN).eval()
+
+
+@pytest.fixture(scope="module")
+def plain_engine(model):
+    from paddle_tpu.serving import ServingEngine
+
+    eng = ServingEngine(model, num_slots=2, page_size=PS,
+                        max_model_len=MAXLEN, numeric_guard=False)
+    with eng:
+        eng.generate([1, 2, 3, 4], max_new_tokens=4, timeout=600)  # compile
+        yield eng
+
+
+@pytest.fixture(scope="module")
+def guarded_engine(model):
+    from paddle_tpu.serving import ServingEngine
+
+    eng = ServingEngine(model, num_slots=2, page_size=PS,
+                        max_model_len=MAXLEN, numeric_guard=True)
+    with eng:
+        eng.generate([1, 2, 3, 4], max_new_tokens=4, timeout=600)  # compile
+        yield eng
+
+
+def _tiny_step(b=8, din=8, ncls=4):
+    paddle.seed(7)
+    m = nn.Sequential(nn.Linear(din, 16), nn.ReLU(), nn.Linear(16, ncls))
+    o = opt.AdamW(learning_rate=1e-2, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(b, din).astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, ncls, (b,)).astype("int64"))
+    return step, x, y
+
+
+def _numeric_dumps():
+    d = flight_recorder.get_flight_recorder().dir
+    return sorted(glob.glob(os.path.join(d, "flight_pid*_numerics_*.json")))
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# =============================================================== probe math
+def test_stats_row_probe_math():
+    x = np.array([1.0, -2.0, 0.0, np.nan, np.inf, 4.0], np.float32)
+    s = numerics.tensor_stats(x)
+    assert set(s) == set(numerics.STAT_FIELDS)
+    assert s["nonfinite"] == 2.0
+    assert s["absmax"] == 4.0                       # finite values only
+    assert s["rms"] == pytest.approx(np.sqrt(21.0 / 6.0), rel=1e-6)
+    assert s["zero_frac"] == pytest.approx(0.5)     # true zero + masked nonfinite
+    assert s["underflow_frac"] == 0.0
+    assert s["overflow_frac"] == pytest.approx(2.0 / 6.0)
+    # clean tensor: all-zero anomaly channels
+    c = numerics.tensor_stats(np.ones((4,), np.float32))
+    assert c["nonfinite"] == 0.0 and c["zero_frac"] == 0.0
+    assert c["rms"] == pytest.approx(1.0)
+
+
+def test_stats_row_low_dtype_fracs():
+    # f32 subnormals flush to zero on the CPU backend, so the under/overflow
+    # channels are exercised against the fp16 normal range
+    x = np.array([1e-6, 1.0, 1e5], np.float32)
+    s = numerics.tensor_stats(x, low_dtype="float16")
+    assert s["underflow_frac"] == pytest.approx(1.0 / 3.0)
+    assert s["overflow_frac"] == pytest.approx(1.0 / 3.0)
+    assert s["absmax"] == pytest.approx(1e5)
+    # bf16 shares f32's exponent range: the same values are in-range
+    s2 = numerics.tensor_stats(x, low_dtype="bfloat16")
+    assert s2["underflow_frac"] == 0.0 and s2["overflow_frac"] == 0.0
+
+
+def test_tensor_checker_config_validation_and_filters():
+    with pytest.raises(ValueError):
+        numerics.TensorCheckerConfig(level="loud")
+    assert numerics.TensorCheckerConfig(cadence=0).cadence == 1
+    cfg = numerics.TensorCheckerConfig(include="decoder", exclude=("embed",))
+    assert cfg.include == ("decoder",)
+    assert cfg.match("decoder.layer0")
+    assert not cfg.match("decoder.embed")     # exclude beats include
+    assert not cfg.match("encoder.layer0")    # not in include
+    assert numerics.TensorCheckerConfig().match("anything")
+
+
+def test_probe_token_and_config_defaults():
+    assert numerics.probe_token() == 0
+    assert numerics.level() == "warn"
+    assert not numerics.serving_guard_default()
+    cfg = numerics.enable_tensor_checker(level="dump", cadence=3,
+                                         low_dtype="float16",
+                                         serving_guard=True)
+    t1 = numerics.probe_token()
+    assert t1 != 0
+    assert numerics.probe_cadence() == 3
+    assert numerics.low_dtype() == "float16"
+    assert numerics.serving_guard_default()
+    assert numerics.config() is cfg
+    numerics.disable_tensor_checker()
+    assert numerics.probe_token() == 0
+    # each enable is a fresh variant key: stale probed programs never alias
+    numerics.enable_tensor_checker(level="warn")
+    assert numerics.probe_token() not in (0, t1)
+
+
+# ================================================================ eager API
+def test_check_numerics_warn_level_counts():
+    c0 = prof_metrics.counter("numerics.checks").get() or 0
+    x = paddle.to_tensor(np.array([np.nan, 1.0], np.float32))
+    with pytest.warns(RuntimeWarning, match="nonfinite"):
+        s = numerics.check_numerics(x, "probe")
+    assert s["nonfinite"] == 1.0
+    assert (prof_metrics.counter("numerics.checks").get() or 0) == c0 + 1
+    # clean tensor: no warning, no counter
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        numerics.check_numerics(paddle.to_tensor(np.ones((2,), np.float32)))
+    assert (prof_metrics.counter("numerics.checks").get() or 0) == c0 + 1
+
+
+def test_check_numerics_abort_raises_numeric():
+    numerics.enable_tensor_checker(level="abort")
+    x = np.array([np.inf], np.float32)
+    with pytest.raises(FloatingPointError) as ei:
+        numerics.check_numerics(x, "logits")
+    # aborts classify as "numeric": the supervisor rolls back instead of
+    # blindly retrying the poisoned step
+    assert classify_failure(ei.value) == "numeric"
+    assert classify_failure(NumericFault("nan", site="0")) == "numeric"
+
+
+def test_check_numerics_dump_once_per_episode():
+    numerics.enable_tensor_checker(level="dump")
+    bad = np.array([np.nan, np.nan], np.float32)
+    numerics.check_numerics(bad, "act")
+    assert len(_numeric_dumps()) == 1
+    numerics.check_numerics(bad, "act")          # same episode: no new dump
+    assert len(_numeric_dumps()) == 1
+    numerics.check_numerics(np.ones((2,), np.float32), "act")  # re-arms
+    numerics.check_numerics(bad, "act")
+    assert len(_numeric_dumps()) == 2
+    doc = json.load(open(_numeric_dumps()[0]))
+    assert doc["reason"] == "numerics"
+    assert doc["extra"]["kind"] == "nonfinite"
+    assert doc["extra"]["site"] == "act"
+    assert doc["extra"]["stats"][0]["nonfinite"] == 2.0
+
+
+def test_collect_operator_stats_eager():
+    m = nn.Sequential(nn.Linear(4, 8), nn.Tanh())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    with numerics.collect_operator_stats(model=m) as col:
+        m(x)
+    s = col.summary()
+    assert "0" in s and "1" in s                 # per-sublayer sites
+    assert set(s["0"]) == set(numerics.STAT_FIELDS)
+    assert s["1"]["absmax"] <= 1.0               # tanh range
+    rep = col.report()
+    assert rep.splitlines()[0].startswith("site")
+    assert "absmax" in rep
+    # non-finite layer outputs are checked on exit at the active level
+    xn = paddle.to_tensor(np.full((2, 4), np.nan, np.float32))
+    with pytest.warns(RuntimeWarning):
+        with numerics.collect_operator_stats(model=m):
+            m(xn)
+
+
+def test_amp_debugging_facade():
+    from paddle_tpu.amp import debugging as dbg
+
+    assert dbg.TensorCheckerConfig is numerics.TensorCheckerConfig
+    assert dbg.enable_tensor_checker is numerics.enable_tensor_checker
+    assert dbg.check_numerics is numerics.check_numerics
+    assert dbg.collect_operator_stats is numerics.collect_operator_stats
+    assert dbg.enable_operator_stats_collection is numerics.collect_operator_stats
+
+
+# =============================================================== GradScaler
+def test_grad_scaler_deferred_sync_and_metrics():
+    paddle.seed(1)
+    m = nn.Linear(4, 2)
+    o = opt.Momentum(learning_rate=0.1, parameters=m.parameters())
+    sc = amp.GradScaler(init_loss_scaling=8.0, incr_every_n_steps=100)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    sc.scale(m(x).sum()).backward()
+    p0 = o._parameter_list[0]
+    p0.grad._value = jnp.full(p0.grad._value.shape, jnp.inf,
+                              p0.grad._value.dtype)
+    f0 = prof_metrics.counter("amp.found_inf").get() or 0
+    d0 = prof_metrics.counter("amp.scale_decr").get() or 0
+    w0 = np.asarray(m.weight._value).copy()
+    sc.unscale_(o)
+    # satellite (b): the verdict stays ON DEVICE — no host sync in unscale_
+    assert sc._found_dev is not None
+    sc.step(o)                                   # resolves once, skips update
+    assert np.array_equal(np.asarray(m.weight._value), w0)
+    sc.update()
+    assert sc._scale == 4.0
+    assert (prof_metrics.counter("amp.found_inf").get() or 0) == f0 + 1
+    assert (prof_metrics.counter("amp.scale_decr").get() or 0) == d0 + 1
+    assert prof_metrics.gauge("amp.loss_scale").get() == 4.0
+
+
+def test_grad_scaler_scale_trajectory():
+    paddle.seed(2)
+    m = nn.Linear(4, 2)
+    o = opt.Momentum(learning_rate=0.01, parameters=m.parameters())
+    sc = amp.GradScaler(init_loss_scaling=8.0, incr_ratio=2.0, decr_ratio=0.5,
+                        incr_every_n_steps=2, decr_every_n_nan_or_inf=1)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+
+    def cycle(poison=False):
+        o.clear_grad()
+        sc.scale(m(x).sum()).backward()
+        if poison:
+            p0 = o._parameter_list[0]
+            p0.grad._value = jnp.full(p0.grad._value.shape, jnp.nan,
+                                      p0.grad._value.dtype)
+        sc.step(o)
+        sc.update()
+
+    cycle(); assert sc._scale == 8.0             # good_steps=1
+    cycle(); assert sc._scale == 16.0            # incr_every=2 reached
+    cycle(poison=True); assert sc._scale == 8.0  # decr_every=1
+    cycle(); cycle(); assert sc._scale == 16.0   # recovers
+    assert prof_metrics.gauge("amp.loss_scale").get() == 16.0
+
+
+# ============================================================ TrainStep probes
+def test_trainstep_probe_byte_identity_and_stats():
+    reg = prof_metrics.get_registry()
+
+    def total(name):
+        mtr = reg.get(name)
+        return mtr.total() if mtr else 0.0
+
+    step, x, y = _tiny_step()
+    c0, r0 = total("train_step.compiles"), total("train_step.retraces")
+    float(step(x, y))
+    float(step(x, y))
+    assert total("train_step.compiles") == c0 + 1   # one unprobed program
+
+    numerics.enable_tensor_checker(level="warn")
+    float(step(x, y))                               # distinct probed variant
+    assert total("train_step.compiles") == c0 + 2
+    assert total("train_step.retraces") == r0       # probe toggle stays quiet
+    numerics.poll()
+    ent = numerics.latest(step._perf_tag)
+    assert ent is not None
+    sites = ent["sites"]
+    assert "0" in sites and "loss" in sites          # first layer + loss rows
+    assert any(s.startswith("grad/") for s in sites)
+    assert ent["table"].shape == (len(sites), numerics.NSTATS)
+    assert prof_metrics.gauge("numerics.rms").get(
+        site=step._perf_tag, tensor="loss") is not None
+    assert prof_metrics.gauge("numerics.nonfinite").get(
+        site=step._perf_tag, tensor="0") == 0.0
+
+    # disabled: the ORIGINAL program is reused — byte-identical variant key,
+    # no new compile, no retrace
+    numerics.disable_tensor_checker()
+    float(step(x, y))
+    assert total("train_step.compiles") == c0 + 2
+    assert total("train_step.retraces") == r0
+    assert len(step._compiled) == 2
+
+
+def test_trainstep_nan_inject_one_dump_names_first_layer():
+    step, x, y = _tiny_step()
+    numerics.enable_tensor_checker(level="dump")
+    float(step(x, y))                                # clean probed step
+    numerics.poll()
+    assert len(_numeric_dumps()) == 0
+
+    faults.inject("numerics.nan_inject", times=1)
+    float(step(x, y))                                # poisoned at site "0"
+    numerics.poll()
+    files = _numeric_dumps()
+    assert len(files) == 1                           # exactly ONE dump
+    doc = json.load(open(files[0]))
+    assert doc["reason"] == "numerics"
+    assert doc["extra"]["kind"] == "nonfinite"
+    assert doc["extra"]["site"] == "0"               # first offending layer
+    assert doc["extra"]["stream"] == step._perf_tag
+    by_tensor = {r["tensor"]: r for r in doc["extra"]["stats"]}
+    assert by_tensor["0"]["nonfinite"] > 0
+    eps = numerics.monitor().episodes()
+    assert eps and eps[-1].kind == "nonfinite" and eps[-1].site == "0"
+    assert (prof_metrics.counter("observability.flight_dumps").get(
+        reason="numerics") or 0) >= 1
+
+    # the NaN propagated into the params — following steps stay non-finite
+    # but the EPISODE is still open, so no dump storm
+    float(step(x, y))
+    numerics.poll()
+    float(step(x, y))
+    numerics.poll()
+    assert len(_numeric_dumps()) == 1
+
+
+def test_poll_abort_raises_numeric_fault():
+    step, x, y = _tiny_step()
+    numerics.enable_tensor_checker(level="abort")
+    float(step(x, y))                                # clean: no raise
+    numerics.poll()
+    faults.inject("numerics.nan_inject", times=1)
+    with pytest.raises(NumericFault) as ei:
+        float(step(x, y))                            # maybe_poll may raise...
+        numerics.poll()                              # ...else this does
+    assert ei.value.site == "0"
+    assert ei.value.stream == step._perf_tag
+    assert classify_failure(ei.value) == "numeric"
+
+
+# =============================================================== supervisor
+def test_supervisor_rolls_back_on_numeric_fault(tmp_path):
+    mgr = AsyncCheckpointManager(tmp_path / "ckpt")
+    calls = []
+
+    def train(start, state):
+        calls.append(start)
+        for s in range(start, 5):
+            mgr.save(s + 1, {"marker": paddle.to_tensor(np.float32(s + 1))},
+                     block=True)
+            if s == 2 and len(calls) == 1:
+                raise NumericFault("non-finite values at '0'", site="0",
+                                   stream="train_step/t0", step=s)
+        return "ok"
+
+    sup = RecoverySupervisor(
+        mgr, policy=RetryPolicy(base_delay=0.01, max_delay=0.02, seed=0),
+        max_numeric_restarts=2)
+    assert sup.run(train) == "ok"
+    # the numeric budget is its own key, added lazily on first use
+    assert sup.restarts == {"transient": 0, "fatal": 0, "numeric": 1}
+    assert calls == [0, 3]                           # resumed from last valid
+    mgr.close()
+
+
+def test_supervisor_numeric_budget_exhaustion(tmp_path):
+    mgr = AsyncCheckpointManager(tmp_path / "ckpt")
+    sup = RecoverySupervisor(
+        mgr, policy=RetryPolicy(base_delay=0.001, jitter=0.0),
+        max_numeric_restarts=1)
+
+    def poisoned(start, state):
+        raise NumericFault("always nan", site="logits", stream="t", step=start)
+
+    with pytest.raises(NumericFault):
+        sup.run(poisoned)
+    assert sup.restarts["numeric"] == 2              # budget 1 + surfaced one
+    assert sup.restarts["transient"] == 0
+    mgr.close()
+
+
+@pytest.mark.slow
+def test_e2e_nan_inject_supervisor_rollback(tmp_path):
+    """The full loop: probed train step -> nan_inject -> poll raises
+    NumericFault -> supervisor resumes from the last valid checkpoint and
+    the retrained run finishes clean."""
+    mgr = AsyncCheckpointManager(tmp_path / "ckpt")
+    numerics.enable_tensor_checker(level="abort")
+    # fire on the SECOND probed dispatch: step 0 checkpoints first, so the
+    # rollback has a valid step to land on
+    faults.inject("numerics.nan_inject", at_trips={2})
+    calls = []
+
+    def train(start, state):
+        calls.append(start)
+        step, x, y = _tiny_step()                    # fresh params per attempt
+        loss = None
+        for s in range(start, 4):
+            loss = float(step(x, y))
+            numerics.poll()                          # raises on the poisoned step
+            mgr.save(s + 1, {"marker": paddle.to_tensor(np.float32(s + 1))},
+                     block=True)
+        return loss
+
+    sup = RecoverySupervisor(
+        mgr, policy=RetryPolicy(base_delay=0.01, max_delay=0.02, seed=0))
+    out = sup.run(train)
+    assert np.isfinite(out)
+    assert sup.restarts.get("numeric") == 1
+    assert calls[0] == 0 and calls[1] >= 1           # rolled back, not replayed from 0
+    mgr.close()
+
+
+# ============================================================= NaN-safe serving
+def test_serving_guard_off_is_byte_identical(plain_engine):
+    assert plain_engine._numeric_guard is False
+    # empty key component appended to every program key: the store entries
+    # (and therefore the compiled programs) are byte-identical to a build
+    # that never heard of the guard
+    assert plain_engine._guard_key() == ()
+    assert plain_engine.stats()["numeric_guard"] is False
+    ids = plain_engine.generate([5, 6, 7, 8], max_new_tokens=12, timeout=600)
+    assert len(ids) == 12
+    assert plain_engine.step_traces == 1             # warmup program reused
+
+
+def test_serving_guard_clean_parity(plain_engine, guarded_engine):
+    assert guarded_engine._guard_key() == ("nguard",)
+    assert guarded_engine.stats()["numeric_guard"] is True
+    prompt = [7, 8, 9, 10, 11]
+    want = plain_engine.generate(prompt, max_new_tokens=16, timeout=600)
+    got = guarded_engine.generate(prompt, max_new_tokens=16, timeout=600)
+    assert got == want                               # greedy ids byte-identical
+    # the guarded dispatch submitted a logits stats row for this replica
+    numerics.poll()
+    ent = numerics.latest(f"serving/{guarded_engine.replica}")
+    assert ent is not None and ent["sites"] == ("logits",)
+
+
+def test_serving_nan_prefill_fails_only_that_request(plain_engine,
+                                                     guarded_engine):
+    base = prof_metrics.counter("serving.numeric_faults").get(
+        replica=guarded_engine.replica) or 0
+    numerics.set_nan_inject_row(0)
+    faults.inject("numerics.nan_inject", times=1)
+    h0 = guarded_engine.submit([5, 6, 7, 8], max_new_tokens=12)
+    with pytest.raises(NumericFault) as ei:
+        h0.result(timeout=600)
+    assert ei.value.site == "logits"
+    assert h0.status == "error"
+    assert (prof_metrics.counter("serving.numeric_faults").get(
+        replica=guarded_engine.replica) or 0) == base + 1
+    # the very next request is clean AND byte-identical to the unguarded run
+    ids = guarded_engine.generate([5, 6, 7, 8], max_new_tokens=12, timeout=600)
+    assert ids == plain_engine.generate([5, 6, 7, 8], max_new_tokens=12,
+                                        timeout=600)
+
+
+def test_serving_nan_decode_lane_fails_only_that_lane(guarded_engine):
+    numerics.set_nan_inject_row(0)
+    h0 = guarded_engine.submit([9, 10, 11], max_new_tokens=40)
+    h1 = guarded_engine.submit([12, 13, 14], max_new_tokens=40)
+    it0, it1 = h0.stream(), h1.stream()              # closing would cancel
+    next(it0)                                        # both prefills done —
+    next(it1)                                        # the trip can only land
+    faults.inject("numerics.nan_inject", times=1)    # on a DECODE step
+    with pytest.raises(NumericFault):
+        h0.result(timeout=600)
+    out1 = h1.result(timeout=600)
+    assert h0.status == "error" and h1.status == "completed"
+    assert len(out1) == 40                           # the other lane finished
+
+
+def test_serving_quant_drift_gauge():
+    paddle.seed(3)
+    from paddle_tpu.text.models.gpt import GPTForCausalLM
+    from paddle_tpu.serving import ServingEngine
+
+    qm = GPTForCausalLM(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                        num_attention_heads=2,
+                        max_position_embeddings=MAXLEN).eval()
+    eng = ServingEngine(qm, num_slots=1, page_size=PS, max_model_len=MAXLEN,
+                        weight_dtype="int8", numeric_guard=False)
+    with eng:
+        eng._quant_drift_tick()                      # one sampled layer
+        v = prof_metrics.gauge("serving.quant_drift").get(replica=eng.replica)
+    # static int8 weights: the dequant->requant roundtrip sits at the
+    # rounding floor — a later jump is drift worth alerting on
+    assert v is not None and 0.0 <= v <= 0.05
+
+
+def test_scrape_under_pressure_includes_numerics_section(guarded_engine):
+    """The PR-7 wedged-scheduler pattern: /statusz with the numerics
+    section + the numerics.* gauges render in bounded time while the
+    scheduler is parked mid-iteration AND this thread holds the engine's
+    scheduler lock (the section never touches the device)."""
+    numerics.enable_tensor_checker(level="warn")     # registers the provider
+    numerics.submit("unit", ("x",),
+                    jnp.zeros((1, numerics.NSTATS), jnp.float32), step=3)
+    numerics.poll("unit")
+    srv = telemetry.serve(0)
+    release = threading.Event()
+    site = f"serving.scheduler_wedge@{guarded_engine.replica}"
+    faults.inject(site, fn=lambda: release.wait(60), at_trips={3})
+    try:
+        h = guarded_engine.submit([1, 2, 3, 4, 5], max_new_tokens=40)
+        t0 = time.time()
+        while not faults.trip_count(site) and time.time() - t0 < 120:
+            time.sleep(0.005)
+        assert faults.trip_count(site)
+        with guarded_engine._lock:                   # held by US during scrape
+            t0 = time.time()
+            code_s, body_s = _get(srv.url + "/statusz")
+            code_m, body_m = _get(srv.url + "/metrics")
+            elapsed = time.time() - t0
+        assert code_s == 200 and code_m == 200
+        assert elapsed < 5.0, f"scrape took {elapsed:.1f}s under lock"
+        nz = json.loads(body_s)["numerics"]
+        assert nz["enabled"] is True and nz["level"] == "warn"
+        assert "unit" in nz["streams"]
+        assert nz["streams"]["unit"]["tensors"][0]["tensor"] == "x"
+        assert set(nz["amp"]) == {"loss_scale", "found_inf", "scale_decr"}
+        assert "numerics_nonfinite" in body_m.decode()
+    finally:
+        release.set()
+        faults.clear()
+        h.cancel()
