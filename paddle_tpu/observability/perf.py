@@ -135,7 +135,7 @@ def classify(flops_per_call, bytes_per_call, peak=None, hbm=None):
 
 #: serving-engine program families whose bytes are dominated by the paged
 #: KV cache — the ones int8 pools (kv_dtype="int8") directly shrink
-_KV_BOUND_FAMILIES = ("decode", "prefill/", "verify/")
+_KV_BOUND_FAMILIES = ("decode", "prefill/", "prefill_chunk/", "verify/")
 
 
 def is_quantized_family(family):
@@ -158,6 +158,23 @@ def is_encode_family(family):
     return "@embed" in family or "@score" in family
 
 
+def is_flash_family(family):
+    """True for the length-bounded flash-decode families — on a TPU
+    backend the engine attributes its decode programs as ``decode@flash``
+    (``decode@flash@int8`` when quantized): the page sweep is clamped per
+    row by the prefetched seq_lens, so dead-page DMA is already gone."""
+    return "@flash" in family
+
+
+def is_chunked_prefill_family(family):
+    """True for the chunked-prefill ingestion families — the engine
+    attributes them as ``prefill_chunk/<chunk_tokens>`` (plus the usual
+    ``@int8`` / ``@lora-r<r>`` suffixes).  NOT a ``prefill/`` family:
+    scratch is already O(chunk), so the 'chunk the prefill' capacity hint
+    must never fire for these."""
+    return family.split("@")[0].startswith("prefill_chunk/")
+
+
 def candidate_hint(family, regime, temp_bytes=None, pool_bytes=None):
     """The regime-driven recommendation :meth:`ProgramTable.report` prints
     for a top device-time program.  Recognizes the quantized serving
@@ -175,15 +192,25 @@ def candidate_hint(family, regime, temp_bytes=None, pool_bytes=None):
     capacity-bound before it is time-bound — the hint becomes 'chunk the
     prefill', whatever the roofline regime says."""
     quant = is_quantized_family(family)
+    flash = is_flash_family(family)
     serving = family.split("@")[0].startswith(_KV_BOUND_FAMILIES)
+    if temp_bytes and pool_bytes \
+            and is_chunked_prefill_family(family) \
+            and temp_bytes > pool_bytes:
+        return ("chunked prefill already active, yet peak temp bytes "
+                f"({temp_bytes / 1e6:.1f} MB) still dwarf the paged KV "
+                f"pools ({pool_bytes / 1e6:.1f} MB): lower "
+                "prefill_chunk_tokens so per-chunk scratch shrinks "
+                "further")
     if temp_bytes and pool_bytes \
             and family.split("@")[0].startswith("prefill/") \
             and temp_bytes > pool_bytes:
         return (f"prefill peak temp bytes ({temp_bytes / 1e6:.1f} MB) dwarf "
                 f"the paged KV pools ({pool_bytes / 1e6:.1f} MB): chunk the "
-                "prefill — run the prompt through the chunked cache variant "
-                "in page-sized slices so scratch stays O(chunk), and long "
-                "prompts stop spiking HBM at admission")
+                "prefill — ServingEngine(prefill_chunk_tokens=N) runs the "
+                "prompt through the chunked cache variant in N-token "
+                "slices so scratch stays O(chunk), and long prompts stop "
+                "spiking HBM at admission")
     if regime == "bandwidth-bound":
         if is_lora_family(family):
             if quant:
@@ -199,6 +226,17 @@ def candidate_hint(family, regime, temp_bytes=None, pool_bytes=None):
             return ("HBM-bound embed/score encode: prefill-shaped one-shot "
                     "— batch more rows per dispatch or share prefix "
                     "compute with generate admissions")
+        if flash:
+            if quant:
+                return ("HBM-bound int8 flash-decode program: the page "
+                        "sweep is length-bounded and KV dequant is fused "
+                        "— remaining levers are int8 weights "
+                        "(weight_dtype=\"int8\") and batch occupancy "
+                        "(more live slots per dispatch)")
+            return ("HBM-bound flash-decode program: dead-page DMA is "
+                    "already clamped by the length-bounded sweep — next "
+                    "lever is int8 KV pools (kv_dtype=\"int8\"), then "
+                    "int8 weights")
         if quant:
             return ("HBM-bound int8 serving program: KV dequant already "
                     "fused in-kernel — cut the remaining bytes (int8 "

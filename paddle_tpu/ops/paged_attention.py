@@ -136,21 +136,225 @@ def _paged_pallas(q, k_pages, v_pages, page_table, seq_lens, scale,
     return out.astype(q.dtype)
 
 
+# ------------------------------------------------------------ flash decode
+# The length-bounded sweep: the legacy kernels above visit EVERY page slot
+# of the table width for every row — a 128-token row in a 2048-token table
+# pays 128 pages of DMA for 8 pages of data.  The flash variants clamp the
+# sweep per row using the scalar-prefetched seq_lens INSIDE the BlockSpec
+# index map: grid steps past the row's last valid page re-present that last
+# page's block index, and Pallas's revisiting-block optimization elides the
+# HBM->VMEM copy for a repeated index — dead pages are never DMA'd.  The
+# kernel body masks those steps out (i*page_size >= seq_len) and finalizes
+# at the row's LAST VALID page instead of the last grid step, so the
+# trailing steps are pure no-ops.  The batch dimension keeps leading the
+# grid and is declared "parallel" for megacore partitioning; the page sweep
+# stays "arbitrary" (sequential online-softmax accumulation).
+
+
+def flash_decode_active():
+    """True when :func:`paged_attention` will dispatch to the
+    length-bounded flash-decode Pallas path (i.e. a TPU backend is
+    active).  The serving engine uses this for perf-family attribution
+    (``decode@flash`` vs plain ``decode``)."""
+    return jax.default_backend() == "tpu"
+
+
+def _last_page(seq_len, page_size):
+    """Index of the last page a row's sweep must visit (>= 0, so empty
+    rows still have a step to finalize on — they write zeros)."""
+    return jnp.maximum((seq_len + page_size - 1) // page_size - 1, 0)
+
+
+def _bounded_page_map(page_size):
+    """BlockSpec index map for [P, ps, ...] page pools that clamps the
+    sweep: steps past the row's last valid page re-present that page so
+    the revisited block is not re-fetched."""
+    def idx(b, i, pt, ln):
+        return (pt[b, jnp.minimum(i, _last_page(ln[b], page_size))],
+                0, 0, 0)
+    return idx
+
+
+def _bounded_scale_map(page_size):
+    """Same clamp for the [P, ps, HKV] scale pools of the int8 path."""
+    def idx(b, i, pt, ln):
+        return (pt[b, jnp.minimum(i, _last_page(ln[b], page_size))],
+                0, 0)
+    return idx
+
+
+def _accum_page(q_ref, valid, load_k, load_v, scale, num_kv_heads,
+                m_scr, l_scr, acc_scr):
+    """One page's online-softmax update, shared by the flash kernels.
+
+    Mosaic discipline (mirrors _paged_kernel, which compiles on this
+    backend): strictly 2-D tiles, keepdims reductions, f32 constants,
+    plain-contracting dot_generals only.  KV heads run as a STATIC
+    unrolled loop; ``load_k(j)``/``load_v(j)`` return the page's f32
+    [page, D] tile for kv head j (the int8 kernel fuses dequant there),
+    streamed ONCE and serving all g grouped query heads."""
+    num_q = q_ref.shape[1]
+    g = num_q // num_kv_heads
+    for j in range(num_kv_heads):
+        r = slice(j * g, (j + 1) * g)
+        q = q_ref[0, r, :].astype(jnp.float32)             # [g, D]
+        k = load_k(j)                                      # [page, D]
+        v = load_v(j)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * jnp.float32(scale)
+        s = jnp.where(valid, s, jnp.float32(NEG_INF))      # [g, page]
+        m_prev = m_scr[r, :]                               # [g, 1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                             # [g, page]
+        alpha = jnp.exp(m_prev - m_new)                    # [g, 1]
+        l_scr[r, :] = l_scr[r, :] * alpha + p.sum(axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [g, D]
+        acc_scr[r, :] = acc_scr[r, :] * alpha + pv
+        m_scr[r, :] = m_new
+
+
+def _paged_flash_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                        m_scr, l_scr, acc_scr, *, page_size, scale,
+                        num_kv_heads):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, jnp.float32(NEG_INF))
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    seq_len = lens_ref[b]
+    # finalize at the row's LAST VALID page, not the table edge — steps
+    # past it present a repeated (un-fetched) block and do nothing.  The
+    # clamp to the grid edge covers rows whose length overruns the table
+    # (callers mask with seq_lens, the legacy kernels behave the same).
+    last = jnp.minimum(_last_page(seq_len, page_size),
+                       pl.num_programs(1) - 1)
+
+    @pl.when(i * page_size < seq_len)
+    def _compute():
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        valid = pos < seq_len                              # [1, page]
+        _accum_page(q_ref, valid,
+                    lambda j: k_ref[0, :, j, :].astype(jnp.float32),
+                    lambda j: v_ref[0, :, j, :].astype(jnp.float32),
+                    scale, num_kv_heads, m_scr, l_scr, acc_scr)
+
+    @pl.when(i == last)
+    def _fin():
+        # empty rows (seq_len == 0) run _init then _fin at step 0 (when
+        # blocks execute in definition order) and write zeros.  Output
+        # stays f32 — the f32->bf16 truncf fails to legalize in this
+        # Mosaic backend; the public entry downcasts outside the kernel.
+        o_ref[0] = acc_scr[...] / jnp.maximum(l_scr[...], jnp.float32(1e-30))
+
+
+def _flash_compiler_params():
+    """Megacore partitioning over the batch grid dimension, defensively:
+    older Pallas revisions spell the params differently (or not at all),
+    and the kernel is correct without them."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    except Exception:
+        return None
+
+
+def _paged_flash_pallas(q, k_pages, v_pages, page_table, seq_lens, scale,
+                        interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, D = q.shape
+    HKV = k_pages.shape[2]
+    page_size = k_pages.shape[1]
+    NP = page_table.shape[1]
+
+    page_map = _bounded_page_map(page_size)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, NP),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b, i, pt, ln: (b, 0, 0)),
+            pl.BlockSpec((1, page_size, HKV, D), page_map),
+            pl.BlockSpec((1, page_size, HKV, D), page_map),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, i, pt, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+        ],
+    )
+    kwargs = {}
+    cparams = None if interpret else _flash_compiler_params()
+    if cparams is not None:
+        kwargs["compiler_params"] = cparams
+    # x64 OFF for the same Mosaic i64-index reason as _paged_pallas
+    from jax.experimental import enable_x64 as _enable_x64
+
+    with _enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_paged_flash_kernel, page_size=page_size,
+                              scale=scale, num_kv_heads=HKV),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(q.shape, jnp.float32),
+            interpret=interpret,
+            **kwargs,
+        )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+          q, k_pages, v_pages)
+    return out.astype(q.dtype)
+
+
 def _gathered_attend(q, k, v, seq_lens, scale):
     """The dense-reference math shared by the bf16 and int8 fallbacks:
-    q [B, H, D] against gathered k/v [B, T, HKV, D] masked by seq_lens."""
+    q [B, H, D] against gathered k/v [B, T, HKV, D] masked by seq_lens.
+
+    GQA runs as a grouped einsum over [HKV, g] (query head k*g+j attends
+    kv head k, the jnp.repeat convention) — the K/V operands stay at their
+    native HKV head count instead of materializing a g×-repeated copy, so
+    the CPU/reference path allocates KV bytes once, not per query head."""
     B, H, D = q.shape
+    T = k.shape[1]
     HKV = k.shape[2]
-    if HKV != H:
-        k = jnp.repeat(k, H // HKV, axis=2)
-        v = jnp.repeat(v, H // HKV, axis=2)
-    s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    pos = jnp.arange(k.shape[1])[None, None, :]
-    s = jnp.where(pos < seq_lens[:, None, None], s, NEG_INF)
+    g = H // HKV
+    qg = q.reshape(B, HKV, g, D).astype(jnp.float32)
+    s = jnp.einsum("bkgd,btkd->bkgt", qg, k.astype(jnp.float32)) * scale
+    pos = jnp.arange(T)[None, None, None, :]
+    s = jnp.where(pos < seq_lens[:, None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bht,bthd->bhd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    out = jnp.einsum("bkgt,btkd->bkgd", p, v.astype(jnp.float32))
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
+def _gathered_chunk_attend(q, k, v, lens2, scale):
+    """Chunked twin of :func:`_gathered_attend`: q [B, C, H, D] against
+    gathered k/v [B, T, HKV, D], position (b, t) masked to its OWN valid
+    length ``lens2[b, t]``.  The point is the gather amortization: the
+    slot's pages are gathered ONCE for all C chunk positions, where the
+    naive [B*C]-row expansion through the dense reference re-gathers the
+    full table width per position (C× the bytes for identical data)."""
+    B, C, H, D = q.shape
+    T = k.shape[1]
+    HKV = k.shape[2]
+    g = H // HKV
+    qg = q.reshape(B, C, HKV, g, D).astype(jnp.float32)
+    s = jnp.einsum("bckgd,btkd->bckgt", qg, k.astype(jnp.float32)) * scale
+    pos = jnp.arange(T)[None, None, None, None, :]
+    s = jnp.where(pos < lens2[:, :, None, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bckgt,btkd->bckgd", p, v.astype(jnp.float32))
+    return out.reshape(B, C, H, D).astype(q.dtype)
 
 
 def paged_attention_ref(q, k_pages, v_pages, page_table, seq_lens,
@@ -173,11 +377,13 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
                     interpret=None):
     """Decode attention over a paged KV cache (see module docstring).
 
-    Uses the Pallas scalar-prefetch kernel on TPU; dense reference
-    elsewhere.  All rows of ``page_table`` must index valid pages (pad rows
-    with any in-range id — padded pages are masked by ``seq_lens``).
-    GQA: q with g*HKV heads against HKV-head pools is grouped inside the
-    kernel — each page streams once for all g query heads.
+    Uses the length-bounded flash Pallas kernel on TPU (each row's page
+    sweep stops at its last valid page — dead table slots cost no DMA);
+    dense reference elsewhere.  All rows of ``page_table`` must index
+    valid pages (pad rows with any in-range id — padded pages are masked
+    by ``seq_lens``).  GQA: q with g*HKV heads against HKV-head pools is
+    grouped inside the kernel — each page streams once for all g query
+    heads.
     """
     B, H, D = q.shape
     if H % k_pages.shape[2]:
@@ -189,8 +395,8 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
             return paged_attention_ref(q, k_pages, v_pages, page_table,
                                        seq_lens, scale)
         interpret = False
-    return _paged_pallas(q, k_pages, v_pages, page_table, seq_lens, scale,
-                         interpret)
+    return _paged_flash_pallas(q, k_pages, v_pages, page_table, seq_lens,
+                               scale, interpret)
 
 
 # --------------------------------------------------------- decode-loop utils
@@ -237,11 +443,20 @@ def paged_decode_attend(q, k_pages, v_pages, pos, scale=None):
     q head h must map to kv head h//g (jnp.repeat convention — what the
     dense paths in gpt.py/llama.py use)."""
     B, PP, ps, hkv, d = k_pages.shape
+    lens = jnp.full((B,), pos + 1, jnp.int32)
+    if jax.default_backend() != "tpu":
+        # the table below is the IDENTITY permutation of the reshaped
+        # pools, so the reference path's two [B, PP*ps] gathers are pure
+        # copies — skip them and attend the reshaped pools directly
+        # (trace-time static branch; big win for the CPU bench arm)
+        sc = scale if scale is not None else 1.0 / math.sqrt(d)
+        return _gathered_attend(q, k_pages.reshape(B, PP * ps, hkv, d),
+                                v_pages.reshape(B, PP * ps, hkv, d),
+                                lens, sc)
     pool_k = k_pages.reshape(B * PP, ps, hkv, d)
     pool_v = v_pages.reshape(B * PP, ps, hkv, d)
     table = (jnp.arange(B, dtype=jnp.int32)[:, None] * PP
              + jnp.arange(PP, dtype=jnp.int32)[None, :])
-    lens = jnp.full((B,), pos + 1, jnp.int32)
     return paged_attention(q, pool_k, pool_v, table, lens, scale)
 
 
@@ -333,9 +548,18 @@ def paged_chunk_attend(q, k_pages, v_pages, table, lens):
     B, C, H, D = q.shape
     NP = table.shape[1]
     ps = k_pages.shape[1]
+    HKV = k_pages.shape[2]
     lens2 = lens.astype(jnp.int32)[:, None] + jnp.int32(1) \
         + jnp.arange(C, dtype=jnp.int32)[None, :]            # [B, C]
     lens2 = jnp.minimum(lens2, jnp.int32(NP * ps))
+    if jax.default_backend() != "tpu":
+        # gather each slot's pages ONCE for all C positions (the [B*C]
+        # expansion below would re-gather the full table width per
+        # position — C× the bytes for the same data)
+        k = k_pages[table].reshape(B, NP * ps, HKV, D)
+        v = v_pages[table].reshape(B, NP * ps, HKV, D)
+        return _gathered_chunk_attend(q, k, v, lens2,
+                                      1.0 / math.sqrt(D))
     table2 = jnp.broadcast_to(table[:, None, :], (B, C, NP)).reshape(B * C, NP)
     out = paged_attention(q.reshape(B * C, H, D), k_pages, v_pages,
                           table2, lens2.reshape(-1))
@@ -500,6 +724,95 @@ def _paged_q_pallas(q, k_pages, v_pages, k_scales, v_scales, page_table,
     return out.astype(q.dtype)
 
 
+def _paged_q_flash_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, ks_ref,
+                          vs_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                          page_size, scale, num_kv_heads):
+    """Length-bounded twin of :func:`_paged_q_kernel`: the flash sweep
+    clamp of :func:`_paged_flash_kernel` with dequant fused into the page
+    loads — int8 engines (``served_q``/``served_chunk_q``) ride the same
+    dead-page elision."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, jnp.float32(NEG_INF))
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    seq_len = lens_ref[b]
+    last = jnp.minimum(_last_page(seq_len, page_size),
+                       pl.num_programs(1) - 1)
+
+    @pl.when(i * page_size < seq_len)
+    def _compute():
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        valid = pos < seq_len                              # [1, page]
+        _accum_page(
+            q_ref, valid,
+            lambda j: (k_ref[0, :, j, :].astype(jnp.float32)
+                       * ks_ref[0, :, j:j + 1]),
+            lambda j: (v_ref[0, :, j, :].astype(jnp.float32)
+                       * vs_ref[0, :, j:j + 1]),
+            scale, num_kv_heads, m_scr, l_scr, acc_scr)
+
+    @pl.when(i == last)
+    def _fin():
+        o_ref[0] = acc_scr[...] / jnp.maximum(l_scr[...], jnp.float32(1e-30))
+
+
+def _paged_q_flash_pallas(q, k_pages, v_pages, k_scales, v_scales,
+                          page_table, seq_lens, scale, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, D = q.shape
+    HKV = k_pages.shape[2]
+    page_size = k_pages.shape[1]
+    NP = page_table.shape[1]
+
+    page_spec = pl.BlockSpec((1, page_size, HKV, D),
+                             _bounded_page_map(page_size))
+    scale_spec = pl.BlockSpec((1, page_size, HKV),
+                              _bounded_scale_map(page_size))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, NP),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b, i, pt, ln: (b, 0, 0)),
+            page_spec, page_spec, scale_spec, scale_spec,
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, i, pt, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+        ],
+    )
+    kwargs = {}
+    cparams = None if interpret else _flash_compiler_params()
+    if cparams is not None:
+        kwargs["compiler_params"] = cparams
+    # x64 OFF for the same Mosaic i64-index reason as _paged_pallas
+    from jax.experimental import enable_x64 as _enable_x64
+
+    with _enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_paged_q_flash_kernel, page_size=page_size,
+                              scale=scale, num_kv_heads=HKV),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(q.shape, jnp.float32),
+            interpret=interpret,
+            **kwargs,
+        )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+          q, k_pages, v_pages, k_scales.astype(jnp.float32),
+          v_scales.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def paged_attention_quantized_ref(q, k_pages, v_pages, k_scales, v_scales,
                                   page_table, seq_lens, scale=None):
     """Dense-gather oracle/fallback for the quantized pools: gather the
@@ -540,8 +853,8 @@ def paged_attention_quantized(q, k_pages, v_pages, k_scales, v_scales,
                 q, k_pages, v_pages, k_scales, v_scales, page_table,
                 seq_lens, scale)
         interpret = False
-    return _paged_q_pallas(q, k_pages, v_pages, k_scales, v_scales,
-                           page_table, seq_lens, scale, interpret)
+    return _paged_q_flash_pallas(q, k_pages, v_pages, k_scales, v_scales,
+                                 page_table, seq_lens, scale, interpret)
 
 
 def paged_chunk_attend_quant(q, k_pages, v_pages, k_scales, v_scales,
@@ -552,9 +865,21 @@ def paged_chunk_attend_quant(q, k_pages, v_pages, k_scales, v_scales,
     B, C, H, D = q.shape
     NP = table.shape[1]
     ps = k_pages.shape[1]
+    HKV = k_pages.shape[2]
     lens2 = lens.astype(jnp.int32)[:, None] + jnp.int32(1) \
         + jnp.arange(C, dtype=jnp.int32)[None, :]            # [B, C]
     lens2 = jnp.minimum(lens2, jnp.int32(NP * ps))
+    if jax.default_backend() != "tpu":
+        # one gather + dequant per slot for all C positions (transient
+        # [B, T] working set, as in paged_attention_quantized_ref)
+        k = k_pages[table].astype(jnp.float32) \
+            * k_scales[table].astype(jnp.float32)[..., None]
+        v = v_pages[table].astype(jnp.float32) \
+            * v_scales[table].astype(jnp.float32)[..., None]
+        return _gathered_chunk_attend(
+            q, k.reshape(B, NP * ps, HKV, D),
+            v.reshape(B, NP * ps, HKV, D), lens2,
+            1.0 / math.sqrt(D)).astype(q.dtype)
     table2 = jnp.broadcast_to(table[:, None, :], (B, C, NP)).reshape(B * C, NP)
     out = paged_attention_quantized(
         q.reshape(B * C, H, D), k_pages, v_pages, k_scales, v_scales,

@@ -22,6 +22,11 @@ one scheduler serves every pool layout.
   ``lens[b]..lens[b]+C-1`` through the chunk cache variant, returning
   logits at EVERY position so the engine can accept/reject the drafted
   suffix (serving/speculative.py).
+- ``prefill_chunk(params, bufs, ids, nvalid, *pools, table, lens)`` —
+  chunked prefill's ingestion step: the next C prompt tokens per slot
+  through the same chunk cache variant, logits at each row's last real
+  chunk lane (``nvalid[b] - 1``) so the final chunk seeds decode exactly
+  like a monolithic prefill.
 
 prefill/step return ``(logits [B, V] f32, *pools)``, verify
 ``(logits [B, C, V] f32, *pools)``, with each pool a per-layer-stacked
@@ -203,4 +208,32 @@ class GPTAdapter:
         x, w, pools = self._run(params, bufs, ids, pools, table, lens,
                                 pos_ids, self.chunk_tag, lora=lora)
         logits = x.astype(jnp.float32) @ w.T.astype(jnp.float32)
+        return (logits,) + pools
+
+    def prefill_chunk(self, params, bufs, ids, nvalid, *args):
+        """One CHUNK of a long prompt's prefill: run ``ids [B, C]`` — the
+        next C prompt tokens of each row, right-padded past ``nvalid[b]``
+        — at per-slot positions ``lens[b]..lens[b]+C-1`` through the chunk
+        cache variant (the verify machinery reused for prompt ingestion:
+        within-chunk causality and the pool writes come for free), and
+        return the next-token logits at each row's last REAL chunk lane
+        ``nvalid[b] - 1``.  Pad-lane K/V lands past the row's valid length
+        (or in dropped OOB lanes), invisible to seq_lens masking and
+        overwritten by the next chunk/decode write — the
+        paged_table_chunk_write contract.
+
+        Only the FINAL chunk's logits are consumed (they seed decode);
+        intermediate chunks exist for their pool writes.  Returns
+        ``(logits [B, V] f32, *pools)`` — the prefill contract, so the
+        engine's sampler/guard plumbing is shared."""
+        pools, table, lens, lora = self._split_extra(args)
+        C = ids.shape[1]
+        pos_ids = lens[:, None].astype(jnp.int64) \
+            + jnp.arange(C, dtype=jnp.int64)[None, :]
+        pos_ids = jnp.minimum(pos_ids, self.max_model_len - 1)
+        x, w, pools = self._run(params, bufs, ids, pools, table, lens,
+                                pos_ids, self.chunk_tag, lora=lora)
+        idx = jnp.maximum(nvalid.astype(jnp.int32) - 1, 0)[:, None, None]
+        h = jnp.take_along_axis(x, idx, axis=1)[:, 0]
+        logits = h.astype(jnp.float32) @ w.T.astype(jnp.float32)
         return (logits,) + pools

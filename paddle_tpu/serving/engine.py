@@ -252,7 +252,7 @@ class RequestHandle:
 class _Slot:
     __slots__ = ("handle", "req", "alloc", "table_row", "length", "last",
                  "produced", "temp", "eos", "max_new", "deadline",
-                 "last_token_t", "idx")
+                 "last_token_t", "idx", "prefilled")
 
     def __init__(self, req, alloc, table_row):
         self.idx = None                     # batch lane (set at admission)
@@ -268,6 +268,12 @@ class _Slot:
         self.max_new = req.max_new_tokens
         self.deadline = req.deadline
         self.last_token_t = None
+        # chunked prefill: prompt tokens whose K/V have landed so far.
+        # None = monolithic prefill / ingestion complete (lane decodes);
+        # an int means the slot is mid-prefill — its persistent host row
+        # stays inert (scratch table, length 0) so decode dispatches skip
+        # it, and _advance_prefills drives the next chunk.
+        self.prefilled = None
 
 
 class ServingEngine:
@@ -287,8 +293,32 @@ class ServingEngine:
                  degraded_stall_s=2.0, restart_cooldown_s=10.0,
                  speculative_k=0, draft_max_ngram=3, draft_min_ngram=1,
                  replica="0", device=None, health_gating=True, slo=None,
-                 kv_dtype=None, weight_dtype=None, numeric_guard=None):
+                 kv_dtype=None, weight_dtype=None, numeric_guard=None,
+                 prefill_chunk_tokens=None):
         self._model = model
+        # chunked prefill (README "Flash decode & chunked prefill"):
+        # prompts longer than N tokens are admitted IMMEDIATELY and
+        # ingested N tokens at a time through the chunk cache variant,
+        # interleaved with the batch decode dispatch each scheduler
+        # iteration — one long prompt stops stalling the whole decode
+        # batch for its entire prefill, while greedy outputs stay
+        # byte-identical to the monolithic path.  None/0 disables.
+        if prefill_chunk_tokens:
+            prefill_chunk_tokens = int(prefill_chunk_tokens)
+            if prefill_chunk_tokens < 1:
+                raise ValueError(f"prefill_chunk_tokens must be >= 1, "
+                                 f"got {prefill_chunk_tokens}")
+        else:
+            prefill_chunk_tokens = None
+        self._chunk_tokens = prefill_chunk_tokens
+        self._prefill_rr = 0    # round-robin cursor over prefilling slots
+        # decode perf-family attribution: on TPU the paged kernels run the
+        # length-bounded flash sweep — a different roofline than the
+        # full-width legacy sweep, so the family carries an @flash tag
+        # (perf.candidate_hint keys remediation advice on it)
+        from ..ops.paged_attention import flash_decode_active
+
+        self._flash_tag = "@flash" if flash_decode_active() else ""
         # quantized serving (serving/quant, README "Quantized serving"):
         # kv_dtype="int8" stores the paged KV pools as int8 with parallel
         # per-(page slot, head) scale pools — quant fused into the pool
@@ -529,6 +559,12 @@ class ServingEngine:
             "serving.step_traces", "decode-step program traces")
         self._m_prefill_traces = _c(
             "serving.prefill_traces", "prefill program traces")
+        self._m_prefill_chunk_seconds = _h(
+            "serving.prefill_chunk_seconds",
+            "one chunked-prefill dispatch (prefill_chunk_tokens tokens)")
+        self._m_prefill_chunk_traces = _c(
+            "serving.prefill_chunk_traces",
+            "chunked-prefill program traces")
         self._m_shed = _c(
             "serving.load_shed", "requests shed at submit, by reason")
         self._m_engine_restarts = _c(
@@ -1183,6 +1219,46 @@ class ServingEngine:
 
         return self._program(key, build)
 
+    def _prefill_chunk_program(self, c_pad):
+        """The compiled chunked-prefill step: the ``("serve_prefill_chunk",
+        C, …)`` family — every chunk of every long prompt reuses ONE trace
+        per (chunk width, pool shape, sampler) tuple (trace-count plateau
+        asserted in tests).  ``nvalid`` rides as a 4th positional so the
+        adapter's ``_split_extra`` tail (LoRA ids/pools) composes
+        unchanged; pools are donated from position 4."""
+        key = ("serve_prefill_chunk", c_pad, self.table_width,
+               self._pools[0].shape, str(self._pools[0].dtype),
+               self._top) + self._guard_key()
+        n = len(self._pools)
+
+        def build():
+            traces = [0]
+            adapter, sampler = self._adapter, self._sampler
+            guard, gsampler = self._numeric_guard, self._guard_sampler
+            low = _numerics.low_dtype()
+
+            @functools.partial(jax.jit,
+                               donate_argnums=tuple(range(4, 4 + n)))
+            def chunk(params, bufs, ids, nvalid, *rest):
+                traces[0] += 1
+                if guard:
+                    pools, (table, lens, temps, rkey, inj) = \
+                        rest[:n], rest[n:]
+                    out = adapter.prefill_chunk(params, bufs, ids, nvalid,
+                                                *pools, table, lens)
+                    logits = out[0] + inj[:, None]
+                    tok, bad = gsampler(logits, temps, rkey)
+                    stats = _numerics.stats_row(logits, low)[None]
+                    return (tok, bad, stats) + tuple(out[1:])
+                pools, (table, lens, temps, rkey) = rest[:n], rest[n:]
+                out = adapter.prefill_chunk(params, bufs, ids, nvalid,
+                                            *pools, table, lens)
+                return (sampler(out[0], temps, rkey),) + tuple(out[1:])
+
+            return chunk, traces
+
+        return self._program(key, build)
+
     @property
     def step_traces(self):
         """Trace count of this engine's decode-step program (the continuous
@@ -1202,8 +1278,15 @@ class ServingEngine:
                 _faults.maybe("serving.scheduler_wedge")
                 _faults.maybe(self._site_wedge)  # replica-scoped chaos site
                 self._admit()
+                # chunked prefill rides the SAME scheduler iteration as the
+                # decode dispatch: one budget's worth of chunk work, then
+                # the batch decode over the lanes that finished ingesting
+                self._advance_prefills()
                 self._update_gauges()
-                if not any(s is not None for s in self._slots):
+                if not any(s is not None and s.prefilled is None
+                           for s in self._slots):
+                    if any(s is not None for s in self._slots):
+                        continue        # chunked prefills still advancing
                     with self._cv:
                         if not self._queue and not self._stop_evt.is_set():
                             self._cv.wait(timeout=0.02)
@@ -1382,6 +1465,9 @@ class ServingEngine:
                     self._admitting = req
             if req.mode != "generate":
                 self._run_passthrough(req)
+            elif self._chunk_tokens \
+                    and len(req.prompt) > self._chunk_tokens:
+                self._admit_chunked(req, alloc, free_slot)
             else:
                 self._prefill(req, alloc, free_slot)
 
@@ -1497,6 +1583,155 @@ class ServingEngine:
         self._emit_token(slot, tok)
         self._retire_if_done(slot_idx)
 
+    # ------------------------------------------------- chunked prefill
+    def _admit_chunked(self, req, alloc, slot_idx):
+        """Admit a long prompt WITHOUT running its prefill: the slot goes
+        live immediately with ``prefilled=0`` and ingests chunk-by-chunk
+        via :meth:`_advance_prefills`, interleaved with decode — the
+        decode batch never waits out a monolithic long-prompt dispatch.
+        The lane's persistent host row stays inert (scratch table, length
+        0) until the final chunk seeds decode."""
+        table_row = np.asarray(alloc.pages, np.int32)
+        slot = _Slot(req, alloc, table_row)
+        slot.idx = slot_idx
+        slot.prefilled = 0
+        req.handle.status = "running"
+        self._slots[slot_idx] = slot
+        self._admitting = None
+        # _n_temp counts LIVE slots with temperature: incremented at
+        # admission (not at go-live) so the retire paths' _clear_slot_row
+        # decrement stays balanced whether or not ingestion completed
+        if slot.temp > 0:
+            self._n_temp += 1
+
+    def _advance_prefills(self):
+        """One scheduler iteration's chunked-prefill work: up to
+        ``prefill_chunk_tokens`` prompt tokens across the mid-prefill
+        slots, round-robin so concurrent long prompts share the budget
+        fairly.  Cancelled/expired slots retire here — they must not wait
+        for a decode lane they never reached."""
+        if not self._chunk_tokens:
+            return
+        prefilling = [i for i, s in enumerate(self._slots)
+                      if s is not None and s.prefilled is not None]
+        if not prefilling:
+            return
+        start = self._prefill_rr
+        order = sorted(prefilling,
+                       key=lambda i: (i - start) % self.num_slots)
+        budget = self._chunk_tokens
+        for i in order:
+            if budget <= 0:
+                return
+            s = self._slots[i]
+            if s is None or s.prefilled is None:
+                continue
+            h = s.handle
+            if h.cancelled or (s.deadline is not None
+                               and time.time() > s.deadline):
+                status = "cancelled" if h.cancelled else "expired"
+                if status == "expired":
+                    self._m_preempt.inc()
+                self._bm.free(s.alloc)
+                self._release_tenant(s.req)
+                self._slots[i] = None
+                self._clear_slot_row(i, s)
+                self._finish(h, status)
+                continue
+            budget -= self._prefill_chunk_step(i, s)
+            self._prefill_rr = (i + 1) % self.num_slots
+
+    def _prefill_chunk_step(self, i, slot):
+        """Dispatch ONE chunk of slot ``i``'s prompt: tokens
+        ``prefilled .. prefilled+C-1`` (right-padded on the last chunk)
+        through the chunk cache variant at positions ``prefilled..``.
+        Pad-lane junk K/V lands past the valid length (or drops OOB) —
+        invisible to seq_lens masking, overwritten by the first decode
+        write — so the padded dispatch is byte-equivalent to an exact one.
+        The FINAL chunk's sampled token seeds decode and the lane goes
+        live.  Returns the number of real prompt tokens ingested (the
+        budget unit)."""
+        req = slot.req
+        C = self._chunk_tokens
+        S0 = len(req.prompt)
+        c0 = slot.prefilled
+        nval = min(C, S0 - c0)
+        final = c0 + nval >= S0
+        ids = np.zeros((1, C), np.int64)
+        ids[0, :nval] = req.prompt[c0:c0 + nval]
+        table = np.full((1, self.table_width), self._scratch, np.int32)
+        table[0, :len(slot.table_row)] = slot.table_row
+        lens = np.asarray([c0], np.int32)
+        nvalid = np.asarray([nval], np.int32)
+        temps = np.asarray([slot.temp], np.float32)
+        prog, traces = self._prefill_chunk_program(C)
+        n0 = traces[0]
+        rkey = self._next_key()
+        extra = self._prefill_extra(req)
+        guard = self._numeric_guard
+        tail = (self._numeric_inject(1),) if guard else ()
+        fam = self._prefill_chunk_family(C)
+        if _perf.needs_cost(fam):
+            _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
+                prog, (self._params, self._bufs, ids, nvalid, *self._pools,
+                       table, lens, temps, rkey, *extra, *tail)))
+        self._compiling = n0 == 0
+        t0 = time.perf_counter()
+        bad = nstats = None
+        try:
+            with _tracing.span("serving.prefill_chunk",
+                               trace_id=req.handle.trace_id,
+                               request_id=req.handle.request_id,
+                               slot=i, chunk_start=c0, chunk_tokens=nval):
+                if guard:
+                    tok, bad, nstats, *pools = prog(
+                        self._params, self._bufs, ids, nvalid,
+                        *self._pools, table, lens, temps, rkey,
+                        *extra, *tail)
+                else:
+                    tok, *pools = prog(self._params, self._bufs, ids,
+                                       nvalid, *self._pools, table, lens,
+                                       temps, rkey, *extra)
+                self._pools = tuple(pools)
+                tok = int(np.asarray(tok)[0])
+        finally:
+            self._compiling = False
+            self._progress_t = time.monotonic()
+        if traces[0] > n0:
+            self._m_prefill_chunk_traces.inc(traces[0] - n0)
+        elif traces[0]:
+            _perf.record(fam, time.perf_counter() - t0)
+        self._m_prefill_chunk_seconds.observe(time.perf_counter() - t0)
+        if guard:
+            _numerics.submit(f"serving/{self.replica}", ("logits",), nstats,
+                             step=self._iteration)
+            if bool(np.asarray(bad)[0]):
+                # non-finite chunk logits: fail exactly this request (the
+                # decode-lane helper does the full retire dance; the lane
+                # backfills at the next admit)
+                self._fail_numeric(i)
+                return nval
+        slot.prefilled = c0 + nval
+        if not final:
+            return nval
+        # last chunk: its sampled token is the monolithic prefill's first
+        # token — the lane goes live for the decode dispatch
+        slot.prefilled = None
+        slot.last = tok
+        slot.produced = 1
+        self._h_table[i, :] = self._scratch
+        self._h_table[i, :len(slot.table_row)] = slot.table_row
+        self._h_lens[i] = slot.length
+        self._h_temps[i] = slot.temp
+        self._h_last[i, 0] = tok
+        self._on_admitted(slot, i)
+        if self._drafter is not None:
+            self._drafter.register(i, req.prompt)
+            self._drafter.extend(i, [tok])
+        self._emit_token(slot, tok)
+        self._retire_if_done(i)
+        return nval
+
     def _step_key(self):
         """PRNG key for a decode dispatch.  A batch with no temperature
         rows never consumes randomness (the batched sampler/verifier
@@ -1513,7 +1748,11 @@ class ServingEngine:
         # accepted-token state)
         _faults.maybe("serving.step_crash")
         _faults.maybe(self._site_step_crash)  # replica-scoped chaos site
-        active = [i for i, s in enumerate(self._slots) if s is not None]
+        # mid-prefill chunked slots stay OUT of the decode batch: their
+        # host rows are inert (scratch table, length 0) so the dispatch
+        # computes a junk lane nobody reads
+        active = [i for i, s in enumerate(self._slots)
+                  if s is not None and s.prefilled is None]
         if self._spec_k:
             return self._verify_once(active)
         return self._plain_step(active)
@@ -1524,8 +1763,11 @@ class ServingEngine:
     def _prefill_family(self, s_pad):
         return f"prefill/{s_pad}{self._fam_suffix}"
 
+    def _prefill_chunk_family(self, c):
+        return f"prefill_chunk/{c}{self._fam_suffix}"
+
     def _decode_family(self):
-        return f"decode{self._fam_suffix}"
+        return f"decode{self._flash_tag}{self._fam_suffix}"
 
     def _verify_family(self):
         return f"verify/k{self._spec_k}{self._fam_suffix}"
@@ -2002,6 +2244,10 @@ class ServingEngine:
             "bytes_per_page": self._bytes_per_page,
             "kv_bytes_per_token": self._bytes_per_page / self.page_size,
             "numeric_guard": self._numeric_guard,
+            "prefill_chunk_tokens": self._chunk_tokens,
+            "prefilling_slots": sum(
+                1 for s in self._slots
+                if s is not None and s.prefilled is not None),
         }
         if self._spec_k:
             st["speculative"] = {
@@ -2047,6 +2293,7 @@ class ServingEngine:
                           "trace_id": s.handle.trace_id,
                           "status": s.handle.status, "length": s.length,
                           "produced": s.produced, "max_new": s.max_new,
-                          "pages": len(s.table_row)})
+                          "pages": len(s.table_row),
+                          "prefilled": s.prefilled})
         st["slots"] = slots
         return st

@@ -202,7 +202,10 @@ class MultiTenantEngine(ServingEngine):
         return f"prefill/{s_pad}{self._fam_suffix}{self._lora_fam}"
 
     def _decode_family(self):
-        return f"decode{self._fam_suffix}{self._lora_fam}"
+        return f"decode{self._flash_tag}{self._fam_suffix}{self._lora_fam}"
+
+    def _prefill_chunk_family(self, c):
+        return f"prefill_chunk/{c}{self._fam_suffix}{self._lora_fam}"
 
     def _verify_family(self):
         return f"verify/k{self._spec_k}{self._fam_suffix}{self._lora_fam}"
@@ -403,6 +406,32 @@ class MultiTenantEngine(ServingEngine):
                     + tuple(out[1:])
 
             return prefill, traces
+
+        return self._program(key, build)
+
+    def _prefill_chunk_program(self, c_pad):
+        key = ("mt_prefill_chunk", c_pad, self.table_width,
+               self._pools[0].shape, str(self._pools[0].dtype), self._top,
+               self._mt_sig)
+        n = len(self._pools)
+
+        def build():
+            traces = [0]
+            adapter, sampler = self._adapter, self._masked_sampler
+
+            @functools.partial(jax.jit,
+                               donate_argnums=tuple(range(4, 4 + n)))
+            def chunk(params, bufs, ids, nvalid, *rest):
+                traces[0] += 1
+                pools = rest[:n]
+                table, lens, temps, rkey, allowed = rest[n:n + 5]
+                mt = rest[n + 5:]
+                out = adapter.prefill_chunk(params, bufs, ids, nvalid,
+                                            *pools, table, lens, *mt)
+                return (sampler(out[0], allowed, temps, rkey),) \
+                    + tuple(out[1:])
+
+            return chunk, traces
 
         return self._program(key, build)
 

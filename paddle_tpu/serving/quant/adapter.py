@@ -18,6 +18,13 @@ attention consumers dequantize in-kernel
 full-precision copy of the cache ever materializes in HBM.  Rollback,
 prefix pages, scratch-page masking and the chunk-write drop semantics are
 all untouched — the scale pool rides the exact same table addressing.
+
+Chunked prefill (``ServingEngine(prefill_chunk_tokens=N)``) rides the
+inherited :meth:`GPTAdapter.prefill_chunk` unchanged: ``chunk_tag`` is
+``"served_chunk_q"``, so each chunk quantizes on the way into the pools
+and the engine's ``prefill_chunk/<c>@int8`` program family stays
+byte-identical to the monolithic int8 prefill.  On TPU the decode side of
+the same batch runs the int8 flash kernel (``decode@flash@int8``).
 """
 
 from __future__ import annotations

@@ -252,8 +252,10 @@ class GPTDecoderLayer(Layer):
             # [B, PP, ps, h, d] — HBM bound by pages allocated, not a dense
             # [B, max_len] rectangle.  Prefill attends densely (flash/sdpa
             # over the prompt) and writes the prompt's K/V into pages;
-            # each decode step writes one token and runs the Pallas
-            # scalar-prefetch paged-attention kernel (ops/paged_attention).
+            # each decode step writes one token and runs the length-bounded
+            # Pallas flash-decode kernel (ops/paged_attention): the page
+            # sweep is clamped per row by the scalar-prefetched seq_lens,
+            # so dead table slots past a row's length are never DMA'd.
             from ...ops.paged_attention import (paged_decode_attend,
                                                 paged_prefill_write,
                                                 paged_token_write)
@@ -414,7 +416,8 @@ class GPTForCausalLM(Layer):
 
         ``cache_impl="paged"``: block-paged KV cache — per-layer page pools
         instead of dense [B, T] rectangles, decode attention through the
-        Pallas scalar-prefetch paged kernel (ops/paged_attention).  Same
+        length-bounded Pallas flash-decode kernel (ops/paged_attention):
+        each row's page sweep stops at its own last valid page.  Same
         tokens as the dense path (tests/test_paged_attention.py); KV HBM is
         bounded by pages allocated (ceil(T/page_size) per sequence), the
         serving property the reference's paged engine exists for."""

@@ -143,7 +143,9 @@ class LlamaAttention(Layer):
             # PAGED cache: per-layer [B, PP, ps, hkv, hd] pools, keys stored
             # pre-rotated like the dense path; GQA attends grouped against
             # the pools (no repeated-KV materialization in HBM) via
-            # ops.paged_attention's scalar-prefetch kernel.
+            # ops.paged_attention's length-bounded flash-decode kernel —
+            # each page streams once for all g query heads of its KV head,
+            # and the sweep is clamped per row by the prefetched seq_lens.
             from ...ops.paged_attention import (paged_decode_attend,
                                                 paged_prefill_write,
                                                 paged_token_write)
