@@ -768,6 +768,126 @@ def _serving_cluster_report(replicas):
     return out
 
 
+def _measure_serving_mp(mp=1, n_requests=16, num_slots=4, S0=48,
+                        page_size=16, max_new=64):
+    """ONE arm of the tensor-parallel comparison (mp=1 is the unsharded
+    baseline): greedy decode throughput through a single ServingEngine,
+    sharded over a ``model`` mesh when mp > 1.  Runs in its own
+    subprocess with XLA_FLAGS forcing the host-device count, so the mesh
+    is real even on CPU; returns the full greedy ids so the parent can
+    assert byte-identity across arms, plus the per-shard pool accounting
+    (bytes_per_page, pool bytes, resident-sequence capacity)."""
+    import time
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models import GPTForCausalLM
+
+    paddle.seed(0)
+    max_len = S0 + max_new
+    m = GPTForCausalLM(vocab_size=512, hidden_size=256, num_hidden_layers=4,
+                       num_attention_heads=4,
+                       max_position_embeddings=max_len).eval()
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(1, 500, (S0,)).astype("int64")
+               for _ in range(n_requests)]
+
+    mp = int(mp)
+    mesh_kw = {"mesh": jax.devices()[:mp]} if mp > 1 else {}
+    engine = ServingEngine(m, num_slots=num_slots, page_size=page_size,
+                           max_model_len=max_len, **mesh_kw)
+    with engine:
+        engine.generate(prompts[0], max_new_tokens=4, timeout=900)  # compile
+        t0 = time.time()
+        handles = [engine.submit(p, max_new_tokens=max_new)
+                   for p in prompts]
+        ids = [h.result(timeout=900) for h in handles]
+        dt = time.time() - t0
+        step_traces = engine.step_traces
+        st = engine.stats()
+        bm = engine.block_manager
+        # capacity at a fixed per-chip budget: sharded pools admit mp x
+        budget = 64 * (st["bytes_per_page"] * mp)   # mp-invariant budget
+        resident = bm.max_resident_sequences(max_len, budget_bytes=budget)
+        mem = _bench_memory_section(engine)
+    from paddle_tpu.observability import perf as _perf
+
+    total = n_requests * max_new
+    return {
+        "mp": mp,
+        "n_requests": n_requests,
+        "tokens": total,
+        "tokens_per_sec": round(total / dt, 2),
+        "itl_p50_s": _metric_quantile("serving.inter_token_seconds", 0.5,
+                                      replica="0"),
+        "itl_p95_s": _metric_quantile("serving.inter_token_seconds", 0.95,
+                                      replica="0"),
+        "step_traces": step_traces,
+        "bytes_per_page": st["bytes_per_page"],        # per shard
+        "pool_shard_bytes": bm.stats().get("pool_bytes"),
+        "resident_seqs_at_budget": resident,
+        "program_table": _perf.snapshot(resolve=True),
+        "memory": mem,
+        "ids": [list(map(int, r)) for r in ids],
+    }
+
+
+def _serving_mp_report(mp):
+    """Two arms (separate subprocesses via _section, both under the SAME
+    forced host-device count so the topology is identical): the unsharded
+    engine vs one engine sharded mp-ways over the ``model`` mesh axis.
+    Acceptance: greedy byte-identical per request, per-shard pool bytes
+    exactly 1/mp of unsharded, mp x the resident sequences at the same
+    per-chip HBM budget, and the one-SPMD-program trace plateau."""
+    import os
+
+    mp = int(mp)
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = (flags + " --xla_force_host_platform_device_count="
+             f"{mp}").strip()
+    base = _section("serving_mp", BENCH_MP="1", XLA_FLAGS=flags)
+    sharded = _section("serving_mp", BENCH_MP=str(mp), XLA_FLAGS=flags)
+    ident = [a == b for a, b in zip(base["ids"], sharded["ids"])]
+    out = {
+        "mp": mp,
+        # the parallel substrate under the mesh: on a 1-core host the
+        # shards serialize and the number to watch is PARITY and the
+        # per-shard bytes ratio, not speedup (same convention as the
+        # cluster arm's host_cores)
+        "host_cores": len(os.sched_getaffinity(0))
+        if hasattr(os, "sched_getaffinity") else (os.cpu_count() or 1),
+        "tokens": sharded["tokens"],
+        "base_tokens_per_sec": base["tokens_per_sec"],
+        "mp_tokens_per_sec": sharded["tokens_per_sec"],
+        "mp_speedup": round(sharded["tokens_per_sec"]
+                            / max(base["tokens_per_sec"], 1e-9), 3),
+        "base_itl_p50_s": base["itl_p50_s"],
+        "mp_itl_p50_s": sharded["itl_p50_s"],
+        "base_itl_p95_s": base["itl_p95_s"],
+        "mp_itl_p95_s": sharded["itl_p95_s"],
+        "bytes_per_page_base": base["bytes_per_page"],
+        "bytes_per_page_per_shard": sharded["bytes_per_page"],
+        "shard_bytes_ratio": round(
+            base["bytes_per_page"]
+            / max(sharded["bytes_per_page"], 1), 3),
+        "resident_seqs_at_budget_base": base["resident_seqs_at_budget"],
+        "resident_seqs_at_budget_mp": sharded["resident_seqs_at_budget"],
+        "step_traces_base": base["step_traces"],
+        "step_traces_mp": sharded["step_traces"],
+        "greedy_identical_per_request": ident,
+        "greedy_identical": all(ident),
+        "note": ("one ServingEngine sharded over a model-axis mesh vs the "
+                 "unsharded engine, same forced host-device topology; "
+                 "greedy_identical asserts byte-equal output per request, "
+                 "shard_bytes_ratio the per-shard pool cost, "
+                 "resident_seqs_at_budget the mp x capacity win at a fixed "
+                 "per-chip HBM budget"),
+    }
+    return out
+
+
 def _serving_speculative_report(k, **kwargs):
     """Both arms (separate subprocesses via _section) + the acceptance
     criteria: speedup on decode tokens/sec with byte-identical greedy
@@ -1110,6 +1230,10 @@ def _run_section(name):
             policy=os.environ.get("BENCH_ROUTE_POLICY", "affinity"),
             workload_replicas=int(os.environ.get("BENCH_FLEET", "0"))
             or None)
+    if name == "serving_mp":
+        import os
+
+        return _measure_serving_mp(mp=int(os.environ.get("BENCH_MP", "1")))
     if name == "tracing_overhead":
         return _measure_tracing_overhead()
     if name == "numerics_overhead":
@@ -1417,6 +1541,7 @@ def main():
         # same hygiene as the per-section subprocesses of the full run)
         spec_k = _spec_k_from_argv()
         n_replicas = _replicas_from_argv()
+        mp_n = _mp_from_argv()
         kv_dtype = _argv_value("--kv-dtype")
         lora_n = _argv_value("--lora")
         if lora_n:
@@ -1429,6 +1554,11 @@ def main():
             # --replicas N: the multi-replica cluster (prefix-affinity
             # router) vs a single replica and vs random routing
             out = {"serving_cluster": _serving_cluster_report(n_replicas)}
+        elif mp_n:
+            # --mp N: one engine sharded N-ways over a model-axis mesh
+            # (forced host devices) vs the unsharded engine — greedy
+            # parity, per-shard pool bytes, mp x capacity at fixed budget
+            out = {"serving_mp": _serving_mp_report(mp_n)}
         elif kv_dtype and kv_dtype not in ("bf16", "native"):
             # --kv-dtype int8: the quantized-pool engine vs the
             # full-precision engine on a decode-heavy workload (tokens/sec,
@@ -1587,6 +1717,15 @@ def _replicas_from_argv():
         if a == "--replicas" and i + 1 < len(sys.argv):
             return int(sys.argv[i + 1])
         if a.startswith("--replicas="):
+            return int(a.split("=", 1)[1])
+    return None
+
+
+def _mp_from_argv():
+    for i, a in enumerate(sys.argv):
+        if a == "--mp" and i + 1 < len(sys.argv):
+            return int(sys.argv[i + 1])
+        if a.startswith("--mp="):
             return int(a.split("=", 1)[1])
     return None
 

@@ -344,14 +344,21 @@ class MemoryLedger:
             meta = row.get("meta") or {}
             if meta.get("kind") != "kv":
                 continue
-            capacity.append({
+            cap = {
                 "replica": row["replica"],
                 "bytes_per_page": meta.get("bytes_per_page"),
                 "page_size": meta.get("page_size"),
                 "num_pages": meta.get("num_pages"),
                 "max_model_len": meta.get("max_model_len"),
                 "max_resident_slots": meta.get("max_resident_slots"),
-            })
+            }
+            # mesh-sharded pools: bytes_per_page above is PER SHARD (the
+            # per-chip cost admission runs on); surface the split so the
+            # capacity table reads unambiguously next to the global-bytes
+            # owner rows
+            if meta.get("shard"):
+                cap["shard"] = meta["shard"]
+            capacity.append(cap)
         rep["budget_bytes"] = budget
         if budget:
             rep["budget_used_frac"] = rep["total_bytes"] / budget

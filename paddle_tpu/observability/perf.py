@@ -166,6 +166,24 @@ def is_flash_family(family):
     return "@flash" in family
 
 
+def is_mp_family(family):
+    """True for the tensor-parallel serving families — a mesh-sharded
+    engine attributes its programs as ``decode@mp<N>``,
+    ``prefill/<bucket>@mp<N>``, ``verify/k<k>@mp<N>`` (the suffix composes
+    after ``@flash``/``@int8``: one SPMD program per family, dispatched
+    over the ``model`` axis)."""
+    return "@mp" in family
+
+
+def mp_degree(family):
+    """Model-parallel degree parsed from the ``@mp<N>`` suffix (1 when
+    the family is unsharded)."""
+    for part in family.split("@"):
+        if part.startswith("mp") and part[2:].isdigit():
+            return int(part[2:])
+    return 1
+
+
 def is_chunked_prefill_family(family):
     """True for the chunked-prefill ingestion families — the engine
     attributes them as ``prefill_chunk/<chunk_tokens>`` (plus the usual
@@ -173,6 +191,18 @@ def is_chunked_prefill_family(family):
     scratch is already O(chunk), so the 'chunk the prefill' capacity hint
     must never fire for these."""
     return family.split("@")[0].startswith("prefill_chunk/")
+
+
+def _multi_chip_host():
+    """More than one accelerator visible — an unsharded serving family
+    here is leaving mesh capacity on the table, which flips the
+    bandwidth-bound hint toward ``ServingEngine(mesh=...)``."""
+    try:
+        import jax
+
+        return jax.device_count() > 1
+    except Exception:
+        return False
 
 
 def candidate_hint(family, regime, temp_bytes=None, pool_bytes=None):
@@ -193,6 +223,7 @@ def candidate_hint(family, regime, temp_bytes=None, pool_bytes=None):
     prefill', whatever the roofline regime says."""
     quant = is_quantized_family(family)
     flash = is_flash_family(family)
+    mp = is_mp_family(family)
     serving = family.split("@")[0].startswith(_KV_BOUND_FAMILIES)
     if temp_bytes and pool_bytes \
             and is_chunked_prefill_family(family) \
@@ -226,6 +257,18 @@ def candidate_hint(family, regime, temp_bytes=None, pool_bytes=None):
             return ("HBM-bound embed/score encode: prefill-shaped one-shot "
                     "— batch more rows per dispatch or share prefix "
                     "compute with generate admissions")
+        if mp and serving:
+            n = mp_degree(family)
+            if quant:
+                return (f"HBM-bound mp{n} int8 serving program: KV pools "
+                        "sharded over the model axis AND dequant fused — "
+                        "per-shard bytes are the floor; remaining levers "
+                        "are int8 weights (weight_dtype=\"int8\") and "
+                        "batch occupancy")
+            return (f"HBM-bound mp{n} serving program: already sharded "
+                    "over the model axis, so each chip sweeps 1/"
+                    f"{n} of the KV heads — cut the per-shard bytes next "
+                    "with int8 pools (kv_dtype=\"int8\")")
         if flash:
             if quant:
                 return ("HBM-bound int8 flash-decode program: the page "
@@ -242,6 +285,12 @@ def candidate_hint(family, regime, temp_bytes=None, pool_bytes=None):
                     "fused in-kernel — cut the remaining bytes (int8 "
                     "weights via weight_dtype, larger pages, more slots "
                     "per dispatch)")
+        if serving and _multi_chip_host():
+            return ("HBM-bound serving program with UNSHARDED pools on a "
+                    "multi-chip host: shard the KV pools and weights over "
+                    "the mesh (ServingEngine(mesh=...)) — each chip then "
+                    "sweeps only its KV-head slice, ~1/mp the bytes/call "
+                    "— then int8 pools (kv_dtype=\"int8\")")
         if serving:
             return ("HBM-bound serving program: quantize the KV pools "
                     "(kv_dtype=\"int8\" — dequant fuses into the paged "

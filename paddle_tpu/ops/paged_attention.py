@@ -159,6 +159,70 @@ def flash_decode_active():
     return jax.default_backend() == "tpu"
 
 
+# ------------------------------------------------- tensor-parallel serving
+# ServingEngine(mesh=...) shards q and the page pools on the (KV-)head dim.
+# Off-TPU the dense-gather references below are plain jnp — GSPMD partitions
+# them from the operand shardings with no help.  The Pallas flash kernels
+# can't be GSPMD-partitioned (they bake num_kv_heads from the static shape
+# and unroll the head loop), so under an active scope the TPU entries wrap
+# the kernel in shard_map with head-sharded specs: each shard's kernel
+# compiles against its LOCAL head count and sweeps only its own pool
+# shard's pages.  Per-head attention is embarrassingly parallel and the
+# contiguous head split keeps GQA groups whole per shard (q head h reads
+# kv head h // g; both sides split at the same head boundaries), so the
+# wrapper needs no collectives.  The scope is entered by the serving
+# adapter at TRACE time (inside the engine's jit), so the wrapping decision
+# bakes into the compiled program.
+_MP_SCOPE = [None]  # active (mesh, axis_name) or None
+
+
+def mp_shard_scope(mesh, axis="model"):
+    """Context manager activating head-sharded flash dispatch for the
+    paged-attention entries traced inside it.  ``mesh=None`` is a no-op
+    scope (the single-device engine pays nothing)."""
+    import contextlib
+
+    if mesh is None:
+        return contextlib.nullcontext()
+
+    @contextlib.contextmanager
+    def scope():
+        prev = _MP_SCOPE[0]
+        _MP_SCOPE[0] = (mesh, axis)
+        try:
+            yield
+        finally:
+            _MP_SCOPE[0] = prev
+
+    return scope()
+
+
+def _flash_sharded(pallas_fn, q, pools, scales, page_table, seq_lens,
+                   scale, interpret):
+    """shard_map wrapper for a flash Pallas entry: q and the pools shard
+    the head dim, table/lens replicate, out follows q.  ``pools`` are the
+    [P, ps, h, d] payload arrays, ``scales`` the optional [P, ps, h] scale
+    pools (quantized path)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh, ax = _MP_SCOPE[0]
+    q_spec = P(None, ax, None)
+    pool_spec = P(None, None, ax, None)
+    scale_spec = P(None, None, ax)
+    in_specs = (q_spec,) + (pool_spec,) * len(pools) \
+        + (scale_spec,) * len(scales) + (P(), P())
+
+    def local(q_, *rest):
+        kv = rest[:len(pools) + len(scales)]
+        table_, lens_ = rest[-2:]
+        return pallas_fn(q_, *kv, table_, lens_, scale, interpret)
+
+    f = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=q_spec,
+                  check_rep=False)
+    return f(q, *pools, *scales, page_table, seq_lens)
+
+
 def _last_page(seq_len, page_size):
     """Index of the last page a row's sweep must visit (>= 0, so empty
     rows still have a step to finalize on — they write zeros)."""
@@ -395,6 +459,9 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
             return paged_attention_ref(q, k_pages, v_pages, page_table,
                                        seq_lens, scale)
         interpret = False
+    if _MP_SCOPE[0] is not None:
+        return _flash_sharded(_paged_flash_pallas, q, (k_pages, v_pages),
+                              (), page_table, seq_lens, scale, interpret)
     return _paged_flash_pallas(q, k_pages, v_pages, page_table, seq_lens,
                                scale, interpret)
 
@@ -853,6 +920,10 @@ def paged_attention_quantized(q, k_pages, v_pages, k_scales, v_scales,
                 q, k_pages, v_pages, k_scales, v_scales, page_table,
                 seq_lens, scale)
         interpret = False
+    if _MP_SCOPE[0] is not None:
+        return _flash_sharded(_paged_q_flash_pallas, q, (k_pages, v_pages),
+                              (k_scales, v_scales), page_table, seq_lens,
+                              scale, interpret)
     return _paged_q_flash_pallas(q, k_pages, v_pages, k_scales, v_scales,
                                  page_table, seq_lens, scale, interpret)
 

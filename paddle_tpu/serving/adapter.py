@@ -72,6 +72,13 @@ class GPTAdapter:
         self.max_model_len = self.gpt.position_embeddings.weight.shape[0]
         self.page_size = int(page_size)
 
+    #: set by ServingEngine(mesh=...) — the jax Mesh whose "model" axis the
+    #: pools/weights are sharded over (None = single-device serving).  The
+    #: TPU flash kernels consult it at trace time (mp_shard_scope) so each
+    #: shard's Pallas page sweep covers only its local KV heads.
+    mp_mesh = None
+    mp_axis = "model"
+
     def params_and_buffers(self):
         # under the bind lock: another replica of this model may be inside
         # a trace-time bind() on its scheduler thread right now
@@ -79,6 +86,38 @@ class GPTAdapter:
             params = {k: p._value for k, p in self.model.named_parameters()}
             bufs = {k: b._value for k, b in self.model.named_buffers()}
         return params, bufs
+
+    # --------------------------------------------------------- mp sharding
+    def validate_mp(self, mp):
+        """Divisibility check for ``ServingEngine(mesh=...)``: the pools
+        shard on the KV-head dim and the qkv split is head-granular, so
+        every shard must own a whole number of heads."""
+        mp = int(mp)
+        if self.num_kv_heads % mp:
+            raise ValueError(
+                f"tensor-parallel serving needs num_kv_heads divisible by "
+                f"the mesh's model axis: {self.num_kv_heads} heads % "
+                f"mp={mp} != 0")
+
+    def pool_pspecs(self, axis="model"):
+        """PartitionSpec per pool array: payload pools [L, P, ps, h, d]
+        shard the KV-head dim (page table stays replicated — every shard
+        addresses the same page slots, each holding its own heads)."""
+        from jax.sharding import PartitionSpec as P
+
+        return (P(None, None, None, axis, None),) * self.n_pools
+
+    def param_pspec(self, name, axis="model"):
+        """PartitionSpec for one named parameter/buffer under mp serving:
+        the Megatron column/row split from gpt.mp_param_specs, replicated
+        for everything outside the decoder matmuls."""
+        from jax.sharding import PartitionSpec as P
+        from ..text.models.gpt import mp_param_specs
+
+        for suf, spec in mp_param_specs(axis).items():
+            if name.endswith(suf):
+                return spec
+        return P()
 
     # ----------------------------------------------------------- pool hooks
     def init_pools(self, num_pages):
@@ -120,11 +159,13 @@ class GPTAdapter:
              lora=None):
         from ..framework import random as _rng
         from ..framework.state import no_grad_ctx
+        from ..ops.paged_attention import mp_shard_scope
         from ..tensor.tensor import Tensor
 
         gpt = self.gpt
         with no_grad_ctx(), _rng.rng_scope(jax.random.key(0)), \
-                self.model.bind(params, bufs):
+                self.model.bind(params, bufs), \
+                mp_shard_scope(self.mp_mesh, self.mp_axis):
             lc = self._layer_caches(pools, table, lens, tag)
             x, new_cache = gpt(Tensor(ids), position_ids=Tensor(pos_ids),
                                cache=lc, lora=lora)

@@ -51,7 +51,8 @@ class PageAllocation:
 
 class BlockManager:
     def __init__(self, num_pages, page_size, prefix_sharing=False,
-                 replica="0", bytes_per_page=None, pool_dtype=None):
+                 replica="0", bytes_per_page=None, pool_dtype=None,
+                 shards=1):
         if num_pages < 1:
             raise ValueError(f"num_pages must be >= 1, got {num_pages}")
         if page_size < 1:
@@ -63,10 +64,16 @@ class BlockManager:
         # HBM accounting (quantized serving): what one page costs across
         # all layers, K+V, scale pools included, and what the pool rows
         # are made of — the engine fills these in so capacity math and the
-        # /statusz slot table talk in bytes, not just page counts
+        # /statusz slot table talk in bytes, not just page counts.
+        # Tensor-parallel serving: ``shards`` records the mesh split of
+        # the pools and ``bytes_per_page`` is then the PER-SHARD (per-chip)
+        # cost — a 2-way-sharded pool holds 2x the resident sequences at
+        # the same per-chip HBM budget, which is exactly what
+        # :meth:`max_resident_sequences` with ``budget_bytes`` reports
         self.bytes_per_page = int(bytes_per_page) \
             if bytes_per_page is not None else None
         self.pool_dtype = str(pool_dtype) if pool_dtype is not None else None
+        self.shards = int(shards)
         self._free = collections.deque(range(self.num_pages))
         self._active = {}                       # prefix key -> [page, refs]
         self._idle = collections.OrderedDict()  # prefix key -> page (refs 0)
@@ -120,8 +127,10 @@ class BlockManager:
             "prefix_sharing": self.prefix_sharing,
             "bytes_per_page": self.bytes_per_page,
             "pool_dtype": self.pool_dtype,
+            "shards": self.shards,
         }
         if self.bytes_per_page is not None:
+            # per-shard (per-chip) bytes when the pools are mesh-sharded
             st["pool_bytes"] = self.num_pages * self.bytes_per_page
             st["used_bytes"] = self.used_pages * self.bytes_per_page
             st["kv_bytes_per_token"] = self.bytes_per_page / self.page_size
@@ -167,7 +176,10 @@ class BlockManager:
         case fit — in this pool, or in a hypothetical pool of
         ``budget_bytes`` HBM at this manager's bytes_per_page (the
         occupancy comparison the int8 acceptance test and the bench arm
-        assert on)."""
+        assert on).  ``budget_bytes`` is PER CHIP: with mesh-sharded
+        pools (shards > 1) bytes_per_page is the per-shard cost, so the
+        same budget admits ``shards``x the sequences of the unsharded
+        engine — the mp HBM-capacity win, asserted by the mp tests."""
         per_seq = self.pages_for(tokens_per_seq)
         pages = self.num_pages
         if budget_bytes is not None:

@@ -11,11 +11,18 @@ replicas reuse one traced prefill/step/verify family instead of minting N.
 Device placement is configured from ``jax.devices()`` with an explicit
 dp-replica count: ``devices="auto"`` round-robins replicas over the
 visible devices and commits each replica's params/buffers/pools to its
-device (the engine's uncommitted per-step host arrays follow); the default
+device (the engine's uncommitted per-step host arrays follow); an
+explicit device LIST pins the round-robin order; the default
 ``devices=None`` leaves placement to jax (all replicas on the default
 device — the single-host dryrun shape, where replicas still overlap
-host-side scheduling with device dispatch).  A mesh-sliced mp replica
-(sharded engine) is future work; the seam is ``engine_kwargs["device"]``.
+host-side scheduling with device dispatch).
+
+Tensor-parallel replicas (dp x mp topologies behind the same router):
+``mp=N`` carves the device list into contiguous N-sized submeshes — one
+mp engine per carve, each sharding its pools/weights over its own
+``"model"`` axis (``ServingEngine(mesh=...)``) — or pass ``devices=`` as
+an explicit list of submeshes (each entry a device list / jax Mesh).
+Count divisibility is validated with a clear error either way.
 """
 
 from __future__ import annotations
@@ -23,17 +30,22 @@ from __future__ import annotations
 import jax
 
 
+def _is_device(d):
+    """A jax device object (vs a submesh list/Mesh)."""
+    return hasattr(d, "platform") and not isinstance(d, (list, tuple))
+
+
 class ReplicaPool:
     """Build and own N serving-engine replicas.
 
-    ``replicas=None`` defaults to one per visible device when ``devices``
-    selects placement, else 1.  ``replica_prefix`` namespaces the replica
+    ``replicas=None`` defaults to one per carve when ``devices``/``mp``
+    select placement, else 1.  ``replica_prefix`` namespaces the replica
     ids (metric labels / provider keys) when several pools share a
     process.  Remaining ``engine_kwargs`` go to every engine verbatim.
     """
 
     def __init__(self, model, replicas=None, devices=None, replica_prefix="",
-                 engine_cls=None, **engine_kwargs):
+                 engine_cls=None, mp=None, **engine_kwargs):
         from ..engine import ServingEngine
 
         if engine_cls is None:
@@ -45,22 +57,75 @@ class ReplicaPool:
                 engine_cls = MultiTenantEngine
             else:
                 engine_cls = ServingEngine
+        mp = int(mp) if mp else None
+        if mp is not None and mp < 1:
+            raise ValueError(f"mp must be >= 1, got {mp}")
         if devices == "auto":
             devices = list(jax.devices())
+        elif devices is not None:
+            devices = list(devices)
         if devices is not None and not devices:
             raise ValueError("devices must be non-empty (or None/'auto')")
-        if replicas is None:
-            replicas = len(devices) if devices is not None else 1
-        replicas = int(replicas)
-        if replicas < 1:
-            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        # submesh placement: either the caller hands explicit submeshes
+        # (list entries that are themselves device lists / meshes), or
+        # mp= carves the flat device list into contiguous mp-sized groups
+        meshes = None
+        if devices is not None and not all(_is_device(d) for d in devices):
+            if mp is not None:
+                raise ValueError(
+                    "pass EITHER mp=N (carve a flat device list) OR "
+                    "devices= as explicit submeshes, not both")
+            if any(_is_device(d) for d in devices):
+                raise ValueError(
+                    "devices= mixes single devices and submeshes — use "
+                    "1-element lists for single-device replicas")
+            meshes = [list(m) if isinstance(m, (list, tuple)) else m
+                      for m in devices]
+            sizes = {len(m) if isinstance(m, list)
+                     else int(m.devices.size) for m in meshes}
+            if len(sizes) > 1:
+                raise ValueError(
+                    f"submeshes must be same-sized (one SPMD program per "
+                    f"family across replicas), got sizes {sorted(sizes)}")
+        elif mp is not None and mp > 1:
+            if devices is None:
+                devices = list(jax.devices())
+            if len(devices) % mp:
+                raise ValueError(
+                    f"{len(devices)} devices not divisible by mp={mp}: a "
+                    f"dp x mp pool needs len(devices) == replicas * mp "
+                    f"(force host devices with "
+                    f"XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                    f"for CPU tests)")
+            meshes = [devices[i:i + mp] for i in range(0, len(devices), mp)]
+        if meshes is not None:
+            if replicas is None:
+                replicas = len(meshes)
+            replicas = int(replicas)
+            if replicas < 1:
+                raise ValueError(f"replicas must be >= 1, got {replicas}")
+            if replicas > len(meshes):
+                raise ValueError(
+                    f"replicas={replicas} exceeds the {len(meshes)} "
+                    f"available submeshes (need replicas * mp devices)")
+        else:
+            if replicas is None:
+                replicas = len(devices) if devices is not None else 1
+            replicas = int(replicas)
+            if replicas < 1:
+                raise ValueError(f"replicas must be >= 1, got {replicas}")
         self.model = model
         self.devices = devices
+        self.meshes = meshes
         self.engines = []
         for i in range(replicas):
-            dev = devices[i % len(devices)] if devices is not None else None
+            place = {}
+            if meshes is not None:
+                place["mesh"] = meshes[i % len(meshes)]
+            elif devices is not None:
+                place["device"] = devices[i % len(devices)]
             self.engines.append(engine_cls(
-                model, replica=f"{replica_prefix}{i}", device=dev,
+                model, replica=f"{replica_prefix}{i}", **place,
                 **engine_kwargs))
 
     # ------------------------------------------------------------ lifecycle

@@ -107,6 +107,46 @@ _HEALTH_CODE = {"healthy": 0, "degraded": 1, "draining": 2, "stopped": 3,
 # of two so long-prompt traffic stops minting a program per page increment
 _PREFILL_POW2_PAGES = 4
 
+#: the mesh axis tensor-parallel serving shards over (pool KV-head dim,
+#: Megatron weight splits) — the Fleet mp axis name, serving-side
+_MP_AXIS = "model"
+
+
+def _normalize_mesh(mesh):
+    """``ServingEngine(mesh=...)`` input -> ``(jax Mesh | None, mp)``.
+
+    Accepts a :class:`jax.sharding.Mesh` with a ``"model"`` axis, a
+    :class:`paddle_tpu.distributed.ProcessMesh` carrying a ``"model"``
+    dim, or a flat sequence of devices (meshed over one ``"model"``
+    axis).  A 1-sized model axis degrades to unsharded serving on that
+    single device (mp=1, plain ``device=`` placement) so a dp pool over
+    mp-sized submeshes handles ``mp=1`` carves uniformly.  Returns
+    ``(mesh, mp, solo_device)``."""
+    if mesh is None:
+        return None, 1, None
+    if hasattr(mesh, "jax_mesh"):        # distributed ProcessMesh
+        if _MP_AXIS not in mesh.dim_names:
+            raise ValueError(
+                f"ProcessMesh {mesh!r} has no '{_MP_AXIS}' dim — serving "
+                f"tensor parallelism shards over a '{_MP_AXIS}' axis")
+        mesh = mesh.jax_mesh
+    if isinstance(mesh, jax.sharding.Mesh):
+        if _MP_AXIS not in mesh.axis_names:
+            raise ValueError(
+                f"mesh axes {mesh.axis_names} carry no '{_MP_AXIS}' axis "
+                f"— serving tensor parallelism shards over '{_MP_AXIS}'")
+        mp = int(mesh.shape[_MP_AXIS])
+        devs = list(mesh.devices.flat)
+    else:                                # flat device sequence
+        devs = list(mesh)
+        if not devs:
+            raise ValueError("mesh= device list must be non-empty")
+        mp = len(devs)
+        mesh = jax.sharding.Mesh(np.array(devs), (_MP_AXIS,))
+    if mp > 1:
+        return mesh, mp, None
+    return None, 1, devs[0]
+
 
 class RequestRejectedError(RuntimeError):
     """Raised by submit() for requests the engine can never serve (too long
@@ -294,7 +334,7 @@ class ServingEngine:
                  speculative_k=0, draft_max_ngram=3, draft_min_ngram=1,
                  replica="0", device=None, health_gating=True, slo=None,
                  kv_dtype=None, weight_dtype=None, numeric_guard=None,
-                 prefill_chunk_tokens=None):
+                 prefill_chunk_tokens=None, mesh=None):
         self._model = model
         # chunked prefill (README "Flash decode & chunked prefill"):
         # prompts longer than N tokens are admitted IMMEDIATELY and
@@ -361,6 +401,27 @@ class ServingEngine:
         # the 503 fold instead (one dead replica must not fail the fleet)
         self._health_gating = bool(health_gating)
         self._device = device
+        # tensor-parallel serving (README "Tensor-parallel serving"):
+        # mesh= shards this engine's programs SPMD over a "model" mesh
+        # axis — paged KV pools on the KV-head dim, decoder weights
+        # Megatron-style (qkv/ffn1 column-, out_proj/ffn2 row-parallel),
+        # page table / seq_lens / sampler state host-side and replicated
+        # so the scheduler, prefix sharing and admission logic never see
+        # the second device axis.  Accepts a jax.sharding.Mesh (an axis
+        # named "model"), a distributed ProcessMesh with a "model" dim,
+        # or a flat device sequence (meshed over one "model" axis).
+        self._mesh, self._mp, solo = _normalize_mesh(mesh)
+        if mesh is not None and device is not None:
+            raise ValueError(
+                "device= and mesh= are mutually exclusive: a dp replica "
+                "commits to ONE device, an mp engine to a mesh (compose "
+                "them via ReplicaPool(devices=..., mp=...))")
+        if solo is not None:    # 1-sized mesh = plain dp placement
+            self._device = device = solo
+        # mp program families get their own perf-attribution suffix
+        # (decode@mp2, prefill/<b>@mp2, ...) and program-store keys, so an
+        # mp=1 engine's programs stay byte-identical to pre-mesh builds
+        self._mp_suffix = f"@mp{self._mp}" if self._mp > 1 else ""
         if adapter is not None:
             self._adapter = adapter
         elif kv_dtype == "int8":
@@ -369,6 +430,13 @@ class ServingEngine:
             self._adapter = QuantizedGPTAdapter(model, page_size)
         else:
             self._adapter = GPTAdapter(model, page_size)
+        if self._mp > 1:
+            self._adapter.validate_mp(self._mp)
+            # the adapter carries the mesh so the TPU flash kernels trace
+            # under mp_shard_scope (each shard sweeps its local KV heads);
+            # off-TPU the jnp reference paths are GSPMD-partitioned from
+            # the operand shardings and the scope is a no-op
+            self._adapter.mp_mesh = self._mesh
         self.page_size = int(page_size)
         self.num_slots = int(num_slots)
         cap = self._adapter.max_model_len
@@ -382,8 +450,14 @@ class ServingEngine:
         # HBM accounting (quantized serving satellite): every page costs
         # adapter.page_bytes() across all layers, K+V, scale pools
         # included — BlockManager carries it so capacity math, stats()
-        # and /statusz all read one number
-        self._bytes_per_page = int(self._adapter.page_bytes())
+        # and /statusz all read one number.  Under mp the pools shard the
+        # KV-head dim, so a page costs 1/mp of the global bytes PER CHIP —
+        # capacity math (max_resident_sequences, admission pre-flight
+        # against PADDLE_HBM_BUDGET_BYTES) is denominated in per-shard
+        # bytes: a 2-way-sharded pool holds 2x slots per chip at the same
+        # HBM budget.  Exact division: page_bytes is linear in
+        # num_kv_heads, which validate_mp pinned divisible by mp.
+        self._bytes_per_page = int(self._adapter.page_bytes()) // self._mp
         self._pool_dtype = "int8" if self.kv_dtype == "int8" \
             else str(self._adapter.dtype)
         self._bm = self._new_block_manager()
@@ -401,6 +475,16 @@ class ServingEngine:
             self._params = jax.device_put(self._params, device)
             self._bufs = jax.device_put(self._bufs, device)
             self._pools = jax.device_put(self._pools, device)
+        elif self._mesh is not None:
+            # mp placement: commit weights with their Megatron annotations
+            # and pools with the KV-head sharding — GSPMD propagates the
+            # layouts through the unchanged adapter closures, so every
+            # program family compiles ONCE as a single SPMD program (not
+            # per shard), and the uncommitted host arrays (table/lens/
+            # ids/temps) replicate onto the mesh automatically
+            self._params = self._shard_tree(self._params)
+            self._bufs = self._shard_tree(self._bufs)
+            self._pools = self._shard_pools(self._pools)
         from ..text.models._decode import (make_batched_sampler,
                                            make_guarded_batched_sampler)
 
@@ -643,6 +727,8 @@ class ServingEngine:
             if owner == "kv.pages":
                 meta = {
                     "kind": "kv",
+                    # per-shard when mp > 1 (shard= below): the unit the
+                    # per-chip capacity math is denominated in
                     "bytes_per_page": self._bytes_per_page,
                     "page_size": self.page_size,
                     "num_pages": self._num_pages,
@@ -652,6 +738,13 @@ class ServingEngine:
                 }
             elif owner == "kv.scales":
                 meta = {"kind": "kv_scales"}
+            if meta is not None and self._mp > 1:
+                # sharded pools: label the owner with the mesh split so
+                # ledger.report()'s per-device view can divide the global
+                # array bytes by the shard count (live_arrays and the
+                # sources both report GLOBAL nbytes, so reconciliation
+                # still accounts 100% of live bytes either way)
+                meta["shard"] = f"{_MP_AXIS}:{self._mp}"
             self._mem_regs.append(led.register(
                 owner, _pools_src(idx), replica=self.replica, meta=meta))
 
@@ -680,12 +773,37 @@ class ServingEngine:
                 "model.weights_int8", _named_src("bufs", is_q),
                 replica=self.replica, meta={"kind": "weights_int8"}))
 
+    # --------------------------------------------------------- mp sharding
+    def _shard_tree(self, tree):
+        """Commit a params/buffers dict to the mesh with each leaf's
+        Megatron annotation (adapter.param_pspec; unmatched leaves
+        replicate).  device_put with a NamedSharding — the same
+        shard_tensor mechanics as distributed.auto_parallel, minus the
+        Tensor wrapper (the engine holds raw jax arrays)."""
+        from jax.sharding import NamedSharding
+
+        return {k: jax.device_put(
+            v, NamedSharding(self._mesh,
+                             self._adapter.param_pspec(k, _MP_AXIS)))
+            for k, v in tree.items()}
+
+    def _shard_pools(self, pools):
+        """Commit a fresh pool tuple to the mesh on the KV-head dim (the
+        adapter owns the per-pool specs — the quantized 4-tuple shards
+        its scale pools alongside the payloads)."""
+        from jax.sharding import NamedSharding
+
+        specs = self._adapter.pool_pspecs(_MP_AXIS)
+        return tuple(jax.device_put(p, NamedSharding(self._mesh, s))
+                     for p, s in zip(pools, specs))
+
     def _new_block_manager(self):
         return BlockManager(self._num_pages, self.page_size,
                             prefix_sharing=self._prefix_sharing,
                             replica=self.replica,
                             bytes_per_page=self._bytes_per_page,
-                            pool_dtype=self._pool_dtype)
+                            pool_dtype=self._pool_dtype,
+                            shards=self._mp)
 
     def _set_pool_gauges(self):
         self._m_kv_bytes_tok.set(self._bytes_per_page / self.page_size)
@@ -1093,10 +1211,18 @@ class ServingEngine:
         what they were before the guard existed."""
         return ("nguard",) if self._numeric_guard else ()
 
+    def _mp_key(self):
+        """Program-store key component for the tensor-parallel variant.
+        Pool shapes stay GLOBAL under GSPMD, so without this an mp engine
+        sharing the model with an unsharded one would collide with its
+        cached single-device programs.  Empty at mp=1 — pre-mesh keys
+        (and trace counters) stay byte-for-byte identical."""
+        return ("mp", self._mp) if self._mp > 1 else ()
+
     def _step_program(self):
         key = ("serve_step", self.num_slots, self.table_width,
                self._pools[0].shape, str(self._pools[0].dtype),
-               self._top) + self._guard_key()
+               self._top) + self._guard_key() + self._mp_key()
         n = len(self._pools)  # pools are DONATED; count is adapter-defined
 
         def build():
@@ -1137,7 +1263,7 @@ class ServingEngine:
         k_pad = self._spec_k
         key = ("verify", k_pad, self.num_slots, self.table_width,
                self._pools[0].shape, str(self._pools[0].dtype),
-               self._top) + self._guard_key()
+               self._top) + self._guard_key() + self._mp_key()
         n = len(self._pools)
 
         def build():
@@ -1189,7 +1315,7 @@ class ServingEngine:
     def _prefill_program(self, s_pad):
         key = ("serve_prefill", s_pad, self.table_width,
                self._pools[0].shape, str(self._pools[0].dtype),
-               self._top) + self._guard_key()
+               self._top) + self._guard_key() + self._mp_key()
         n = len(self._pools)
 
         def build():
@@ -1228,7 +1354,7 @@ class ServingEngine:
         unchanged; pools are donated from position 4."""
         key = ("serve_prefill_chunk", c_pad, self.table_width,
                self._pools[0].shape, str(self._pools[0].dtype),
-               self._top) + self._guard_key()
+               self._top) + self._guard_key() + self._mp_key()
         n = len(self._pools)
 
         def build():
@@ -1358,6 +1484,11 @@ class ServingEngine:
         self._pools = tuple(self._adapter.init_pools(self._num_pages + 1))
         if self._device is not None:
             self._pools = jax.device_put(self._pools, self._device)
+        elif self._mesh is not None:
+            # mp restart: the rebuilt pools re-commit to the mesh with the
+            # same KV-head sharding, so re-admission dispatches land on
+            # the cached SPMD programs (byte-identical ids, no retrace)
+            self._pools = self._shard_pools(self._pools)
         self._set_pool_gauges()
         self._reset_host_buffers()
         with self._lock:
@@ -1761,16 +1892,16 @@ class ServingEngine:
     # Extension points MultiTenantEngine fills in; the base engine's
     # returns keep every dispatch signature and program family unchanged.
     def _prefill_family(self, s_pad):
-        return f"prefill/{s_pad}{self._fam_suffix}"
+        return f"prefill/{s_pad}{self._fam_suffix}{self._mp_suffix}"
 
     def _prefill_chunk_family(self, c):
-        return f"prefill_chunk/{c}{self._fam_suffix}"
+        return f"prefill_chunk/{c}{self._fam_suffix}{self._mp_suffix}"
 
     def _decode_family(self):
-        return f"decode{self._flash_tag}{self._fam_suffix}"
+        return f"decode{self._flash_tag}{self._fam_suffix}{self._mp_suffix}"
 
     def _verify_family(self):
-        return f"verify/k{self._spec_k}{self._fam_suffix}"
+        return f"verify/k{self._spec_k}{self._fam_suffix}{self._mp_suffix}"
 
     def _prefill_extra(self, req):
         """Host arrays appended to the prefill dispatch (adapter ids,
@@ -2241,8 +2372,10 @@ class ServingEngine:
             "kv_dtype": self.kv_dtype,
             "weight_dtype": self.weight_dtype,
             "pool_dtype": self._pool_dtype,
+            # per-shard under mp (the per-chip capacity unit)
             "bytes_per_page": self._bytes_per_page,
             "kv_bytes_per_token": self._bytes_per_page / self.page_size,
+            "mp": self._mp,
             "numeric_guard": self._numeric_guard,
             "prefill_chunk_tokens": self._chunk_tokens,
             "prefilling_slots": sum(
@@ -2270,6 +2403,11 @@ class ServingEngine:
         st["memory"] = {
             "owners": _obs_memory.ledger().owner_rows(replica=self.replica),
             "pool_bytes_by_dtype": self.pool_bytes_by_dtype(),
+            # per-chip residency under mp (global // mp — the head dim
+            # splits exactly; == pool_bytes_by_dtype at mp=1)
+            "pool_shard_bytes_by_dtype": {
+                dt: b // self._mp
+                for dt, b in self.pool_bytes_by_dtype().items()},
             "fixed_bytes": self._fixed_bytes,
             "committed_pages": self._committed_pages,
             "hbm_budget_bytes": _obs_memory.hbm_budget_bytes(),

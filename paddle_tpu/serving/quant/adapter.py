@@ -69,6 +69,16 @@ class QuantizedGPTAdapter(GPTAdapter):
         payload-only view used to hide (ISSUE 12 satellite fix)."""
         return (("kv.pages", (0, 1)), ("kv.scales", (2, 3)))
 
+    def pool_pspecs(self, axis="model"):
+        """Payload pools [L, P, ps, h, d] AND scale pools [L, P, ps, h]
+        both shard the KV-head dim — a shard dequantizes its heads with
+        its own scale columns, no cross-shard traffic."""
+        from jax.sharding import PartitionSpec as P
+
+        payload = P(None, None, None, axis, None)
+        scales = P(None, None, None, axis)
+        return (payload, payload, scales, scales)
+
     def _layer_caches(self, pools, table, lens, tag):
         from ...tensor.tensor import Tensor
 

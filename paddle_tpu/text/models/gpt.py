@@ -569,6 +569,34 @@ _TP_DIM = {
 }
 
 
+def mp_param_specs(axis="model"):
+    """Suffix -> ``PartitionSpec`` map for Megatron-style tensor
+    parallelism of a decoder block's parameters over one mesh axis —
+    the serving-side reading of :data:`_TP_DIM` (qkv/ffn1
+    column-parallel, out_proj/ffn2 row-parallel).  The qkv projection is
+    HEAD-MAJOR (``[heads, 3, head_dim]`` flattened), so a contiguous
+    column split hands each shard whole (q, k, v) head triples — the
+    layout the per-shard paged KV pools line up with.
+
+    Keys are dotted-name suffixes (match with ``name.endswith``), so one
+    map covers every layer of ``named_parameters()``.  ``weight_int8``
+    buffers (quantization.Int8Linear payloads) shard exactly like the
+    full-precision weights they replace; anything unmatched (embeddings,
+    LayerNorms, the row-parallel biases) is replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    specs = {}
+    for name, dim in _TP_DIM.items():
+        ndim = 2 if name.endswith(".weight") else 1
+        entries = [None] * ndim
+        entries[dim] = axis
+        specs["." + name] = P(*entries)
+        if name.endswith(".weight"):
+            specs["." + name + "_int8"] = P(*entries)
+    return specs
+
+
 def stack_block_params(model: GPTModel, pp: int, order="stage"):
     """Stack the (structurally identical) decoder blocks' parameters into
     [pp, layers_per_stage, ...] pytrees for the SPMD pipeline engine.
