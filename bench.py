@@ -1029,6 +1029,109 @@ def _serving_mixed_report(chunk_tokens=32):
     }
 
 
+def _measure_serving_warmup(arm="cold", S0=32, max_new=32, num_slots=4,
+                            page_size=16, model_kwargs=None):
+    """One arm of the cold-vs-warm first-token comparison.
+
+    ``cold``: fresh engine, first request pays every trace+compile, the
+    resulting program-store key set is captured to the manifest path in
+    ``BENCH_WARMUP_MANIFEST``.  ``warm``: fresh process + fresh same-seed
+    model, ``engine.warmup(manifest)`` replays the keys BEFORE admission,
+    then the same request must dispatch with ZERO new traces
+    (``first_request_traces``) and a compile-free TTFT."""
+    import os
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models import GPTForCausalLM
+
+    path = os.environ.get("BENCH_WARMUP_MANIFEST", "")
+    kw = dict(vocab_size=128, hidden_size=128, num_hidden_layers=4,
+              num_attention_heads=4, max_position_embeddings=256)
+    kw.update(model_kwargs or {})
+    paddle.seed(0)
+    m = GPTForCausalLM(**kw).eval()
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(1, kw["vocab_size"], (S0,)).astype("int64")
+    engine = ServingEngine(m, num_slots=num_slots, page_size=page_size,
+                           max_model_len=S0 + max_new)
+    winfo = None
+    t0 = time.time()
+    if arm == "warm":
+        if not path or not os.path.exists(path):
+            raise RuntimeError(
+                "warm arm needs BENCH_WARMUP_MANIFEST pointing at the "
+                "cold arm's captured manifest")
+        winfo = engine.warmup(path)
+    traces0 = engine.program_traces()
+    with engine:
+        h = engine.submit(prompt, max_new_tokens=max_new)
+        ids = [int(t) for t in h.result(timeout=600)]
+        first_request_traces = engine.program_traces() - traces0
+        t_first = time.time() - t0
+        bd = h.ttft_breakdown()
+        if arm == "cold" and path:
+            engine.capture_manifest().save(path)
+    from paddle_tpu.observability import programs as _progs
+
+    return {
+        "arm": arm,
+        "ttft_s": round(bd["ttft_s"], 4),
+        "queue_s": round(bd["queue_s"], 4),
+        "compile_s": round(bd["compile_s"], 4),
+        "prefill_s": round(bd["prefill_s"], 4),
+        "cold": bool(bd["cold"]),
+        "first_request_traces": int(first_request_traces),
+        # warmup (or nothing, cold arm) + start + first full request:
+        # the operator-visible "restart to first token" wall time
+        "startup_to_done_s": round(t_first, 4),
+        "warmup": winfo,
+        "ledger_rows": len(_progs.ledger().rows()),
+        "ids": ids,
+    }
+
+
+def _serving_warmup_report():
+    """Cold vs warm restart in subprocess arms sharing one manifest file:
+    the cold arm pays (and captures) the compiles, the warm arm replays
+    them pre-admission.  ``warm_traces`` is the PR's invariant — a warmed
+    engine's first real request mints ZERO traces — and is gated at
+    tolerance 0 in perf_baselines.json."""
+    import os
+    import tempfile
+
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="warmup_manifest_")
+    os.close(fd)
+    try:
+        cold = _section("serving_warmup", BENCH_WARMUP_ARM="cold",
+                        BENCH_WARMUP_MANIFEST=path)
+        warm = _section("serving_warmup", BENCH_WARMUP_ARM="warm",
+                        BENCH_WARMUP_MANIFEST=path)
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    return {
+        "cold_ttft_s": cold["ttft_s"],
+        "warm_ttft_s": warm["ttft_s"],
+        "cold_compile_s": cold["compile_s"],
+        "warm_compile_s": warm["compile_s"],
+        "cold_startup_to_done_s": cold["startup_to_done_s"],
+        "warm_startup_to_done_s": warm["startup_to_done_s"],
+        "warm_traces": warm["first_request_traces"],
+        "warm_warmup_s": (warm["warmup"] or {}).get("seconds"),
+        "warmed_programs": (warm["warmup"] or {}).get("warmed"),
+        "ttft_speedup": round(cold["ttft_s"] / max(warm["ttft_s"], 1e-9), 2),
+        "greedy_identical": cold["ids"] == warm["ids"],
+        "note": ("cold arm captures the program-store manifest after "
+                 "serving; warm arm replays it before admission — "
+                 "warm_traces == 0 is the warmup invariant (gated at "
+                 "tolerance 0)"),
+    }
+
+
 def _measure_tracing_overhead(iters=30):
     """Tracing-enabled vs disabled step-time delta on the two instrumented
     hot paths (the < 2% disabled-path contract from the observability PR):
@@ -1234,6 +1337,11 @@ def _run_section(name):
         import os
 
         return _measure_serving_mp(mp=int(os.environ.get("BENCH_MP", "1")))
+    if name == "serving_warmup":
+        import os
+
+        return _measure_serving_warmup(
+            arm=os.environ.get("BENCH_WARMUP_ARM", "cold"))
     if name == "tracing_overhead":
         return _measure_tracing_overhead()
     if name == "numerics_overhead":
@@ -1578,6 +1686,11 @@ def main():
             # on decode ITL p50/p95, TTFT, tokens/sec, greedy parity
             out = {"serving_mixed": _serving_mixed_report(
                 int(_argv_value("--chunk-tokens") or 32))}
+        elif _argv_has("--warmup"):
+            # --warmup: cold restart (first request pays the compiles,
+            # manifest captured) vs warm restart (manifest replayed before
+            # admission) — warm arm's first request must mint zero traces
+            out = {"serving_warmup": _serving_warmup_report()}
         else:
             out = {"serving": _section("serving")}
         if "--emit-metrics" in sys.argv:

@@ -38,6 +38,7 @@ from ..framework import random as _rng
 from ..framework.state import no_grad_ctx
 from ..observability import numerics as _numerics
 from ..observability import perf as _perf
+from ..observability import programs as _obs_programs
 from ..observability import tracing as _tracing
 from ..optimizer.lr import LRScheduler
 from ..profiler import events as _prof_events
@@ -274,9 +275,16 @@ class TrainStep:
         if new_variant:
             # first dispatch of a variant = trace + XLA compile (+ async
             # enqueue); record it and refresh the donation footprint
+            compile_s = perf_counter() - t_call
             self._m_compiles.inc()
-            self._m_compile_s.set(perf_counter() - t_call)
+            self._m_compile_s.set(compile_s)
             self._m_donated.set(self._donated_bytes())
+            # program-lifecycle ledger row: TrainStep variants are mints
+            # too (keyed by their perf family — no model program store)
+            _obs_programs.ledger().record_compile(
+                fn._perf_family, compile_s, family=fn._perf_family,
+                kind="train_step", replica="-",
+                trace_id=_tracing.current_trace_id())
             if (os.environ.get("PADDLE_TRAINSTEP_COST", "0").lower()
                     not in ("", "0", "false", "no")) or _prof_events._ACTIVE:
                 self.cost_analysis(_fn=fn)

@@ -30,8 +30,8 @@ Env flags (README "Distributed tracing & forensics"):
 from __future__ import annotations
 
 from . import (  # noqa: F401
-    faults, flight_recorder, memory, numerics, perf, slo, telemetry, tracing,
-    watchdog,
+    faults, flight_recorder, memory, numerics, perf, programs, slo, telemetry,
+    tracing, watchdog,
 )
 from .faults import FaultPlan  # noqa: F401
 from .memory import MemoryLedger, MemoryWatchdog  # noqa: F401
@@ -40,6 +40,7 @@ from .numerics import (  # noqa: F401
     collect_operator_stats, disable_tensor_checker, enable_tensor_checker,
 )
 from .perf import ProgramTable  # noqa: F401
+from .programs import ProgramLedger, WarmupManifest  # noqa: F401
 from .slo import RequestTimeline, SLOAccountant, SLOPolicy  # noqa: F401
 from .flight_recorder import (  # noqa: F401
     FlightRecorder, get_flight_recorder, install_crash_handlers,
@@ -58,10 +59,11 @@ from .watchdog import (  # noqa: F401
 
 __all__ = [
     "tracing", "flight_recorder", "watchdog", "telemetry", "faults",
-    "perf", "slo", "memory", "numerics", "NumericsMonitor",
+    "perf", "programs", "slo", "memory", "numerics", "NumericsMonitor",
     "TensorCheckerConfig", "enable_tensor_checker", "disable_tensor_checker",
     "check_numerics", "collect_operator_stats", "ProgramTable", "SLOPolicy", "SLOAccountant",
     "RequestTimeline", "MemoryLedger", "MemoryWatchdog",
+    "ProgramLedger", "WarmupManifest",
     "Span", "Tracer", "span", "event", "new_trace_id", "current_trace_id",
     "open_spans", "merge_rank_traces",
     "FlightRecorder", "get_flight_recorder", "install_crash_handlers",

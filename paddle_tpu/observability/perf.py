@@ -717,3 +717,41 @@ def jit_cost_thunk(jitted, args):
                 _memory_analysis_dict(comp))
 
     return thunk
+
+
+def jit_analysis_thunk(jitted, args):
+    """:func:`jit_cost_thunk` with a lifecycle split for the program
+    ledger: the re-lower is timed as a trace-seconds estimate and the
+    backend compile separately, alongside flops / bytes-accessed /
+    executable size / memory analysis — one dict per program, resolved
+    lazily (never on a scrape).  Same weakref discipline as
+    :func:`jit_cost_thunk`: a pending thunk must not pin a dead model."""
+    import weakref
+
+    import jax
+
+    shapes = jax.tree_util.tree_map(_shape_struct, args)
+    ref = weakref.ref(jitted)
+
+    def thunk():
+        fn = ref()
+        if fn is None:
+            raise RuntimeError(
+                "compiled program was garbage-collected before its "
+                "analysis resolved")
+        t0 = perf_counter()
+        low = fn.lower(*shapes)
+        t1 = perf_counter()
+        comp = low.compile()
+        t2 = perf_counter()
+        ca = comp.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else (ca or {})
+        mem = _memory_analysis_dict(comp)
+        return {"trace_s": t1 - t0,
+                "backend_compile_s": t2 - t1,
+                "flops": float(ca.get("flops", 0.0)),
+                "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+                "executable_bytes": (mem or {}).get("generated_code_bytes"),
+                "memory": mem}
+
+    return thunk

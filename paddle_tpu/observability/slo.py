@@ -169,13 +169,32 @@ class SLOAccountant:
     def observe(self, handle, met_override=None) -> SLOReport:
         """Evaluate one finished request and refresh counters/gauges.
         ``met_override=False`` forces a miss regardless of the timeline
-        (deadline-expired requests missed by definition)."""
+        (deadline-expired requests missed by definition).
+
+        Cold-start forensics (PR 16): a miss that would have been a MET
+        had the request not waited out a program compile (the engine's
+        ledger windows accumulate ``handle.compile_s``) is labeled
+        ``cause=cold_start`` — a distinct child of the same counter, so
+        existing ``met=`` series stay untouched and total misses remain
+        the sum across causes."""
         tl = timeline_of(handle)
         rep = self.policy.evaluate(tl)
         if met_override is not None and rep.met != bool(met_override):
             rep = dataclasses.replace(
                 rep, met=bool(met_override),
                 good_tokens=rep.tokens if met_override else 0)
+        cause = None
+        compile_s = float(getattr(handle, "compile_s", 0.0) or 0.0)
+        if not rep.met and met_override is None and compile_s > 0.0:
+            # re-evaluate the counterfactual timeline with the compile
+            # stall subtracted from every stamp after submission
+            warm = RequestTimeline(
+                submitted_at=tl.submitted_at,
+                token_times=tuple(t - compile_s for t in tl.token_times),
+                finished_at=None if tl.finished_at is None
+                else tl.finished_at - compile_s)
+            if self.policy.evaluate(warm).met:
+                cause = "cold_start"
         end = tl.finished_at if tl.finished_at is not None \
             else tl.submitted_at
         with self._lock:
@@ -184,7 +203,10 @@ class SLOAccountant:
             self._evaluated += 1
             self._met += 1 if rep.met else 0
             rows = list(self._window)
-        self._m_requests.inc(met="true" if rep.met else "false")
+        if cause is not None:
+            self._m_requests.inc(met="false", cause=cause)
+        else:
+            self._m_requests.inc(met="true" if rep.met else "false")
         self._m_tokens.inc(rep.tokens)
         if rep.good_tokens:
             self._m_good_tokens.inc(rep.good_tokens)

@@ -7,7 +7,12 @@ What any real multi-host deployment scrapes first:
 - ``/healthz`` — liveness: ``{"status": "ok", "uptime_s": …, "rank": …}``;
 - ``/statusz`` — the human page: engine occupancy / queue depth / slot
   table / page-pool utilization (via registered status providers),
-  in-flight spans, watchdog state, last flight-record path.
+  in-flight spans, watchdog state, last flight-record path.  Registered
+  sections include ``memory`` (the PR-12 ledger), ``perf_programs``
+  (the PR-7 roofline table) and ``programs`` (the PR-16 program
+  lifecycle ledger: per-key compile seconds, cold/warm provenance, the
+  trace id that paid each stall, and whether a compile window is open
+  right now — the wedged-compile vs wedged-scheduler discriminator).
 
 Opt-in spellings: ``observability.serve(port)`` from code, or set
 ``PADDLE_TELEMETRY_PORT`` and let :class:`ServingEngine.start` wire it

@@ -32,6 +32,7 @@ from time import monotonic
 
 from ..profiler import metrics as _metrics
 from . import flight_recorder as _flight
+from . import programs as _programs
 
 logger = logging.getLogger("paddle_tpu.observability")
 
@@ -243,8 +244,13 @@ class ServingWatchdog:
             stamp = getattr(e, "_progress_t", None)
             if stamp is None or not getattr(e, "_started", False):
                 continue
-            if getattr(e, "_compiling", False):
-                continue  # first dispatch = XLA compile, slow but not stuck
+            if _programs.ledger().compiling(e):
+                # the program ledger holds an OPEN compile window for this
+                # engine: first dispatch = XLA compile, slow but not stuck.
+                # The ledger (not an engine flag someone forgot to clear)
+                # is the authority, and its compile_in_progress gauge keeps
+                # the stall visible on /statusz while we stay quiet.
+                continue
             age = monotonic() - stamp
             if age <= self.deadline_s or not self._busy():
                 if stamp != self._fired_at_stamp:

@@ -87,6 +87,22 @@ class GPTAdapter:
             bufs = {k: b._value for k, b in self.model.named_buffers()}
         return params, bufs
 
+    def signature(self):
+        """Static geometry a compiled program is specialized on, as a
+        JSON-plain dict.  Stamped into :class:`~paddle_tpu.observability
+        .programs.WarmupManifest` metadata so a manifest captured against
+        one model is refused by an engine whose replay would only mint
+        useless programs."""
+        return {"adapter": type(self).__name__,
+                "kv_dtype": self.kv_dtype,
+                "n_pools": int(self.n_pools),
+                "num_layers": int(self.num_layers),
+                "num_kv_heads": int(self.num_kv_heads),
+                "head_dim": int(self.head_dim),
+                "page_size": int(self.page_size),
+                "max_model_len": int(self.max_model_len),
+                "dtype": str(self.dtype)}
+
     # --------------------------------------------------------- mp sharding
     def validate_mp(self, mp):
         """Divisibility check for ``ServingEngine(mesh=...)``: the pools

@@ -27,6 +27,8 @@ Count divisibility is validated with a clear error either way.
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 
@@ -45,7 +47,7 @@ class ReplicaPool:
     """
 
     def __init__(self, model, replicas=None, devices=None, replica_prefix="",
-                 engine_cls=None, mp=None, **engine_kwargs):
+                 engine_cls=None, mp=None, warmup=None, **engine_kwargs):
         from ..engine import ServingEngine
 
         if engine_cls is None:
@@ -117,6 +119,17 @@ class ReplicaPool:
         self.model = model
         self.devices = devices
         self.meshes = meshes
+        # warm replica spin-up: a WarmupManifest (object or saved path)
+        # replayed by every engine BEFORE its scheduler starts, so a fresh
+        # pool's first real request on any replica mints zero traces.
+        # The model (and program store) is shared: replica 0's replay
+        # warms same-shaped siblings for free, and each engine skips keys
+        # its store already holds traced.
+        if isinstance(warmup, (str, os.PathLike)):
+            from ...observability.programs import WarmupManifest
+
+            warmup = WarmupManifest.load(warmup)
+        self.warmup_manifest = warmup
         self.engines = []
         for i in range(replicas):
             place = {}
@@ -131,6 +144,8 @@ class ReplicaPool:
     # ------------------------------------------------------------ lifecycle
     def start(self):
         for e in self.engines:
+            if self.warmup_manifest is not None and not e._started:
+                e.warmup(self.warmup_manifest)
             e.start()
         return self
 

@@ -100,10 +100,14 @@ class ServingCluster:
             ids = h.result(timeout=120)
 
     ``**engine_kwargs`` configure every replica (num_slots, page_size,
-    prefix_sharing, ...).  Pass a prebuilt ``pool=`` / ``router=`` to
-    override construction; ``policy`` picks the routing policy
-    (``affinity`` default, ``random`` / ``round_robin`` / ``least_loaded``
-    as controls)."""
+    prefix_sharing, ...) — including ``warmup=`` (a
+    :class:`~paddle_tpu.observability.programs.WarmupManifest` or saved
+    path), which the pool replays on every replica before its scheduler
+    starts so the cluster's first request on any replica mints zero
+    traces.  Pass a prebuilt ``pool=`` / ``router=`` to override
+    construction; ``policy`` picks the routing policy (``affinity``
+    default, ``random`` / ``round_robin`` / ``least_loaded`` as
+    controls)."""
 
     def __init__(self, model=None, replicas=2, devices=None, pool=None,
                  router=None, policy="affinity", affinity_tokens=None,

@@ -91,6 +91,7 @@ from ..observability import faults as _faults
 from ..observability import memory as _obs_memory
 from ..observability import numerics as _numerics
 from ..observability import perf as _perf
+from ..observability import programs as _programs
 from ..observability import tracing as _tracing
 from ..resilience.retry import (EngineStoppedError, NumericFault,  # noqa: F401 — re-exported
                                 classify_failure)
@@ -222,6 +223,8 @@ class RequestHandle:
         self.token_times = []
         self.status = "queued"
         self.submitted_at = time.time()
+        self.admitted_at = None        # queue -> slot (first dispatch start)
+        self.compile_s = 0.0           # compile stalls this request waited out
         self.first_token_at = None
         self.finished_at = None
         self.first_token_iteration = None
@@ -287,6 +290,34 @@ class RequestHandle:
         if self.first_token_at is None:
             return None
         return self.first_token_at - self.submitted_at
+
+    # ------------------------------------------- TTFT decomposition (PR 16)
+    @property
+    def queue_s(self):
+        """Submit -> admission wait (None until admitted)."""
+        if self.admitted_at is None:
+            return None
+        return self.admitted_at - self.submitted_at
+
+    @property
+    def prefill_s(self):
+        """TTFT minus queueing minus compile stalls — the dispatch work
+        itself.  Defined as the remainder so the decomposition sums
+        exactly: ``queue_s + compile_s + prefill_s == ttft``."""
+        t = self.ttft
+        if t is None or self.queue_s is None:
+            return None
+        return max(0.0, t - self.queue_s - self.compile_s)
+
+    def ttft_breakdown(self):
+        """Cold-start forensics: where this request's first token went.
+        ``None`` until the first token lands."""
+        t = self.ttft
+        if t is None:
+            return None
+        return {"ttft_s": t, "queue_s": self.queue_s,
+                "compile_s": self.compile_s, "prefill_s": self.prefill_s,
+                "cold": self.compile_s > 0.0, "trace_id": self.trace_id}
 
 
 class _Slot:
@@ -613,6 +644,10 @@ class ServingEngine:
 
         self._m_ttft = _h("serving.ttft_seconds", "submit -> first token",
                           buckets=ttft_buckets)
+        self._m_ttft_cold = _h(
+            "serving.ttft_cold_seconds",
+            "submit -> first token for requests that paid a compile stall "
+            "(subset of serving.ttft_seconds)", buckets=ttft_buckets)
         self._m_itl = _h(
             "serving.inter_token_seconds", "per-sequence inter-token latency",
             buckets=itl_buckets)
@@ -851,6 +886,233 @@ class ServingEngine:
         self._thread.start()
         self._start_observability()
         return self
+
+    # ------------------------------------------------------------- warmup
+    def warmup(self, manifest):
+        """Replay a :class:`~paddle_tpu.observability.programs
+        .WarmupManifest` ahead of admission: every engine-owned key in the
+        manifest is compiled via an INERT dispatch (all lanes inactive —
+        scratch table rows, zero lengths — so the program computes junk
+        lanes nobody reads and the donated pools round-trip unchanged in
+        meaning).  After warmup the first real request dispatches with
+        zero new traces.
+
+        Accepts a manifest object, a saved path, or its JSON dict.  Keys
+        whose static axes (slot count, table width, pool shape/dtype,
+        sampler, guard, mp) don't match THIS engine are skipped, as are
+        keys a subclass's request-dependent extras can't replay.  Must run
+        before :meth:`start` — replay donates the live pools, which must
+        not race the scheduler thread."""
+        if self._started:
+            raise RuntimeError(
+                "warmup() must run before start(): replay dispatches "
+                "donate the live page pools")
+        if isinstance(manifest, (str, os.PathLike)):
+            manifest = _programs.WarmupManifest.load(manifest)
+        elif isinstance(manifest, dict):
+            manifest = _programs.WarmupManifest.from_json(manifest)
+        want = manifest.meta.get("adapter")
+        have = self._adapter_signature()
+        if want is not None and want != have:
+            raise ValueError(
+                f"manifest captured for adapter {want}, this engine is "
+                f"{have} — replaying would mint useless programs")
+        # replay must trace in eval mode, exactly like the scheduler
+        modes = [(m, m.training)
+                 for m in self._model.sublayers(include_self=True)]
+        self._model.eval()
+        t0 = time.perf_counter()
+        warmed, skipped = 0, []
+        try:
+            for key in manifest:
+                try:
+                    ok = self._warm_one(key)
+                except Exception as exc:
+                    _logger.warning("warmup: replay of %r failed: %r",
+                                    key, exc)
+                    ok = False
+                if ok:
+                    warmed += 1
+                    ent = _programs.ledger().entry(key, store=self._store())
+                    if ent is not None and ent.trace_id is None:
+                        ent.trace_id = "warmup"  # provenance: nobody paid
+                else:
+                    skipped.append(key)
+        finally:
+            for m, tr in modes:
+                m.training = tr
+        info = {"warmed": warmed, "skipped": len(skipped),
+                "seconds": round(time.perf_counter() - t0, 3)}
+        self._warmed = info
+        _logger.info("warmup: %(warmed)d programs in %(seconds).2fs "
+                     "(%(skipped)d keys skipped)", info)
+        return info
+
+    def capture_manifest(self):
+        """Snapshot this model's live program-store key set, stamped with
+        the adapter signature so :meth:`warmup` refuses a mismatched
+        model geometry."""
+        return _programs.WarmupManifest.capture(
+            self._model, meta={"adapter": self._adapter_signature()})
+
+    def _adapter_signature(self):
+        sig = getattr(self._adapter, "signature", None)
+        return sig() if callable(sig) else None
+
+    def _warm_one(self, key):
+        """Compile one manifest key if it belongs to this engine's static
+        configuration.  Returns True when the key is now warm."""
+        kind = key[0] if isinstance(key, tuple) and key else None
+        if kind == "serve_step" and key == self._step_store_key():
+            self._warm_step()
+            return True
+        if kind == "serve_prefill" and len(key) > 1 \
+                and key == self._prefill_store_key(key[1]):
+            self._warm_prefill(key[1])
+            return True
+        if kind == "serve_prefill_chunk" and len(key) > 1 \
+                and key == self._prefill_chunk_store_key(key[1]):
+            self._warm_prefill_chunk(key[1])
+            return True
+        if kind == "verify" and self._spec_k and len(key) > 1 \
+                and key == self._verify_store_key(self._spec_k):
+            self._warm_verify()
+            return True
+        return False
+
+    def _warm_step(self):
+        prog, traces = self._step_program()
+        n0 = traces[0]
+        if n0:
+            return
+        guard = self._numeric_guard
+        rkey = self._base_key
+        extra = self._step_extra()
+        tail = (self._numeric_inject(),) if guard else ()
+        args = (self._params, self._bufs, self._h_last, *self._pools,
+                self._h_table, self._h_lens, self._h_temps, rkey,
+                *extra, *tail)
+        win = _programs.ledger().compile_window(
+            self._step_store_key(), family=self._decode_family(),
+            replica=self.replica, device=self._device_label(),
+            store=self._store(), owner=self._model, engine=self)
+        win.attach(prog, args)
+        try:
+            if guard:
+                _tok, _bad, _st, *pools = prog(*args)
+            else:
+                _tok, *pools = prog(*args)
+            self._pools = tuple(pools)
+        finally:
+            win.close(traced=traces[0] > n0)
+
+    def _warm_prefill(self, s_pad):
+        prog, traces = self._prefill_program(s_pad)
+        n0 = traces[0]
+        if n0:
+            return
+        guard = self._numeric_guard
+        ids = np.zeros((1, s_pad), np.int64)
+        table = np.full((1, self.table_width), self._scratch, np.int32)
+        lens = np.asarray([s_pad], np.int32)   # junk K/V lands in scratch
+        temps = np.zeros((1,), np.float32)
+        rkey = self._base_key
+        extra = self._warmup_prefill_extra()
+        tail = (self._numeric_inject(1),) if guard else ()
+        args = (self._params, self._bufs, ids, *self._pools, table, lens,
+                temps, rkey, *extra, *tail)
+        win = _programs.ledger().compile_window(
+            self._prefill_store_key(s_pad),
+            family=self._prefill_family(s_pad), replica=self.replica,
+            device=self._device_label(), store=self._store(),
+            owner=self._model, engine=self)
+        win.attach(prog, args)
+        try:
+            if guard:
+                _tok, _bad, _st, *pools = prog(*args)
+            else:
+                _tok, *pools = prog(*args)
+            self._pools = tuple(pools)
+        finally:
+            win.close(traced=traces[0] > n0)
+
+    def _warm_prefill_chunk(self, c_pad):
+        prog, traces = self._prefill_chunk_program(c_pad)
+        n0 = traces[0]
+        if n0:
+            return
+        guard = self._numeric_guard
+        ids = np.zeros((1, c_pad), np.int64)
+        nvalid = np.asarray([c_pad], np.int32)
+        table = np.full((1, self.table_width), self._scratch, np.int32)
+        lens = np.zeros((1,), np.int32)
+        temps = np.zeros((1,), np.float32)
+        rkey = self._base_key
+        extra = self._warmup_prefill_extra()
+        tail = (self._numeric_inject(1),) if guard else ()
+        args = (self._params, self._bufs, ids, nvalid, *self._pools,
+                table, lens, temps, rkey, *extra, *tail)
+        win = _programs.ledger().compile_window(
+            self._prefill_chunk_store_key(c_pad),
+            family=self._prefill_chunk_family(c_pad), replica=self.replica,
+            device=self._device_label(), store=self._store(),
+            owner=self._model, engine=self)
+        win.attach(prog, args)
+        try:
+            if guard:
+                _tok, _bad, _st, *pools = prog(*args)
+            else:
+                _tok, *pools = prog(*args)
+            self._pools = tuple(pools)
+        finally:
+            win.close(traced=traces[0] > n0)
+
+    def _warm_verify(self):
+        prog, traces = self._verify_program()
+        n0 = traces[0]
+        if n0:
+            return
+        guard = self._numeric_guard
+        rkey = self._base_key
+        extra = self._verify_extra([])
+        tail = (self._numeric_inject(),) if guard else ()
+        args = (self._params, self._bufs, self._h_ids, *self._pools,
+                self._h_table, self._h_lens, self._h_dlen, self._h_temps,
+                rkey, *extra, *tail)
+        win = _programs.ledger().compile_window(
+            self._verify_store_key(self._spec_k),
+            family=self._verify_family(), replica=self.replica,
+            device=self._device_label(), store=self._store(),
+            owner=self._model, engine=self)
+        win.attach(prog, args)
+        try:
+            if guard:
+                _t, _a, _b, _s, *pools = prog(*args)
+            else:
+                _t, _a, *pools = prog(*args)
+            self._pools = tuple(pools)
+        finally:
+            win.close(traced=traces[0] > n0)
+
+    def _warmup_prefill_extra(self):
+        """Request-independent stand-in for :meth:`_prefill_extra` during
+        warmup replay (there is no request).  The base engine's extras
+        are empty; subclasses whose extras depend on the request override
+        this (or let the per-key try/except skip the key)."""
+        return self._prefill_extra(None)
+
+    def program_traces(self):
+        """Total trace count across this model's program store (serving
+        entries carry a ``[count]`` trace box; generate() pairs don't).
+        The warmup invariant — a warmed engine's first request mints
+        nothing — is asserted as a zero delta of this sum."""
+        total = 0
+        for ent in self._store().values():
+            if isinstance(ent, tuple) and len(ent) == 2 \
+                    and isinstance(ent[1], list) and ent[1] \
+                    and isinstance(ent[1][0], int):
+                total += ent[1][0]
+        return total
 
     def drain(self, timeout=600):
         """Graceful rundown: stop admitting (submits reject with reason
@@ -1195,14 +1457,29 @@ class ServingEngine:
     def _next_key(self):
         return jax.random.fold_in(self._base_key, next(self._key_counter))
 
-    def _program(self, key, build):
-        from ..text.models._decode import program_store
-
-        store = program_store(self._model)
+    def _program(self, key, build, family=None):
+        store = self._store()
         ent = store.get(key)
         if ent is None:
+            t0 = time.perf_counter()
             ent = store[key] = build()
+            # every store mint lands a ledger row (provenance + build
+            # wall); the dispatch site's compile window adds the stall
+            _programs.ledger().record_mint(
+                key, family=family or str(key[0]), replica=self.replica,
+                device=self._device_label(), store=store,
+                owner=self._model, build_s=time.perf_counter() - t0)
         return ent
+
+    def _device_label(self):
+        if self._mp > 1:
+            return f"mesh[{self._mp}]:{_MP_AXIS}"
+        if self._device is not None:
+            return str(self._device)
+        try:
+            return str(jax.devices()[0])
+        except Exception:
+            return None
 
     def _guard_key(self):
         """Program-store key component for the numeric-guard variant.
@@ -1219,10 +1496,35 @@ class ServingEngine:
         (and trace counters) stay byte-for-byte identical."""
         return ("mp", self._mp) if self._mp > 1 else ()
 
+    def _store(self):
+        from ..text.models._decode import program_store
+
+        return program_store(self._model)
+
+    # program-store key builders — shared by the mint sites, the dispatch
+    # sites' compile windows (ledger attribution), and warmup() replay
+    def _step_store_key(self):
+        return ("serve_step", self.num_slots, self.table_width,
+                self._pools[0].shape, str(self._pools[0].dtype),
+                self._top) + self._guard_key() + self._mp_key()
+
+    def _verify_store_key(self, k_pad):
+        return ("verify", k_pad, self.num_slots, self.table_width,
+                self._pools[0].shape, str(self._pools[0].dtype),
+                self._top) + self._guard_key() + self._mp_key()
+
+    def _prefill_store_key(self, s_pad):
+        return ("serve_prefill", s_pad, self.table_width,
+                self._pools[0].shape, str(self._pools[0].dtype),
+                self._top) + self._guard_key() + self._mp_key()
+
+    def _prefill_chunk_store_key(self, c_pad):
+        return ("serve_prefill_chunk", c_pad, self.table_width,
+                self._pools[0].shape, str(self._pools[0].dtype),
+                self._top) + self._guard_key() + self._mp_key()
+
     def _step_program(self):
-        key = ("serve_step", self.num_slots, self.table_width,
-               self._pools[0].shape, str(self._pools[0].dtype),
-               self._top) + self._guard_key() + self._mp_key()
+        key = self._step_store_key()
         n = len(self._pools)  # pools are DONATED; count is adapter-defined
 
         def build():
@@ -1253,7 +1555,7 @@ class ServingEngine:
 
             return step, traces
 
-        return self._program(key, build)
+        return self._program(key, build, family=self._decode_family())
 
     def _verify_program(self):
         """The compiled multi-token verification step (speculative
@@ -1261,9 +1563,7 @@ class ServingEngine:
         program store — one trace per (k, batch-shape, sampler) tuple,
         exactly like the plain decode step."""
         k_pad = self._spec_k
-        key = ("verify", k_pad, self.num_slots, self.table_width,
-               self._pools[0].shape, str(self._pools[0].dtype),
-               self._top) + self._guard_key() + self._mp_key()
+        key = self._verify_store_key(k_pad)
         n = len(self._pools)
 
         def build():
@@ -1295,7 +1595,7 @@ class ServingEngine:
 
             return verify, traces
 
-        return self._program(key, build)
+        return self._program(key, build, family=self._verify_family())
 
     def _prefill_bucket(self, S0):
         """Padded prefill width for a prompt of ``S0`` tokens: multiples of
@@ -1313,9 +1613,7 @@ class ServingEngine:
         return min(pages, self.table_width) * ps
 
     def _prefill_program(self, s_pad):
-        key = ("serve_prefill", s_pad, self.table_width,
-               self._pools[0].shape, str(self._pools[0].dtype),
-               self._top) + self._guard_key() + self._mp_key()
+        key = self._prefill_store_key(s_pad)
         n = len(self._pools)
 
         def build():
@@ -1343,7 +1641,7 @@ class ServingEngine:
 
             return prefill, traces
 
-        return self._program(key, build)
+        return self._program(key, build, family=self._prefill_family(s_pad))
 
     def _prefill_chunk_program(self, c_pad):
         """The compiled chunked-prefill step: the ``("serve_prefill_chunk",
@@ -1352,9 +1650,7 @@ class ServingEngine:
         asserted in tests).  ``nvalid`` rides as a 4th positional so the
         adapter's ``_split_extra`` tail (LoRA ids/pools) composes
         unchanged; pools are donated from position 4."""
-        key = ("serve_prefill_chunk", c_pad, self.table_width,
-               self._pools[0].shape, str(self._pools[0].dtype),
-               self._top) + self._guard_key() + self._mp_key()
+        key = self._prefill_chunk_store_key(c_pad)
         n = len(self._pools)
 
         def build():
@@ -1383,7 +1679,8 @@ class ServingEngine:
 
             return chunk, traces
 
-        return self._program(key, build)
+        return self._program(key, build,
+                             family=self._prefill_chunk_family(c_pad))
 
     @property
     def step_traces(self):
@@ -1618,6 +1915,8 @@ class ServingEngine:
             f"mode={req.mode!r} request reached the base engine scheduler")
 
     def _prefill(self, req, alloc, slot_idx):
+        if req.handle.admitted_at is None:   # TTFT decomposition: queue_s
+            req.handle.admitted_at = time.time()
         S0 = len(req.prompt)
         s_pad = self._prefill_bucket(S0)
         ids = np.zeros((1, s_pad), np.int64)
@@ -1640,10 +1939,17 @@ class ServingEngine:
             _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
                 prog, (self._params, self._bufs, ids, *self._pools,
                        table, lens, temps, rkey, *extra, *tail)))
-        # first dispatch of this program = minutes-long XLA compile: flag it
-        # so the serving watchdog doesn't read a legitimate compile stall
-        # as a wedged scheduler
-        self._compiling = n0 == 0
+        # first dispatch of this program = minutes-long XLA compile: the
+        # ledger compile window flags self._compiling for the watchdog/
+        # health paths, holds programs.compile_in_progress up, and bills
+        # the stall to this request's TTFT decomposition
+        win = _programs.ledger().compile_window(
+            self._prefill_store_key(s_pad), family=fam, replica=self.replica,
+            device=self._device_label(), store=self._store(),
+            owner=self._model, handles=(req.handle,), engine=self,
+            cold=n0 == 0)
+        win.attach(prog, (self._params, self._bufs, ids, *self._pools,
+                          table, lens, temps, rkey, *extra, *tail))
         t0 = time.perf_counter()
         bad = nstats = None
         try:
@@ -1662,7 +1968,7 @@ class ServingEngine:
                 self._pools = tuple(pools)
                 tok = int(np.asarray(tok)[0])
         finally:
-            self._compiling = False
+            win.close(traced=traces[0] > n0)
             self._progress_t = time.monotonic()
         if traces[0] > n0:
             self._m_prefill_traces.inc(traces[0] - n0)
@@ -1723,6 +2029,8 @@ class ServingEngine:
         The lane's persistent host row stays inert (scratch table, length
         0) until the final chunk seeds decode."""
         table_row = np.asarray(alloc.pages, np.int32)
+        if req.handle.admitted_at is None:   # TTFT decomposition: queue_s
+            req.handle.admitted_at = time.time()
         slot = _Slot(req, alloc, table_row)
         slot.idx = slot_idx
         slot.prefilled = 0
@@ -1806,7 +2114,14 @@ class ServingEngine:
             _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
                 prog, (self._params, self._bufs, ids, nvalid, *self._pools,
                        table, lens, temps, rkey, *extra, *tail)))
-        self._compiling = n0 == 0
+        win = _programs.ledger().compile_window(
+            self._prefill_chunk_store_key(C), family=fam,
+            replica=self.replica, device=self._device_label(),
+            store=self._store(), owner=self._model,
+            handles=(req.handle,), engine=self, cold=n0 == 0)
+        win.attach(prog, (self._params, self._bufs, ids, nvalid,
+                          *self._pools, table, lens, temps, rkey,
+                          *extra, *tail))
         t0 = time.perf_counter()
         bad = nstats = None
         try:
@@ -1826,7 +2141,7 @@ class ServingEngine:
                 self._pools = tuple(pools)
                 tok = int(np.asarray(tok)[0])
         finally:
-            self._compiling = False
+            win.close(traced=traces[0] > n0)
             self._progress_t = time.monotonic()
         if traces[0] > n0:
             self._m_prefill_chunk_traces.inc(traces[0] - n0)
@@ -2012,7 +2327,18 @@ class ServingEngine:
                 links=[self._slots[i].handle.trace_id for i in active])
         else:  # hot path: one flag read, no span/link-list construction
             cm = _tracing.NOOP
-        self._compiling = n0 == 0  # first decode dispatch = XLA compile
+        # first decode dispatch = XLA compile; every active request waits
+        # out the whole stall, so the window bills each of their TTFTs
+        win = _programs.ledger().compile_window(
+            self._step_store_key(), family=fam, replica=self.replica,
+            device=self._device_label(), store=self._store(),
+            owner=self._model,
+            handles=[self._slots[i].handle for i in active],
+            engine=self, cold=n0 == 0)
+        if n0 == 0:
+            win.attach(prog, (self._params, self._bufs, self._h_last,
+                              *self._pools, self._h_table, self._h_lens,
+                              self._h_temps, rkey, *extra, *tail))
         t0 = time.perf_counter()
         bad = nstats = None
         try:
@@ -2030,7 +2356,7 @@ class ServingEngine:
                 self._pools = tuple(pools)
                 tok = np.asarray(tok)
         finally:
-            self._compiling = False
+            win.close(traced=traces[0] > n0)
             self._progress_t = time.monotonic()
         if traces[0] > n0:
             self._m_step_traces.inc(traces[0] - n0)
@@ -2109,7 +2435,17 @@ class ServingEngine:
                 links=[self._slots[i].handle.trace_id for i in active])
         else:
             cm = _tracing.NOOP
-        self._compiling = n0 == 0
+        win = _programs.ledger().compile_window(
+            self._verify_store_key(K), family=fam, replica=self.replica,
+            device=self._device_label(), store=self._store(),
+            owner=self._model,
+            handles=[self._slots[i].handle for i in active],
+            engine=self, cold=n0 == 0)
+        if n0 == 0:
+            win.attach(prog, (self._params, self._bufs, self._h_ids,
+                              *self._pools, self._h_table, self._h_lens,
+                              self._h_dlen, self._h_temps, rkey,
+                              *extra, *tail))
         t0 = time.perf_counter()
         bad = nstats = None
         try:
@@ -2128,7 +2464,7 @@ class ServingEngine:
                 targets = np.asarray(targets)
                 accept = np.asarray(accept)
         finally:
-            self._compiling = False
+            win.close(traced=traces[0] > n0)
             self._progress_t = time.monotonic()
         if traces[0] > n0:
             self._m_verify_traces.inc(traces[0] - n0)
@@ -2192,6 +2528,12 @@ class ServingEngine:
             h.first_token_at = now
             h.first_token_iteration = self._iteration
             self._m_ttft.observe(now - h.submitted_at)
+            if h.compile_s > 0.0:
+                # compile-paying first token: parallel family (not a label
+                # on serving.ttft_seconds — existing per-replica children
+                # and their bucket alignment stay byte-identical) so p95
+                # TTFT dashboards can subtract cold starts
+                self._m_ttft_cold.observe(now - h.submitted_at)
         elif slot.last_token_t is not None:
             self._m_itl.observe(now - slot.last_token_t)
         slot.last_token_t = now

@@ -175,13 +175,30 @@ def decode_loop(model, fwd, ids0, max_new_tokens, init_cache,
     from time import perf_counter
 
     from ...observability import perf as _perf
+    from ...observability import programs as _programs
+    from ...observability import tracing as _tracing
 
+    if store is not None:
+        # every store mint lands a ledger row; warm hits record provenance
+        # only (no stall), so /statusz accounts 100% of live store keys
+        _programs.ledger().record_mint(
+            program_key, family="generate.decode", kind="generate",
+            store=store, owner=model, replica="-", warm=warm)
     try:
         cache = init_cache()
         base = jax.random.key(seed if seed is not None else 0)
         key0 = jax.random.fold_in(base, 0)
         t_loop = perf_counter()
         nxt, cache = prefill(params, bufs, jnp.asarray(ids0), cache, key0)
+        if not warm and store is not None:
+            # the prefill dispatch above paid this key's trace+compile
+            # (the step program compiles asynchronously under the same
+            # episode); attribute the wall to the ambient trace id
+            _programs.ledger().record_compile(
+                program_key, perf_counter() - t_loop,
+                family="generate.decode", kind="generate", store=store,
+                owner=model, replica="-",
+                trace_id=_tracing.current_trace_id())
         if store is not None and _perf.needs_cost("generate.decode"):
             # per-token roofline attribution for the generate() path: one
             # representative step program's cost (shapes captured here,

@@ -1,0 +1,529 @@
+"""Program-lifecycle observability (ISSUE 16): the compile ledger,
+cold-start TTFT forensics and warmup manifests.
+
+Suite marker: ``progs``.  The in-budget tests share ONE compiled tiny
+engine (module fixture) plus pure-unit ledger/manifest checks; the
+engine-family matrix (int8 / chunked / speculative / mp) compiles fresh
+engines and is marked ``slow``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import (
+    flight_recorder, programs, telemetry,
+)
+from paddle_tpu.observability.programs import WarmupManifest
+from paddle_tpu.profiler import metrics as prof_metrics
+from paddle_tpu.text.models._decode import program_store
+
+pytestmark = pytest.mark.progs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MAXLEN = 64
+PS = 8
+PROMPT = [1, 2, 3, 4]
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _tiny_gpt(seed=0):
+    paddle.seed(seed)
+    from paddle_tpu.text.models.gpt import GPTForCausalLM
+
+    return GPTForCausalLM(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          max_position_embeddings=MAXLEN).eval()
+
+
+@pytest.fixture(autouse=True)
+def _flight_dir(tmp_path):
+    rec = flight_recorder.get_flight_recorder()
+    old_dir, old_last = rec.dir, rec.last_dump_path
+    rec.dir = str(tmp_path / "flight")
+    yield
+    rec.dir, rec.last_dump_path = old_dir, old_last
+    telemetry.shutdown()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_gpt()
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    """ONE compiled tiny engine shared by the in-budget tests.  The
+    ledger is reset FIRST so this module's rows account exactly this
+    store; the cold first request's handle is kept for the TTFT
+    decomposition tests."""
+    programs.ledger().reset()
+    from paddle_tpu.serving import ServingEngine
+
+    eng = ServingEngine(model, num_slots=2, page_size=PS,
+                        max_model_len=MAXLEN)
+    with eng:
+        h = eng.submit(PROMPT, max_new_tokens=6)
+        ids = h.result(timeout=600)
+        eng._test_cold_handle = h
+        eng._test_cold_ids = list(ids)
+        yield eng
+
+
+# ======================================================= unit: keys/manifest
+def test_key_encode_decode_roundtrip():
+    keys = [
+        ("serve_step", 2, 8, (2, 17, 8, 2, 16), "float32", (0, 1.0)),
+        ("prefill", 32, ("mp", 2), None, True),
+        ("decode", 1, 64, "bf16"),
+    ]
+    for k in keys:
+        assert programs.decode_key(programs.encode_key(k)) == k
+    with pytest.raises(TypeError):
+        programs.encode_key(("x", object()))
+
+
+def test_manifest_json_roundtrip(tmp_path):
+    keys = [("serve_step", 2, 8), ("prefill", 32)]
+    m = WarmupManifest(keys, meta={"adapter": {"n": 1}})
+    p = m.save(tmp_path / "man.json")
+    m2 = WarmupManifest.load(p)
+    assert m2.keys == [tuple(k) for k in keys]
+    assert m2.meta == {"adapter": {"n": 1}}
+    assert len(m2) == 2 and list(m2) == m2.keys
+
+
+def test_manifest_rejects_wrong_schema():
+    with pytest.raises(ValueError, match="schema"):
+        WarmupManifest.from_json({"schema": "something/else", "keys": []})
+
+
+def test_manifest_capture_skips_unencodable(model):
+    store = program_store(model)
+    bad = ("bad_key", object())
+    store[bad] = (None, [0])
+    try:
+        m = WarmupManifest.capture(model)
+        assert bad not in m.keys
+        assert any("bad_key" in s for s in m.meta.get("skipped", []))
+        assert all(isinstance(k, tuple) for k in m.keys)
+    finally:
+        del store[bad]
+
+
+# ==================================================== unit: windows/watchdog
+def test_compile_window_drives_engine_flag_and_gauge():
+    led = programs.ledger()
+    reg = prof_metrics.get_registry()
+
+    class FakeEngine:
+        _compiling = False
+
+    e = FakeEngine()
+    assert not led.compiling(e)
+    win = led.compile_window(("unit_win", 1), family="unit", replica="u",
+                             engine=e, cold=True)
+    try:
+        assert e._compiling is True
+        assert led.compiling(e) and led.compiling()
+        assert led.in_progress() >= 1
+        g = reg.get("programs.compile_in_progress").labels(replica="u")
+        assert g.value >= 1
+    finally:
+        win.close(traced=False)
+    assert e._compiling is False
+    assert not led.compiling(e)
+    assert reg.get("programs.compile_in_progress").labels(
+        replica="u").value == 0
+    # traced=False: no ledger row was minted for the key
+    assert led.entry(("unit_win", 1)) is None
+    # close is idempotent
+    win.close(traced=True)
+    assert led.entry(("unit_win", 1)) is None
+
+
+def test_warm_window_is_noop_singleton():
+    led = programs.ledger()
+    w1 = led.compile_window(("k",), family="f", cold=False)
+    w2 = led.compile_window(("k2",), family="f", cold=False)
+    assert w1 is w2
+    w1.attach(None, None)  # all no-ops
+    w1.close()
+    assert led.in_progress() == 0
+
+
+def test_watchdog_consults_ledger_not_stale_flag():
+    """The watchdog's compile suppression reads the ledger, so an engine
+    flag wedged True (the pre-ledger failure mode) cannot silence it."""
+    from paddle_tpu.observability import watchdog as wd
+
+    led = programs.ledger()
+
+    class FakeEngine:
+        _compiling = True  # stale — no window is actually open
+
+    e = FakeEngine()
+    assert not led.compiling(e)
+    src = wd.__file__
+    with open(src) as f:
+        body = f.read()
+    assert "ledger().compiling" in body  # the monitor consults the ledger
+
+
+def test_ttft_billing_skips_post_first_token_handles():
+    """A stall AFTER a request's first token is ITL, not TTFT: only
+    pre-first-token waiters accumulate compile_s."""
+    led = programs.ledger()
+
+    class H:
+        first_token_at = None
+        compile_s = 0.0
+        trace_id = "payer"
+
+    fresh, served = H(), H()
+    served.first_token_at = time.time()
+    led.record_compile(("unit_bill",), 1.5, family="unit",
+                       handles=(fresh, served))
+    assert fresh.compile_s == pytest.approx(1.5)
+    assert served.compile_s == 0.0
+    ent = led.entry(("unit_bill",))
+    assert ent.trace_id == "payer"
+    assert ent.compile_s == pytest.approx(1.5)
+
+
+def test_cold_start_flight_dump_once_per_episode(tmp_path):
+    led = programs.ledger()
+    old = led.budget_s
+    led.budget_s = 0.01
+    try:
+        d0 = led.cold_dumps
+        led.record_compile(("unit_dump",), 5.0, family="unit")
+        led.record_compile(("unit_dump",), 5.0, family="unit")  # same episode
+        assert led.cold_dumps == d0 + 1
+        path = flight_recorder.get_flight_recorder().last_dump_path
+        assert path and os.path.exists(path)
+        body = open(path).read()
+        assert "cold_start" in body and "unit_dump" in body
+    finally:
+        led.budget_s = old
+
+
+# ============================================================ ledger: engine
+def test_ledger_accounts_every_store_key(engine, model):
+    led = programs.ledger()
+    store = program_store(model)
+    rows = led.rows(store=store)
+    assert len(store) == 2  # prefill bucket + decode step
+    row_keys = {r["key"] for r in rows}
+    for k in store:
+        assert repr(k) in row_keys
+    for r in rows:
+        assert r["family"]
+        assert r["kind"] == "serving"
+        assert r["cold"] == "cold"
+        assert r["compile_s"] is not None and r["compile_s"] > 0
+        assert r["device"]
+    fams = {r["family"] for r in rows}
+    assert engine._decode_family() in fams
+
+
+def test_cold_ttft_decomposition_sums(engine):
+    h = engine._test_cold_handle
+    bd = h.ttft_breakdown()
+    assert bd["cold"] is True
+    assert bd["compile_s"] > 0
+    assert bd["queue_s"] >= 0 and bd["prefill_s"] >= 0
+    assert bd["queue_s"] + bd["compile_s"] + bd["prefill_s"] == \
+        pytest.approx(bd["ttft_s"], abs=1e-9)
+    assert bd["trace_id"] == h.trace_id
+    # the ledger knows who paid: some row carries this request's trace id
+    led = programs.ledger()
+    payers = {r["trace_id"] for r in led.rows()}
+    assert h.trace_id in payers
+
+
+def test_warm_request_pays_nothing(engine, model):
+    led = programs.ledger()
+    store = program_store(model)
+    t0 = engine.program_traces()
+    rows0 = len(led.rows(store=store))
+    h = engine.submit(PROMPT, max_new_tokens=4)
+    h.result(timeout=600)
+    assert engine.program_traces() == t0      # zero new traces
+    assert len(led.rows(store=store)) == rows0
+    bd = h.ttft_breakdown()
+    assert bd["cold"] is False and bd["compile_s"] == 0.0
+
+
+def test_ttft_cold_histogram_labels_cold_requests(engine):
+    reg = prof_metrics.get_registry()
+    cold = reg.get("serving.ttft_cold_seconds").labels(replica="0")
+    warm_total = reg.get("serving.ttft_seconds").labels(replica="0")
+    # exactly the compile-paying request(s) land in the cold family
+    assert 1 <= cold.count < warm_total.count
+
+
+def test_programs_metrics_exported(engine):
+    reg = prof_metrics.get_registry()
+    fam = engine._decode_family()
+    assert reg.get("programs.compiled_total").labels(
+        family=fam, replica="0").value >= 1
+    assert reg.get("programs.compile_seconds").labels(
+        family=fam, replica="0").value > 0
+    # the decode-step stall had waiting requests -> stall_seconds too
+    assert reg.get("programs.stall_seconds").labels(
+        family=fam, replica="0").value > 0
+
+
+def test_statusz_programs_section(engine, model):
+    srv = telemetry.serve(0)
+    code, body = _get(srv.url + "/statusz")
+    assert code == 200
+    sec = json.loads(body)["programs"]
+    assert sec["entries"] >= 2
+    assert sec["store_size"] >= 2
+    assert sec["cold_starts"] >= 2
+    assert sec["compile_in_progress"] == 0
+    assert sec["compile_seconds_total"] > 0
+    row_keys = {r["key"] for r in sec["programs"]}
+    for k in program_store(model):       # every live key accounted
+        assert repr(k) in row_keys
+    # sorted by compile seconds, most expensive first
+    cs = [r["compile_s"] or 0.0 for r in sec["programs"]]
+    assert cs == sorted(cs, reverse=True)
+
+
+def test_scrape_bounded_under_open_compile_window(engine):
+    """PR-3 rule: /statusz and /metrics render in bounded time while a
+    compile window is open — and the open window is VISIBLE."""
+    srv = telemetry.serve(0)
+    led = programs.ledger()
+    win = led.compile_window(("scrape_probe",), family="probe",
+                             replica="probe", cold=True)
+    try:
+        t0 = time.time()
+        code_s, body_s = _get(srv.url + "/statusz")
+        code_m, body_m = _get(srv.url + "/metrics")
+        elapsed = time.time() - t0
+        assert code_s == 200 and code_m == 200
+        assert elapsed < 5.0, f"scrape took {elapsed:.1f}s under compile"
+        sec = json.loads(body_s)["programs"]
+        assert sec["compile_in_progress"] >= 1
+        assert "programs_compile_in_progress" in body_m.decode()
+    finally:
+        win.close(traced=False)
+
+
+def test_analysis_resolves_off_scrape_path(engine, model):
+    led = programs.ledger()
+    store = program_store(model)
+    led.resolve_analysis()
+    rows = led.rows(store=store)
+    resolved = [r for r in rows if "backend_compile_s" in r]
+    assert resolved, rows
+    for r in resolved:
+        assert r["backend_compile_s"] > 0
+        assert r["trace_s"] >= 0
+        assert r["flops"] is None or r["flops"] >= 0
+
+
+# ================================================== manifest: warm restarts
+def test_manifest_warm_restart_zero_traces(engine, model, tmp_path):
+    """The tentpole invariant: capture -> save -> load -> warmup on a
+    fresh same-seed model -> the first real request dispatches with ZERO
+    new traces and byte-identical greedy output."""
+    man = engine.capture_manifest()
+    assert len(man) == len(program_store(model)) == 2
+    assert man.meta.get("adapter")
+    path = man.save(tmp_path / "manifest.json")
+
+    m2 = _tiny_gpt()
+    from paddle_tpu.serving import ServingEngine
+
+    e2 = ServingEngine(m2, num_slots=2, page_size=PS, max_model_len=MAXLEN)
+    info = e2.warmup(path)
+    assert info["warmed"] == 2 and info["skipped"] == 0
+    t0 = e2.program_traces()
+    with e2:
+        h = e2.submit(PROMPT, max_new_tokens=6)
+        ids = list(h.result(timeout=600))
+    assert e2.program_traces() - t0 == 0     # the asserted invariant
+    assert h.compile_s == 0.0
+    assert ids == engine._test_cold_ids      # byte-identical greedy
+    # warmed rows carry provenance: warm, paid by "warmup"
+    led = programs.ledger()
+    rows = led.rows(store=program_store(m2))
+    assert len(rows) == 2
+    assert all(r["trace_id"] == "warmup" for r in rows)
+
+
+def test_warmup_refuses_mismatched_adapter(tmp_path, model):
+    man = WarmupManifest.capture(model,
+                                 meta={"adapter": {"page_size": 999}})
+    path = man.save(tmp_path / "bad.json")
+    m2 = _tiny_gpt()
+    from paddle_tpu.serving import ServingEngine
+
+    e2 = ServingEngine(m2, num_slots=2, page_size=PS, max_model_len=MAXLEN)
+    with pytest.raises(ValueError, match="adapter"):
+        e2.warmup(path)
+
+
+def test_warmup_after_start_raises(engine):
+    with pytest.raises(RuntimeError, match="start"):
+        engine.warmup(WarmupManifest())
+
+
+def test_warmup_skips_unknown_keys(model, tmp_path):
+    """Foreign keys (another engine geometry) are skipped, not fatal."""
+    man = WarmupManifest([("no_such_phase", 1, 2)])
+    m2 = _tiny_gpt()
+    from paddle_tpu.serving import ServingEngine
+
+    e2 = ServingEngine(m2, num_slots=2, page_size=PS, max_model_len=MAXLEN)
+    info = e2.warmup(man)
+    assert info["warmed"] == 0 and info["skipped"] == 1
+
+
+@pytest.mark.slow
+def test_replica_pool_warm_spinup(engine, tmp_path):
+    """ReplicaPool(warmup=...) replays the manifest on spin-up: the
+    fresh pool's first request on a replica mints zero traces."""
+    from paddle_tpu.serving.cluster import ReplicaPool
+
+    path = engine.capture_manifest().save(tmp_path / "pool.json")
+    m2 = _tiny_gpt()
+    pool = ReplicaPool(m2, replicas=1, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN, warmup=str(path))
+    assert pool.warmup_manifest is not None
+    with pool:
+        e = pool.engines[0]
+        t0 = e.program_traces()
+        h = e.submit(PROMPT, max_new_tokens=4)
+        h.result(timeout=600)
+        assert e.program_traces() - t0 == 0
+        assert h.compile_s == 0.0
+
+
+# ===================================================== slow: family matrix
+def _matrix_engine_case(model, **kw):
+    """Fresh engine under kw; returns (ledger rows for its store, store)."""
+    from paddle_tpu.serving import ServingEngine
+
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN, **kw) as eng:
+        h = eng.submit(PROMPT, max_new_tokens=8)
+        h.result(timeout=600)
+    store = program_store(model)
+    return programs.ledger().rows(store=store), store
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kw", [
+    {"kv_dtype": "int8"},
+    {"prefill_chunk_tokens": 8},
+    {"speculative_k": 2},
+], ids=["int8", "chunked", "speculative"])
+def test_ledger_accounts_engine_family_matrix(kw):
+    m = _tiny_gpt()
+    rows, store = _matrix_engine_case(m, **kw)
+    assert len(rows) == len(store) >= 2
+    keys = {r["key"] for r in rows}
+    for k in store:
+        assert repr(k) in keys
+    assert all(r["compile_s"] is not None for r in rows)
+
+
+@pytest.mark.slow
+def test_ledger_accounts_mp_engine():
+    """mp=2 engine in a forced-host-device subprocess: every SPMD store
+    key lands a ledger row (store size == row count)."""
+    body = r"""
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.text.models.gpt import GPTForCausalLM
+from paddle_tpu.text.models._decode import program_store
+from paddle_tpu.observability import programs
+
+paddle.seed(0)
+m = GPTForCausalLM(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                   num_attention_heads=2,
+                   max_position_embeddings=64).eval()
+import jax
+with ServingEngine(m, num_slots=2, page_size=8, max_model_len=64,
+                   mesh=list(jax.devices())) as eng:
+    h = eng.submit([1, 2, 3, 4], max_new_tokens=6)
+    h.result(timeout=600)
+store = program_store(m)
+rows = programs.ledger().rows(store=store)
+assert len(rows) == len(store) >= 2, (len(rows), len(store))
+keys = {r["key"] for r in rows}
+assert all(repr(k) in keys for k in store)
+print("WORKER_OK")
+"""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("PYTHONPATH", REPO)
+    proc = subprocess.run([sys.executable, "-c", body],
+                          capture_output=True, text=True, timeout=560,
+                          env=env)
+    assert proc.returncode == 0 and "WORKER_OK" in proc.stdout, (
+        f"worker failed\n--- stdout ---\n{proc.stdout}\n"
+        f"--- stderr ---\n{proc.stderr}")
+
+
+# ================================================== train_step / generate
+@pytest.mark.slow
+def test_train_step_mints_ledger_rows():
+    import paddle_tpu.optimizer as opt
+
+    led = programs.ledger()
+    before = {r["key"] for r in led.rows()}
+    paddle.seed(0)
+    import paddle_tpu.nn as nn
+
+    m = nn.Linear(8, 4)
+    o = opt.Momentum(learning_rate=0.01, momentum=0.9,
+                     parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, o, loss_fn=nn.CrossEntropyLoss())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype("float32"))
+    y = paddle.to_tensor(np.array([0, 1, 2, 3], dtype="int64"))
+    step((x,), y)
+    new = [r for r in led.rows() if r["key"] not in before
+           and r["kind"] == "train_step"]
+    assert new, led.rows()
+    assert new[0]["compile_s"] > 0
+
+
+@pytest.mark.slow
+def test_generate_decode_mints_ledger_row():
+    led = programs.ledger()
+    m = _tiny_gpt(seed=1)
+    ids = paddle.to_tensor(np.array([[1, 2, 3, 4]], dtype="int64"))
+    m.generate(ids, max_new_tokens=4, temperature=0.0, cache_impl="paged",
+               page_size=PS, max_len=32)
+    rows = [r for r in led.rows(store=program_store(m))
+            if r["kind"] == "generate"]
+    assert rows, led.rows()
+    assert rows[0]["family"] == "generate.decode"
+    assert rows[0]["compile_s"] is not None and rows[0]["compile_s"] > 0
