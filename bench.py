@@ -133,11 +133,9 @@ def _measure_decode(cache_impl, B=8, S0=32, lo=64, hi=320):
 def _metric_quantile(name, q, **labels):
     """Reservoir quantile of a registry histogram child (None when empty).
     Serving series carry replica= labels (default replica "0")."""
-    from paddle_tpu.profiler import metrics as _metrics
+    from paddle_tpu.observability import perf as _obs_perf
 
-    h = _metrics.get_registry().get(name)
-    c = h.labels(**labels) if h is not None else None
-    return (c.quantile(q) if c is not None and c.count else None)
+    return _obs_perf.metric_quantile(name, q, **labels)
 
 
 def _bench_memory_section(engine):
@@ -1132,6 +1130,218 @@ def _serving_warmup_report():
     }
 
 
+def _measure_serving_qos(min_replicas=2, max_replicas=3, num_slots=2,
+                         S0=24, page_size=8, max_new=40, model_kwargs=None):
+    """The QoS chaos arm (ISSUE-19 acceptance): a tiered autoscaling
+    cluster runs a calm phase, then a chaos phase — a traffic spike
+    (``serving.traffic_spike`` floods batch work through the normal
+    admission path) plus an injected replica loss
+    (``cluster.replica_preempt@<r>``) while realtime traffic keeps
+    arriving and preempting batch slots.  Reports per-tier TTFT/ITL p95
+    for both phases, the realtime (high-tier) SLO attainment under
+    chaos, the replica-count timeline (must go up AND come back down),
+    and byte-parity of every preempted/rerouted greedy request against
+    an uninterrupted ``generate()`` reference."""
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.observability import faults
+    from paddle_tpu.observability.slo import timeline_of
+    from paddle_tpu.profiler import metrics as _metrics
+    from paddle_tpu.serving import (
+        QoSConfig, ServingCluster, SLOPolicy, TierPolicy,
+    )
+    from paddle_tpu.text.models import GPTForCausalLM
+
+    paddle.seed(0)
+    kw = dict(vocab_size=512, hidden_size=256, num_hidden_layers=4,
+              num_attention_heads=4, max_position_embeddings=S0 + max_new)
+    kw.update(model_kwargs or {})
+    m = GPTForCausalLM(**kw).eval()
+    rs = np.random.RandomState(0)
+    max_len = S0 + max_new
+
+    def prompt():
+        return rs.randint(1, 500, (S0,)).astype("int64")
+
+    def ref(p, n):
+        ids = paddle.to_tensor(np.asarray([p], "int64"))
+        out = m.generate(ids, max_new_tokens=n, temperature=0.0,
+                         cache_impl="paged", page_size=page_size,
+                         max_len=len(p) + n)
+        return [int(t) for t in out.numpy()[0, len(p):]]
+
+    # realtime SLO is deliberately generous for CPU wall clocks: the gated
+    # invariant is that chaos does NOT move high-tier attainment (1.0),
+    # while batch/standard absorb the damage (preemption + queueing)
+    rt_slo = SLOPolicy(ttft_s=30.0, e2e_s=240.0, objective=0.95, window=128)
+    qos = QoSConfig(tiers=(
+        TierPolicy("realtime", priority=2, weight=8, slo=rt_slo,
+                   preemptible=False),
+        TierPolicy("standard", priority=1, weight=3, shed_burn_rate=4.0),
+        TierPolicy("batch", priority=0, weight=1, shed_burn_rate=2.0),
+    ), default_tier="standard")
+    cluster = ServingCluster(
+        m, replicas=min_replicas, devices="auto", qos=qos,
+        num_slots=num_slots, page_size=page_size, max_model_len=max_len,
+        autoscale={"min_replicas": min_replicas,
+                   "max_replicas": max_replicas,
+                   "scale_up_queue": 2.0, "scale_up_occupancy": 0.9,
+                   "stable_s": 0.2, "cooldown_s": 0.5, "interval_s": 0.05})
+
+    def submit(tier, n):
+        p = prompt()
+        h = cluster.submit(p, max_new_tokens=n, tier=tier)
+        h._bench_prompt, h._bench_n = p, n
+        return h
+
+    def tier_stats(handles):
+        per = {}
+        for tier in ("realtime", "standard", "batch"):
+            tls = [timeline_of(h) for h in handles if h.tier == tier]
+            ttfts = [t.ttft for t in tls if t.ttft is not None]
+            gaps = [g for t in tls for g in t.itl_gaps]
+            per[tier] = {
+                "requests": len(tls),
+                "ttft_p95_s": round(float(np.percentile(ttfts, 95)), 4)
+                if ttfts else None,
+                "itl_p95_s": round(float(np.percentile(gaps, 95)), 5)
+                if gaps else None,
+            }
+        return per
+
+    def rt_attainment(handles):
+        reps = [rt_slo.evaluate(timeline_of(h)) for h in handles
+                if h.tier == "realtime"]
+        return round(sum(1 for r in reps if r.met) / len(reps), 4) \
+            if reps else None
+
+    pre_c = _metrics.get_registry().counter("serving.preemptions")
+    with cluster:
+        for e in cluster.engines:      # compile every replica's programs
+            e.generate(prompt(), max_new_tokens=4, timeout=900)
+        # ---- calm phase: mixed-tier traffic, no faults
+        calm = []
+        for i in range(12):
+            calm.append(submit(("realtime", "standard", "batch")[i % 3],
+                               12 if i % 3 == 0 else max_new))
+        for h in calm:
+            h.result(timeout=900)
+        # ---- chaos phase: spike + replica kill under realtime pressure
+        chaos, burst = [], []
+
+        def spike():
+            for _ in range(8):
+                burst.append(submit("batch", max_new))
+
+        faults.inject("serving.traffic_spike", fn=spike, times=1)
+        try:
+            for _ in range(2 * min_replicas * num_slots):  # saturate slots
+                chaos.append(submit("batch", max_new))
+            # preemption's precondition: every live replica's decode batch
+            # full of batch-tier work.  A freshly scaled-up replica joins
+            # with EMPTY slots (queues are per engine — backlog does not
+            # migrate), and least-loaded routing would hand realtime that
+            # free capacity instead of forcing an eviction — correct, but
+            # not the path under test — so keep topping up batch pressure
+            # until the WHOLE fleet is batch-saturated
+            t0 = time.time()
+            while time.time() - t0 < 30:
+                engines = cluster.pool.engines
+                if engines and all(
+                        sum(1 for s in e._slots
+                            if s is not None and s.req.tier == "batch")
+                        == e.num_slots for e in engines):
+                    break
+                if len(chaos) < 5 * max_replicas * num_slots:
+                    chaos.append(submit("batch", max_new))
+                time.sleep(0.05)
+            # realtime keeps arriving until at least one batch slot was
+            # actually preempted (bounded — slot turnover may race)
+            pre0 = pre_c.total()
+            for i in range(24):
+                chaos.append(submit("realtime", 12))
+                if pre_c.total() > pre0:
+                    break
+                time.sleep(0.05)
+            # replica loss mid-traffic: reroute + reap + replace, with
+            # high-tier requests still flowing
+            victim = cluster.pool.engines[0].replica
+            faults.inject(f"cluster.replica_preempt@{victim}", times=1)
+            for i in range(6):
+                chaos.append(submit(("realtime", "standard")[i % 2], 12))
+                time.sleep(0.02)
+            for h in chaos + burst:
+                h.result(timeout=900)
+        finally:
+            faults.clear()
+        preempted = sum(1 for h in chaos + burst if h.preemptions > 0)
+        rerouted = sum(1 for h in chaos + burst
+                       if len(h.replica_history) > 1)
+        # ---- parity: every preempted or rerouted greedy request must be
+        # byte-identical to an uninterrupted generate() run
+        checked, matched = 0, 0
+        for h in chaos + burst:
+            if h.preemptions > 0 or len(h.replica_history) > 1:
+                checked += 1
+                if list(h.result()) == ref(h._bench_prompt, h._bench_n):
+                    matched += 1
+        # ---- fleet settles: drain back down to min_replicas
+        t0 = time.time()
+        while (len(cluster.pool) > min_replicas
+               or cluster.autoscaler.retiring is not None) \
+                and time.time() - t0 < 120:
+            time.sleep(0.05)
+        timeline = cluster.autoscaler.timeline()
+        events = [r["event"] for r in timeline]
+        replica_counts = [r["replicas"] for r in timeline]
+
+    return {
+        "min_replicas": min_replicas,
+        "max_replicas": max_replicas,
+        "calm": {"per_tier": tier_stats(calm),
+                 "realtime_attainment": rt_attainment(calm)},
+        "chaos": {"per_tier": tier_stats(chaos + burst),
+                  "realtime_attainment": rt_attainment(chaos),
+                  "spike_requests": len(burst),
+                  "killed_replica": victim,
+                  "rerouted_requests": rerouted},
+        "high_tier_attainment": rt_attainment(chaos),
+        "preempted_requests": preempted,
+        "parity_checked": checked,
+        "preempted_parity": round(matched / checked, 4) if checked else 1.0,
+        "peak_replicas": max(replica_counts) if replica_counts
+        else min_replicas,
+        "settled_replicas": len(cluster.pool),
+        "autoscale_round_trip": float(
+            "up" in events and "down" in events
+            and len(cluster.pool) == min_replicas),
+        "scale_events": {e: events.count(e)
+                         for e in ("up", "drain", "down", "reap")},
+        "replica_timeline": [{"t": round(r["t"], 3),
+                              "replicas": r["replicas"],
+                              "event": r["event"]} for r in timeline],
+    }
+
+
+def _serving_qos_report():
+    """One subprocess arm (the chaos run is self-contained) + the gate
+    summary: high-tier attainment and preempted-request parity are
+    ratcheted at tolerance 0 in perf_baselines.json, and the autoscaler
+    must complete a full up-and-back-down round trip."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = (flags + " --xla_force_host_platform_device_count=3").strip()
+    out = _section("serving_qos", XLA_FLAGS=flags)
+    out["note"] = (
+        "QoS chaos arm: tiered autoscaling cluster under a traffic spike "
+        "+ injected replica loss; high_tier_attainment (realtime, chaos "
+        "phase), preempted_parity and autoscale_round_trip are gated at "
+        "tolerance 0 — only batch/standard latency may degrade")
+    return out
+
+
 def _measure_tracing_overhead(iters=30):
     """Tracing-enabled vs disabled step-time delta on the two instrumented
     hot paths (the < 2% disabled-path contract from the observability PR):
@@ -1342,6 +1552,8 @@ def _run_section(name):
 
         return _measure_serving_warmup(
             arm=os.environ.get("BENCH_WARMUP_ARM", "cold"))
+    if name == "serving_qos":
+        return _measure_serving_qos()
     if name == "tracing_overhead":
         return _measure_tracing_overhead()
     if name == "numerics_overhead":
@@ -1691,6 +1903,12 @@ def main():
             # manifest captured) vs warm restart (manifest replayed before
             # admission) — warm arm's first request must mint zero traces
             out = {"serving_warmup": _serving_warmup_report()}
+        elif _argv_has("--qos"):
+            # --qos: the tiered-preemption chaos arm — traffic spike +
+            # replica kill against an autoscaling QoS cluster; high-tier
+            # attainment, preemption byte parity and the autoscaler
+            # round trip are the gated invariants
+            out = {"serving_qos": _serving_qos_report()}
         else:
             out = {"serving": _section("serving")}
         if "--emit-metrics" in sys.argv:

@@ -33,7 +33,18 @@ Sites wired so far:
   :func:`paddle_tpu.observability.numerics.consume_nan_inject` call into
   a NaN scalar that probed train-step / guarded serving programs add at
   a configurable tensor site, driving the detect → dump → rollback loop
-  without a real numerical bug (:mod:`.numerics`).
+  without a real numerical bug (:mod:`.numerics`);
+- ``serving.traffic_spike`` — top of :meth:`ServingEngine.submit`: arm
+  with an ``fn`` that submits a burst of extra requests to drive
+  deterministic overload for the QoS brownout/autoscaler drills (the
+  injected submits recurse through the site while it is mid-trip, so use
+  ``times=``/``at_trips=`` to bound the burst);
+- ``serving.replica-scoped sites`` — every engine also polls
+  ``serving.scheduler_wedge@<replica>``, ``serving.step_crash@<replica>``
+  and ``cluster.replica_preempt@<replica>``; the last kills exactly that
+  replica FATALLY (its abort message avoids every transient pattern), so
+  chaos runs can take one pool member down and watch the cluster reroute
+  and the :class:`~paddle_tpu.serving.qos.AutoScaler` reap + replace it.
 
 Armed faults are listed on the telemetry ``/statusz`` page
 (:func:`describe`).

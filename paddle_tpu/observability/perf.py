@@ -641,6 +641,19 @@ def reset():
         _TABLE.reset()
 
 
+def metric_quantile(name, q, **labels):
+    """Reservoir quantile of one registry histogram child, or None when
+    the series is absent or empty.  The read half of the latency-SLO
+    story (bench arms and the QoS report use it for per-tier TTFT/ITL
+    p95s): serving series carry ``replica=`` labels — and on QoS engines
+    ``tier=`` — so the child is addressed by exact label match."""
+    from ..profiler import metrics as _metrics
+
+    h = _metrics.get_registry().get(name)
+    c = h.labels(**labels) if h is not None else None
+    return (c.quantile(q) if c is not None and c.count else None)
+
+
 # ------------------------------------------------- cost-thunk construction
 def _shape_struct(v):
     import jax

@@ -243,6 +243,15 @@ class SLOAccountant:
         self._m_tps.set(rates["tokens_per_sec"])
 
     # -------------------------------------------------------------- insight
+    def current(self):
+        """The current window's derived rates (the :func:`window_rates`
+        dict), or None before any request finished — the burn-rate scalar
+        the QoS brownout ladder and autoscaler poll without scraping the
+        metric registry."""
+        with self._lock:
+            rows = list(self._window)
+        return self.window_rates(rows, self.policy.objective)
+
     def summary(self):
         """/statusz section: policy + the current window's derived rates
         + lifetime counts."""

@@ -13,6 +13,11 @@ What any real multi-host deployment scrapes first:
   lifecycle ledger: per-key compile seconds, cold/warm provenance, the
   trace id that paid each stall, and whether a compile window is open
   right now — the wedged-compile vs wedged-scheduler discriminator).
+  QoS engines add a ``qos`` block to their section (per-tier queue
+  table, active slots by tier, the brownout rung and per-tier SLO
+  windows) and a cluster's section carries the autoscaler timeline —
+  shed decisions and replica-count moves are attributable from this
+  page alone during a brownout.
 
 Opt-in spellings: ``observability.serve(port)`` from code, or set
 ``PADDLE_TELEMETRY_PORT`` and let :class:`ServingEngine.start` wire it

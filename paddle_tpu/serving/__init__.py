@@ -23,6 +23,12 @@ cache (ROADMAP north star: "serves heavy traffic from millions of users").
   "int8")``), the :func:`quantize_model_weights` Int8Linear weight path,
   and the :func:`calibrate` accuracy harness (README "Quantized
   serving").
+- :mod:`.qos` — QoS-tiered serving: :class:`TierPolicy` /
+  :class:`QoSConfig` (priority tiers with weighted admission, per-tier
+  SLOs, brownout shed thresholds), :class:`TieredQueue` (the engine's
+  per-tier weighted-round-robin queue), :func:`brownout` (the shed
+  ladder) and :class:`AutoScaler` (elastic replica count for a
+  :class:`ReplicaPool` — README "QoS tiers & autoscaling").
 - :mod:`.multitenant` — multi-tenant serving: paged multi-LoRA
   (:class:`LoRAStore` rank-bucketed adapter pools with per-row gather
   inside the compiled programs), grammar-constrained decoding
@@ -56,6 +62,9 @@ from .multitenant import (  # noqa: F401
     CompiledGrammar, LoRAAdapter, LoRAStore, MultiTenantEngine,
     compile_json_schema, compile_regex,
 )
+from .qos import (  # noqa: F401
+    AutoScaler, QoSConfig, TieredQueue, TierPolicy, brownout,
+)
 
 __all__ = [
     "ServingEngine", "Request", "RequestHandle", "RequestRejectedError",
@@ -66,4 +75,5 @@ __all__ = [
     "QuantizedGPTAdapter", "quantize_model_weights", "calibrate",
     "MultiTenantEngine", "LoRAStore", "LoRAAdapter", "CompiledGrammar",
     "compile_regex", "compile_json_schema",
+    "QoSConfig", "TierPolicy", "TieredQueue", "AutoScaler", "brownout",
 ]

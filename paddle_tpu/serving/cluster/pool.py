@@ -28,6 +28,7 @@ Count divisibility is validated with a clear error either way.
 from __future__ import annotations
 
 import os
+import threading
 
 import jax
 
@@ -130,20 +131,34 @@ class ReplicaPool:
 
             warmup = WarmupManifest.load(warmup)
         self.warmup_manifest = warmup
-        self.engines = []
-        for i in range(replicas):
-            place = {}
-            if meshes is not None:
-                place["mesh"] = meshes[i % len(meshes)]
-            elif devices is not None:
-                place["device"] = devices[i % len(devices)]
-            self.engines.append(engine_cls(
-                model, replica=f"{replica_prefix}{i}", **place,
-                **engine_kwargs))
+        # elastic membership (AutoScaler): the build recipe is kept so
+        # replicas can be added after construction; ids are monotonic
+        # (never reused) so a replaced replica's metric labels and
+        # /statusz keys stay distinct from its predecessor's
+        self._engine_cls = engine_cls
+        self._engine_kwargs = engine_kwargs
+        self._replica_prefix = replica_prefix
+        self._mut = threading.RLock()
+        self._next_idx = replicas
+        self._pool_started = False
+        self.engines = [self._build_engine(i) for i in range(replicas)]
+
+    def _build_engine(self, i):
+        place = {}
+        if self.meshes is not None:
+            place["mesh"] = self.meshes[i % len(self.meshes)]
+        elif self.devices is not None:
+            place["device"] = self.devices[i % len(self.devices)]
+        return self._engine_cls(
+            self.model, replica=f"{self._replica_prefix}{i}", **place,
+            **self._engine_kwargs)
 
     # ------------------------------------------------------------ lifecycle
     def start(self):
-        for e in self.engines:
+        with self._mut:
+            self._pool_started = True
+            engines = list(self.engines)
+        for e in engines:
             if self.warmup_manifest is not None and not e._started:
                 e.warmup(self.warmup_manifest)
             e.start()
@@ -156,13 +171,49 @@ class ReplicaPool:
 
     def stop(self, drain=False, drain_timeout=600):
         errors = []
-        for e in self.engines:
+        for e in list(self.engines):
             try:
                 e.stop(drain=drain, drain_timeout=drain_timeout)
             except Exception as exc:  # stop the REST before surfacing
                 errors.append(exc)
         if errors:
             raise errors[0]
+
+    # --------------------------------------------------- elastic membership
+    def add_replica(self):
+        """Grow the pool by one engine (autoscaler scale-up) and return
+        it.  Spin-up is WARM when the pool has a ``warmup=`` manifest —
+        the new engine replays it before its scheduler starts, and since
+        the model's program store is shared it skips every key a sibling
+        already traced, so elastic growth mints nothing on a warmed
+        fleet.  Started iff the pool is started."""
+        with self._mut:
+            i = self._next_idx
+            self._next_idx += 1
+            e = self._build_engine(i)
+            started = self._pool_started
+            # list REPLACEMENT (not append): readers iterate a consistent
+            # snapshot without holding the pool lock
+            self.engines = self.engines + [e]
+        if started:
+            if self.warmup_manifest is not None:
+                e.warmup(self.warmup_manifest)
+            e.start()
+        return e
+
+    def remove_replica(self, engine):
+        """Forget a retired/dead engine (the autoscaler stops it first;
+        removal here only changes membership)."""
+        with self._mut:
+            self.engines = [e for e in self.engines if e is not engine]
+
+    def snapshot_states(self):
+        """One atomic ``(engines, states)`` pair: row ``i`` of ``states``
+        describes ``engines[i]`` even if the pool resizes concurrently —
+        the router/autoscaler contract under elastic membership."""
+        with self._mut:
+            engines = list(self.engines)
+        return engines, self._states_of(engines)
 
     def __enter__(self):
         return self.start()
@@ -182,8 +233,12 @@ class ReplicaPool:
         """Router-input snapshots, one per replica (reads race the
         scheduler threads benignly — routing is a heuristic, not a
         transaction)."""
+        return self._states_of(list(self.engines))
+
+    @staticmethod
+    def _states_of(engines):
         out = []
-        for e in self.engines:
+        for e in engines:
             hs = e.health_state()
             out.append({
                 "replica": e.replica,

@@ -103,6 +103,18 @@ class PrefixAffinityRouter:
         self._rng = random.Random(seed)
         self._rr = itertools.count()
 
+    def resize(self, n_replicas):
+        """Retarget the router at ``n_replicas`` (elastic pools — the
+        autoscaler grew or shrank membership).  Rendezvous hashing is
+        stateless over the index range, so this is exactly the stability
+        property the scheme exists for: when the pool shrinks only the
+        removed index's prefixes move; when it grows only the prefixes
+        the new index wins migrate to it."""
+        n_replicas = int(n_replicas)
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        self.n_replicas = n_replicas
+
     # ------------------------------------------------------------- hashing
     def _score(self, key, idx):
         h = hashlib.sha1(key + b"|" + str(idx).encode()).digest()

@@ -42,6 +42,7 @@ while ANY replica is routable — a load balancer should keep sending);
 from __future__ import annotations
 
 import itertools
+import logging
 import queue as _queue
 import threading
 import time
@@ -57,6 +58,8 @@ from .router import ROUTABLE_STATES, PrefixAffinityRouter
 #: not "the request reached its own end"
 _REPLICA_LOST = ("stopped", "error")
 
+_logger = logging.getLogger(__name__)
+
 
 class ClusterHandle(RequestHandle):
     """Caller-side view of a cluster request — the same ``result()`` /
@@ -67,7 +70,7 @@ class ClusterHandle(RequestHandle):
 
     def __init__(self, request_id, prompt, max_new_tokens, sampling,
                  eos_token_id, deadline, adapter=None, grammar=None,
-                 mode="generate", pooling="mean"):
+                 mode="generate", pooling="mean", tier=None):
         super().__init__(request_id, len(prompt))
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -75,11 +78,13 @@ class ClusterHandle(RequestHandle):
         self.eos_token_id = eos_token_id
         self.deadline = deadline            # absolute time.time(), or None
         # multi-tenant fields ride the outer handle so failover legs
-        # re-submit with the same tenant/grammar/mode
+        # re-submit with the same tenant/grammar/mode — and the QoS tier
+        # rides the same way, so a rerouted leg keeps its priority
         self.adapter = adapter
         self.grammar = grammar
         self.mode = mode
         self.pooling = pooling
+        self.tier = tier
         self.replica_history = []
         self._inner = None                  # current leg's engine handle
         self._legs = 0
@@ -113,7 +118,7 @@ class ServingCluster:
                  router=None, policy="affinity", affinity_tokens=None,
                  saturation_queue=None, seed=0, max_reroutes=None,
                  poll_s=0.002, replica_prefix="", name=None, slo=None,
-                 **engine_kwargs):
+                 qos=None, autoscale=None, **engine_kwargs):
         if pool is None:
             if model is None:
                 raise ValueError("need a model (or a prebuilt pool=)")
@@ -125,6 +130,10 @@ class ServingCluster:
                 # legs it served under its replica= label (a prebuilt
                 # pool= configures its own engines)
                 engine_kwargs.setdefault("slo", slo)
+            if qos is not None:
+                # one QoSConfig is immutable and safely shared: every
+                # replica gets the same tier table (queues stay per-engine)
+                engine_kwargs.setdefault("qos", qos)
             pool = ReplicaPool(model, replicas=replicas, devices=devices,
                                replica_prefix=replica_prefix,
                                **engine_kwargs)
@@ -154,6 +163,22 @@ class ServingCluster:
             else n
         self._poll_s = float(poll_s)
         self._lock = threading.Lock()
+        # elastic membership: routing decisions and router resizes are
+        # serialized so a route never runs against a half-applied resize
+        self._route_lock = threading.Lock()
+        self._autoscaler = None
+        if autoscale:
+            from ..qos import AutoScaler
+
+            if isinstance(autoscale, AutoScaler):
+                self._autoscaler = autoscale
+            else:
+                kw = dict(autoscale) if isinstance(autoscale, dict) else {}
+                kw.setdefault("cluster", self.name)
+                # the scale-up burn signal: the worst protected-tier burn
+                # across the fleet (0.0 on non-QoS engines)
+                kw.setdefault("burn_source", self._qos_burn)
+                self._autoscaler = AutoScaler(pool, **kw)
         self._inflight: set[ClusterHandle] = set()
         self._rid = itertools.count()
         self._started = False
@@ -291,13 +316,16 @@ class ServingCluster:
     # ------------------------------------------------------------------ api
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
                eos_token_id=None, deadline_s=None, sampling=None,
-               adapter=None, grammar=None, mode="generate", pooling="mean"):
+               adapter=None, grammar=None, mode="generate", pooling="mean",
+               tier=None):
         """Route one request onto a replica; returns a
         :class:`ClusterHandle` immediately.  ``adapter`` (LoRA tenant),
         ``grammar`` (constrained decoding) and ``mode`` (generate | embed
         | score) forward to the replica engines — multi-tenant pools only
         (``ReplicaPool(lora_store=...)``); adapter-named requests route by
-        ADAPTER affinity so a tenant's weights page into one replica."""
+        ADAPTER affinity so a tenant's weights page into one replica.
+        ``tier`` names the request's QoS tier (QoS-enabled pools only)
+        and rides every failover leg."""
         prompt = ServingEngine._normalize_prompt(prompt_ids)
         if not prompt:
             raise ValueError("empty prompt")
@@ -313,7 +341,7 @@ class ServingCluster:
         h = ClusterHandle(f"c{next(self._rid)}", prompt,
                           int(max_new_tokens), sampling, eos_token_id,
                           deadline, adapter=adapter, grammar=grammar,
-                          mode=mode, pooling=pooling)
+                          mode=mode, pooling=pooling, tier=tier)
         # register BEFORE the leg, atomically with the stopping check: a
         # submit racing stop() either rejects here or its handle is seen
         # by stop()'s leftover sweep — never a live handle nobody pumps
@@ -351,8 +379,14 @@ class ServingCluster:
         """Route + submit one leg (caller OR monitor thread).  A rejection
         from the chosen engine (bounded queue, deadline shed) spills to
         the next-best routable replica before surfacing."""
-        states = self._pool.states()
-        dec = self._router.route(prompt, states, adapter=h.adapter)
+        # one atomic (engines, states) snapshot: with an autoscaler the
+        # membership can change between routing and submission, so every
+        # index below is into THIS snapshot, never the live pool list
+        engines, states = self._pool.snapshot_states()
+        with self._route_lock:
+            if self._router.n_replicas != len(states):
+                self._router.resize(len(states))
+            dec = self._router.route(prompt, states, adapter=h.adapter)
         self._m_routable.set(sum(1 for st in states
                                  if st["state"] in ROUTABLE_STATES))
         if dec is None:
@@ -368,13 +402,13 @@ class ServingCluster:
             key=lambda i: states[i]["queue_depth"] + states[i]["active"])
         last_rejection = None
         for idx in order:
-            eng = self._pool.engines[idx]
+            eng = engines[idx]
             # the full RouteDecision rides the span as REAL attributes
             # (OTLP/chrome export them as-is), so failover forensics read
             # affine/hit/reason off the trace instead of grepping logs
             with _tracing.span("cluster.route", trace_id=h.trace_id,
                                request_id=h.request_id, replica=eng.replica,
-                               affine=self._pool.engines[dec.affine].replica,
+                               affine=engines[dec.affine].replica,
                                hit=idx == dec.affine, policy=dec.policy,
                                reason=dec.reason, leg=h._legs + 1):
                 try:
@@ -390,7 +424,8 @@ class ServingCluster:
                         eos_token_id=h.eos_token_id, deadline_s=deadline_s,
                         sampling=h.sampling, adapter=h.adapter,
                         grammar=h.grammar, mode=h.mode, pooling=h.pooling,
-                        _fsm_state=fsm_state, _autostart=False)
+                        tier=h.tier, _fsm_state=fsm_state,
+                        _autostart=False)
                 except (RequestRejectedError, RuntimeError) as e:
                     # RequestRejectedError: engine shed it (bounded queue,
                     # deadline, draining).  RuntimeError (incl. Engine-
@@ -426,6 +461,14 @@ class ServingCluster:
     def _monitor(self):
         while not self._mon_stop.is_set():
             self._pump()
+            if self._autoscaler is not None and not self._stopping:
+                try:
+                    self._autoscaler.tick()
+                except Exception:
+                    # a scaling hiccup (replica ctor raced a device error,
+                    # say) must never kill the monitor: requests in flight
+                    # depend on this thread pumping their tokens
+                    _logger.exception("autoscaler tick failed")
             self._mon_stop.wait(self._poll_s)
         self._pump()  # final sweep so stop()-time events still land
 
@@ -463,6 +506,9 @@ class ServingCluster:
         h._events.put(("token", tok))
 
     def _on_leg_done(self, h, inner, status):
+        # fold the leg's QoS eviction count into the caller-visible total
+        # BEFORE deciding on reroute — a rerouted leg's preemptions count
+        h.preemptions += getattr(inner, "preemptions", 0)
         if status in _REPLICA_LOST and not self._stopping \
                 and not h.cancelled and self._try_reroute(h):
             return
@@ -540,6 +586,14 @@ class ServingCluster:
     def health(self):
         return self.health_state()["state"]
 
+    def _qos_burn(self):
+        """Autoscaler burn signal: the WORST protected-tier burn rate
+        across the fleet (one hot replica is an incident even when its
+        siblings are idle); None when no engine accounts a tier SLO."""
+        rates = [e.qos_burn_rate() for e in list(self._pool.engines)
+                 if hasattr(e, "qos_burn_rate")]
+        return max(rates) if rates else None
+
     # -------------------------------------------------------------- insight
     @property
     def pool(self):
@@ -548,6 +602,12 @@ class ServingCluster:
     @property
     def router(self):
         return self._router
+
+    @property
+    def autoscaler(self):
+        """The cluster's :class:`~paddle_tpu.serving.qos.AutoScaler`
+        (None unless ``autoscale=`` was set)."""
+        return self._autoscaler
 
     @property
     def slo_accountant(self):
@@ -610,8 +670,19 @@ class ServingCluster:
         st["health"] = self.health_state()
         if self._slo is not None:
             st["slo"] = self._slo.summary()
+        if self._autoscaler is not None:
+            sc = self._autoscaler
+            st["autoscaler"] = {
+                "min_replicas": sc.min_replicas,
+                "max_replicas": sc.max_replicas,
+                "replicas": len(self._pool),
+                "retiring": sc.retiring.replica
+                if sc.retiring is not None else None,
+                "timeline": sc.timeline(),
+            }
         per = {}
-        for snap, e in zip(self._pool.states(), self._pool.engines):
+        engines, states = self._pool.snapshot_states()
+        for snap, e in zip(states, engines):
             per[e.replica] = {
                 "state": snap["state"],
                 "reasons": snap["reasons"],

@@ -192,6 +192,10 @@ class Request:
     mode: str = "generate"
     pooling: str = "mean"
     lease: object = None
+    # QoS tier name (serving/qos.py) — None on engines without a tier
+    # table; resolved to a configured tier at submit on QoS engines, and
+    # carried verbatim across requeues (restart recovery / preemption)
+    tier: str | None = None
 
 
 class RequestHandle:
@@ -214,6 +218,12 @@ class RequestHandle:
         self.value = None
         self.adapter = None
         self._fsm_state = None
+        # QoS surface: the request's resolved tier name (None on non-QoS
+        # engines) and how many times a higher tier evicted it from a
+        # decode slot (each eviction requeued it as prompt+tokens-so-far,
+        # so greedy output is unaffected — only latency is)
+        self.tier = None
+        self.preemptions = 0
         # distributed-tracing identity: every span this request touches
         # (submit -> prefill -> each decode iteration) carries/links it
         self.trace_id = _tracing.new_trace_id()
@@ -365,7 +375,7 @@ class ServingEngine:
                  speculative_k=0, draft_max_ngram=3, draft_min_ngram=1,
                  replica="0", device=None, health_gating=True, slo=None,
                  kv_dtype=None, weight_dtype=None, numeric_guard=None,
-                 prefill_chunk_tokens=None, mesh=None):
+                 prefill_chunk_tokens=None, mesh=None, qos=None):
         self._model = model
         # chunked prefill (README "Flash decode & chunked prefill"):
         # prompts longer than N tokens are admitted IMMEDIATELY and
@@ -426,6 +436,11 @@ class ServingEngine:
         self.replica = str(replica)
         self._site_wedge = f"serving.scheduler_wedge@{self.replica}"
         self._site_step_crash = f"serving.step_crash@{self.replica}"
+        # replica-loss chaos site (QoS/autoscaling bench): when armed and
+        # it fires, the scheduler raises a FATAL error — the replica dies
+        # like a reclaimed spot host, the cluster reroutes its in-flight
+        # work and the autoscaler reaps + replaces it
+        self._site_replica_preempt = f"cluster.replica_preempt@{self.replica}"
         self._provider_key = f"serving/{self.replica}"
         # False for cluster replicas: the replica still shows on /healthz
         # but the ServingCluster's any-replica-routable component gates
@@ -556,7 +571,39 @@ class ServingEngine:
         self._spec_proposed_total = 0
         self._spec_accepted_total = 0
 
-        self._queue = collections.deque()
+        # QoS tiers (serving/qos.py, README "QoS tiers & autoscaling"):
+        # qos=True installs the default realtime/standard/batch table, a
+        # QoSConfig a custom one.  The queue becomes per-tier with
+        # priority-weighted head selection; submits carry tier=, each
+        # tier with an SLOPolicy gets its own accountant (tier= label),
+        # admission sheds by the brownout ladder, and high-tier requests
+        # preempt lower-tier decode slots instead of waiting.
+        self._qos = None
+        self._tier_slo = {}
+        self._tier_ema = {}          # per-tier completed-duration EMAs
+        self._last_preempt_t = None
+        self._bo_cache = (0.0, None)  # throttled brownout snapshot
+        if qos:
+            from .qos import QoSConfig
+
+            if qos is True:
+                qos = QoSConfig()
+            if not isinstance(qos, QoSConfig):
+                raise TypeError(f"qos must be a QoSConfig or True, "
+                                f"got {qos!r}")
+            self._qos = qos
+            from ..observability.slo import SLOAccountant as _TierAcct
+
+            for t in qos.tiers:
+                if t.slo is not None:
+                    self._tier_slo[t.name] = _TierAcct(
+                        t.slo, replica=self.replica, tier=t.name)
+        if self._qos is not None:
+            from .qos import TieredQueue
+
+            self._queue = TieredQueue(self._qos)
+        else:
+            self._queue = collections.deque()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._slots = [None] * self.num_slots
@@ -673,7 +720,15 @@ class ServingEngine:
             "serving.admissions_blocked",
             "admissions deferred: page pool exhausted")
         self._m_preempt = _c(
-            "serving.preemptions", "running sequences retired by deadline")
+            "serving.preemptions",
+            "sequences evicted from their decode slot (reason=deadline: "
+            "retired expired; reason=qos: requeued for a higher tier)")
+        # per-tier pressure gauges (QoS engines set them; registered
+        # unconditionally so the metric families are stable)
+        self._m_tier_depth = _g(
+            "serving.tier.queue_depth", "queued requests per QoS tier")
+        self._m_tier_active = _g(
+            "serving.tier.active_slots", "decoding slots held per QoS tier")
         self._m_step_traces = _c(
             "serving.step_traces", "decode-step program traces")
         self._m_prefill_traces = _c(
@@ -1256,7 +1311,7 @@ class ServingEngine:
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
                eos_token_id=None, deadline_s=None, sampling=None,
                adapter=None, grammar=None, mode="generate", pooling="mean",
-               _fsm_state=None, _autostart=True):
+               tier=None, _fsm_state=None, _autostart=True):
         """Queue one request; returns a :class:`RequestHandle` immediately.
         ``deadline_s`` is a wall-clock budget from now — a sequence still
         queued or decoding past it is retired with status ``expired``.
@@ -1271,10 +1326,24 @@ class ServingEngine:
         without decode slots or pages); ``pooling`` (mean | last) shapes
         the embed vector.
 
+        ``tier`` names a QoS tier (``ServingEngine(qos=...)`` engines
+        only; ``None`` = the config's default tier) — it selects the
+        request's queue, admission weight, SLO accounting and preemption
+        rank (README "QoS tiers & autoscaling").
+
         ``_autostart=False`` (the cluster's leg path) never starts a
         stopped engine: the submit is rejected instead, atomically with
         the enqueue, so a leg racing ``stop()`` cannot resurrect the
         replica or enqueue past the stop-time handle sweep."""
+        # chaos site: an armed fn here drives deterministic overload (the
+        # bench's traffic-spike arm submits a burst from inside the Nth
+        # submit call) — disarmed it is one flag check
+        _faults.maybe("serving.traffic_spike")
+        if self._qos is not None:
+            tier = self._qos.resolve(tier)
+        elif tier is not None:
+            raise ValueError(
+                "tier= needs a QoS-enabled engine (ServingEngine(qos=...))")
         prompt = self._normalize_prompt(prompt_ids)
         if not prompt:
             raise ValueError("empty prompt")
@@ -1290,6 +1359,7 @@ class ServingEngine:
         handle = RequestHandle(next(self._rid_counter), len(prompt))
         handle.mode = mode
         handle.adapter = adapter
+        handle.tier = tier
         if grammar is not None:
             handle._fsm_state = _fsm_state if _fsm_state is not None \
                 else grammar.start
@@ -1326,13 +1396,18 @@ class ServingEngine:
                         f"replica {self.replica} is not running")
                 if self._draining:
                     self._shed("draining",
-                               "engine is draining; not admitting new work")
+                               "engine is draining; not admitting new work",
+                               tier=tier)
+                if self._qos is not None:
+                    self._check_qos_admission(tier)
                 if self._max_queue is not None \
                         and len(self._queue) >= self._max_queue:
                     self._shed("queue_full",
-                               f"admission queue full ({self._max_queue})")
+                               f"admission queue full ({self._max_queue})",
+                               tier=tier)
                 if deadline_s is not None:
-                    self._check_deadline_meetable(float(deadline_s))
+                    self._check_deadline_meetable(float(deadline_s),
+                                                  tier=tier)
                 self._preflight_hbm(handle, prompt, total, mode)
                 deadline = time.time() + deadline_s \
                     if deadline_s is not None else None
@@ -1340,7 +1415,7 @@ class ServingEngine:
                                            sampling, eos_token_id, deadline,
                                            handle, adapter=adapter,
                                            grammar=grammar, mode=mode,
-                                           pooling=pooling))
+                                           pooling=pooling, tier=tier))
                 self._m_requests.inc(status="submitted")
                 self._m_queue_depth.set(len(self._queue))
                 self._cv.notify_all()
@@ -1359,12 +1434,39 @@ class ServingEngine:
                 "engine (paddle_tpu.serving.multitenant.MultiTenantEngine)")
         return eos_token_id
 
-    def _shed(self, reason, message):
+    def _shed(self, reason, message, tier=None):
         """Reject at admission with a distinct, machine-readable reason
-        (load shedding under pressure beats timing out after queueing)."""
-        self._m_shed.inc(reason=reason)
+        (load shedding under pressure beats timing out after queueing).
+        The ``tier=`` label is only attached on QoS engines so that
+        label-less ``.get(reason=...)`` lookups keep working elsewhere."""
+        if tier is not None:
+            self._m_shed.inc(reason=reason, tier=tier)
+        else:
+            self._m_shed.inc(reason=reason)
         self._m_requests.inc(status="rejected")
         raise RequestRejectedError(message, reason=reason)
+
+    def _check_qos_admission(self, tier):
+        """SLO-aware admission for QoS engines (called under the cv lock):
+        shed whole tiers by the brownout ladder — a tier is shed once the
+        protected tier's error-budget burn rate crosses that tier's
+        ``shed_burn_rate`` — and enforce per-tier queue caps.  This
+        replaces pressure signalling via one global ``queue_full`` gate
+        with attribution: during a brownout only the tiers whose
+        threshold tripped are rejected."""
+        bo = self._brownout()
+        if tier in bo["shed"]:
+            self._shed(
+                "brownout",
+                f"tier {tier!r} shed at brownout level {bo['level']} "
+                f"({bo['state']}): protected-tier burn rate "
+                f"{bo['burn_rate']:.2f}", tier=tier)
+        pol = self._qos.tier(tier)
+        if pol.max_queue is not None \
+                and self._queue.depth(tier) >= pol.max_queue:
+            self._shed("queue_full",
+                       f"tier {tier!r} queue full ({pol.max_queue})",
+                       tier=tier)
 
     def _preflight_hbm(self, handle, prompt, total, mode):
         """OOM forensics' prevention half (observability/memory.py):
@@ -1407,30 +1509,45 @@ class ServingEngine:
             with self._commit_lock:
                 self._committed_pages -= n
 
-    def _check_deadline_meetable(self, deadline_s):
+    def _check_deadline_meetable(self, deadline_s, tier=None):
         """Deadline-aware admission (called under the cv lock): shed NOW if
         the scheduler has been stalled longer than the whole deadline
         budget, or if the queue-position estimate (queue depth over slots
         times the completed-request duration EMA) already exceeds it —
         rejecting in microseconds beats returning 'expired' after the
-        deadline burned queue and pages."""
+        deadline burned queue and pages.
+
+        QoS engines estimate per tier: the duration EMA is the submitting
+        tier's own completed-request EMA (one global EMA lets slow
+        batch-tier requests inflate the estimate and falsely shed fast
+        realtime traffic), and the queue-ahead count only counts requests
+        at the same or higher priority — lower tiers behind us in the
+        weighted queue (and preemptible under pressure) don't delay us."""
         stamp = self._progress_t
         if stamp is not None and not self._compiling:
             stall = time.monotonic() - stamp
             if stall > max(self._degraded_stall_s, deadline_s):
                 self._shed("deadline_unmeetable",
                            f"scheduler stalled for {stall:.2f}s, longer "
-                           f"than the {deadline_s:.2f}s deadline")
-        if self._ema_request_s is not None and self._queue:
-            est = (len(self._queue) / max(self.num_slots, 1) + 1.0) \
-                * self._ema_request_s
+                           f"than the {deadline_s:.2f}s deadline",
+                           tier=tier)
+        if self._qos is not None and tier is not None:
+            ema = self._tier_ema.get(tier, self._ema_request_s)
+            ahead = self._queue.depth_at_or_above(
+                self._qos.tier(tier).priority)
+        else:
+            ema = self._ema_request_s
+            ahead = len(self._queue)
+        if ema is not None and ahead:
+            est = (ahead / max(self.num_slots, 1) + 1.0) * ema
             if est > deadline_s:
                 self._shed(
                     "deadline_unmeetable",
-                    f"estimated completion in {est:.2f}s (queue depth "
-                    f"{len(self._queue)}, typical request "
-                    f"{self._ema_request_s:.2f}s) exceeds the "
-                    f"{deadline_s:.2f}s deadline")
+                    f"estimated completion in {est:.2f}s (queue-ahead "
+                    f"{ahead}, typical request {ema:.2f}s"
+                    + (f" for tier {tier!r}" if tier is not None else "")
+                    + f") exceeds the {deadline_s:.2f}s deadline",
+                    tier=tier)
 
     def generate(self, prompt_ids, max_new_tokens=32, timeout=None, **kw):
         """Blocking convenience: submit + wait; returns generated ids."""
@@ -1700,6 +1817,21 @@ class ServingEngine:
                 self._progress_t = time.monotonic()
                 _faults.maybe("serving.scheduler_wedge")
                 _faults.maybe(self._site_wedge)  # replica-scoped chaos site
+                if _faults.armed(self._site_replica_preempt):
+                    # injected replica loss (autoscaler reap / cluster
+                    # reroute drill): when the site trips, this replica
+                    # dies FATALLY — the raised message deliberately
+                    # avoids every transient pattern (including the word
+                    # in the site name) so classify_failure routes it to
+                    # abort, not self-restart
+                    before = _faults.trip_count(self._site_replica_preempt)
+                    _faults.maybe(self._site_replica_preempt)
+                    if _faults.trip_count(self._site_replica_preempt) \
+                            > before:
+                        raise RuntimeError(
+                            f"replica {self.replica} lost: host reclaimed "
+                            "by the cluster scheduler (injected replica "
+                            "loss)")
                 self._admit()
                 # chunked prefill rides the SAME scheduler iteration as the
                 # decode dispatch: one budget's worth of chunk work, then
@@ -1816,6 +1948,163 @@ class ServingEngine:
                 self._m_requeued.inc()
             self._m_queue_depth.set(len(self._queue))
 
+    # --------------------------------------------------- QoS preemption
+    def _queue_pop(self, req):
+        """Pop the already-peeked head ``req`` (called under the lock).
+        QoS engines pop by identity — preemption may have appendleft'd
+        victims into lower-priority tiers between the peek and this pop,
+        and a positional pop must never swallow a victim."""
+        if self._qos is not None:
+            self._queue.pop_exact(req)
+        else:
+            self._queue.popleft()
+
+    def _count_preemption(self, req, reason):
+        """serving.preemptions: label-less on non-tiered requests (the
+        deadline-expiry path predates QoS; its exact-match ``.get()``
+        lookups must keep resolving), ``{tier=,reason=}`` on QoS ones."""
+        if req.tier is not None:
+            self._m_preempt.inc(tier=req.tier, reason=reason)
+        else:
+            self._m_preempt.inc()
+
+    def _preempt_victims(self, req):
+        """Decode slots ``req`` may evict, cheapest first: strictly
+        lower-priority preemptible tiers, ordered lowest priority then
+        least produced (minimum re-prefill work on resume).  Slots that
+        already hit EOS / budget are skipped — they retire and free their
+        resources on the very next step without losing anything."""
+        pri = self._qos.tier(req.tier).priority
+        out = []
+        for i, s in enumerate(self._slots):
+            if s is None or s.req.tier is None:
+                continue
+            pol = self._qos.tier(s.req.tier)
+            if not pol.preemptible or pol.priority >= pri:
+                continue
+            if (s.eos is not None and s.last == s.eos) \
+                    or s.produced >= s.max_new:
+                continue
+            out.append((pol.priority, s.produced, i))
+        out.sort()
+        return [i for _, _, i in out]
+
+    def _preempt_for_slot(self, req):
+        """All slots busy: evict one lower-tier victim so ``req`` admits
+        this iteration instead of waiting out a full decode.  Returns the
+        freed slot index, or None (non-QoS engine / nothing evictable)."""
+        if self._qos is None or req.tier is None:
+            return None
+        victims = self._preempt_victims(req)
+        if not victims:
+            return None
+        i = victims[0]
+        self._preempt_slot(i)
+        return i
+
+    def _preempt_for_pages(self, req):
+        """Page pool exhausted: evict lower-tier victims until ``req``'s
+        allocation fits.  Guarded against thrash — if evicting EVERY
+        eligible victim still could not cover the need, nothing is
+        evicted and the request parks (blocked), exactly as before."""
+        if self._qos is None or req.tier is None:
+            return None
+        victims = self._preempt_victims(req)
+        if not victims:
+            return None
+        need = self._bm.pages_for(len(req.prompt) + req.max_new_tokens)
+        free = self._bm.num_pages - self._bm.used_pages
+        gain = sum(len(self._slots[i].alloc.pages) for i in victims)
+        if free + gain < need:
+            return None
+        for i in victims:
+            self._preempt_slot(i)
+            alloc = self._bm.allocate(
+                req.prompt, len(req.prompt) + req.max_new_tokens)
+            if alloc is not None:
+                return alloc
+        return None
+
+    def _preempt_slot(self, i):
+        """Evict slot ``i`` for QoS (called under the lock): free its
+        pages, clear its lane, and re-queue it at the FRONT of its tier as
+        prompt + tokens-so-far with the remaining budget — the _recover
+        requeue machinery scheduled on purpose, so a preempted greedy
+        request's final ids are byte-identical to an uninterrupted run.
+        Tokens already emitted stay emitted."""
+        s = self._slots[i]
+        h = s.handle
+        produced = s.produced
+        self._bm.free(s.alloc)
+        self._release_tenant(s.req)
+        self._slots[i] = None
+        self._clear_slot_row(i, s)
+        if h.cancelled:
+            self._finish(h, "cancelled")
+            return
+        remaining = s.req.max_new_tokens - produced
+        if remaining <= 0:      # had finished; eviction beat the retire
+            self._finish(h, "completed")
+            return
+        prompt = list(s.req.prompt) + \
+            ([int(t) for t in h.token_ids[-produced:]] if produced else [])
+        h.status = "queued"
+        h.preemptions += 1
+        self._queue.appendleft(dataclasses.replace(
+            s.req, prompt=prompt, max_new_tokens=remaining, lease=None))
+        self._m_requeued.inc()
+        self._count_preemption(s.req, "qos")
+        self._last_preempt_t = time.monotonic()
+        self._bo_cache = (0.0, None)    # ladder rung changed: drop cache
+
+    def _brownout(self):
+        """Current brownout rung (cached ~50ms — burn rates move at
+        request cadence, admission runs per submit)."""
+        from . import qos as _qos_mod
+
+        now = time.monotonic()
+        cached_t, cached = self._bo_cache
+        if cached is not None and now - cached_t < 0.05:
+            return cached
+        preempting = self._last_preempt_t is not None \
+            and now - self._last_preempt_t < 1.0
+        bo = _qos_mod.brownout(self._qos, self.qos_burn_rate(),
+                               preempting=preempting)
+        self._bo_cache = (now, bo)
+        return bo
+
+    def qos_burn_rate(self):
+        """The protected (highest-priority) tier's error-budget burn rate
+        — the scalar driving the brownout ladder and the autoscaler; 0.0
+        until that tier has completed requests in its window (or on
+        non-QoS engines)."""
+        if self._qos is None:
+            return 0.0
+        acct = self._tier_slo.get(self._qos.protected.name)
+        if acct is None:
+            return 0.0
+        cur = acct.current()
+        if not cur or cur.get("burn_rate") is None:
+            return 0.0
+        return float(cur["burn_rate"])
+
+    def begin_drain(self):
+        """Non-blocking drain request (autoscaler scale-down): stop
+        admitting — submits shed with reason ``draining`` — while
+        in-flight work runs to completion.  Poll :attr:`quiescent` to
+        learn when the replica can be retired."""
+        self._draining = True
+
+    @property
+    def quiescent(self):
+        """True once nothing is queued or in flight (drain complete)."""
+        if self._error is not None or not self._started:
+            return True
+        with self._lock:
+            return not self._queue \
+                and all(s is None for s in self._slots) \
+                and self._admitting is None
+
     def _abort_all(self, exc):
         pending, self._admitting = self._admitting, None
         if pending is not None:
@@ -1863,7 +2152,7 @@ class ServingEngine:
                     # base engine's submit validation never queues these)
                     if not self._acquire_tenant(req):
                         return          # adapter slots pinned: stay queued
-                    self._queue.popleft()
+                    self._queue_pop(req)
                     self._m_queue_depth.set(len(self._queue))
                     self._admitting = req
                     alloc = free_slot = None
@@ -1871,9 +2160,16 @@ class ServingEngine:
                     free_slot = next((i for i, s in enumerate(self._slots)
                                       if s is None), None)
                     if free_slot is None:
+                        # QoS: a full batch must not gate high-tier work —
+                        # evict the cheapest strictly-lower-tier slot and
+                        # take its lane (no-op on non-QoS engines)
+                        free_slot = self._preempt_for_slot(req)
+                    if free_slot is None:
                         return
                     alloc = self._bm.allocate(
                         req.prompt, len(req.prompt) + req.max_new_tokens)
+                    if alloc is None:
+                        alloc = self._preempt_for_pages(req)
                     if alloc is None:
                         # FIFO admission: park until a retirement frees
                         # pages
@@ -1885,7 +2181,7 @@ class ServingEngine:
                         self._bm.free(alloc)
                         self._m_blocked.inc()
                         return
-                    self._queue.popleft()
+                    self._queue_pop(req)
                     self._m_queue_depth.set(len(self._queue))
                     # between dequeue and slot assignment the request lives
                     # in _admitting so a crash mid-prefill can still
@@ -2070,7 +2366,7 @@ class ServingEngine:
                                and time.time() > s.deadline):
                 status = "cancelled" if h.cancelled else "expired"
                 if status == "expired":
-                    self._m_preempt.inc()
+                    self._count_preemption(s.req, "deadline")
                 self._bm.free(s.alloc)
                 self._release_tenant(s.req)
                 self._slots[i] = None
@@ -2524,10 +2820,17 @@ class ServingEngine:
     def _emit_token(self, slot, tok):
         h = slot.handle
         now = time.time()
+        # QoS engines label the latency histograms per tier (the bench's
+        # per-tier p95s); non-tiered requests keep the label-less children
+        # so existing exact-match lookups stay resolvable
+        tier = slot.req.tier
         if h.first_token_at is None:
             h.first_token_at = now
             h.first_token_iteration = self._iteration
-            self._m_ttft.observe(now - h.submitted_at)
+            if tier is not None:
+                self._m_ttft.observe(now - h.submitted_at, tier=tier)
+            else:
+                self._m_ttft.observe(now - h.submitted_at)
             if h.compile_s > 0.0:
                 # compile-paying first token: parallel family (not a label
                 # on serving.ttft_seconds — existing per-replica children
@@ -2535,7 +2838,10 @@ class ServingEngine:
                 # TTFT dashboards can subtract cold starts
                 self._m_ttft_cold.observe(now - h.submitted_at)
         elif slot.last_token_t is not None:
-            self._m_itl.observe(now - slot.last_token_t)
+            if tier is not None:
+                self._m_itl.observe(now - slot.last_token_t, tier=tier)
+            else:
+                self._m_itl.observe(now - slot.last_token_t)
         slot.last_token_t = now
         h.token_ids.append(tok)
         h.token_times.append(now)
@@ -2554,7 +2860,7 @@ class ServingEngine:
             status = self._budget_status(slot)
         elif slot.deadline is not None and time.time() > slot.deadline:
             status = "expired"
-            self._m_preempt.inc()
+            self._count_preemption(slot.req, "deadline")
         if status is None:
             return False
         self._bm.free(slot.alloc)
@@ -2603,6 +2909,13 @@ class ServingEngine:
             dur = handle.finished_at - handle.submitted_at
             self._ema_request_s = dur if self._ema_request_s is None \
                 else 0.8 * self._ema_request_s + 0.2 * dur
+            tier = getattr(handle, "tier", None)
+            if tier is not None:
+                # per-tier EMA: a slow batch request must not inflate the
+                # realtime deadline estimate (see _check_deadline_meetable)
+                prev = self._tier_ema.get(tier)
+                self._tier_ema[tier] = dur if prev is None \
+                    else 0.8 * prev + 0.2 * dur
         if self._slo is not None and status in ("completed", "expired") \
                 and handle.mode == "generate":
             # expired = the deadline preempted it: an SLO miss by
@@ -2611,6 +2924,12 @@ class ServingEngine:
             # engine, not the latency promise.
             self._slo.observe(handle, met_override=False
                               if status == "expired" else None)
+        if self._tier_slo and status in ("completed", "expired") \
+                and handle.mode == "generate":
+            acct = self._tier_slo.get(getattr(handle, "tier", None))
+            if acct is not None:
+                acct.observe(handle, met_override=False
+                             if status == "expired" else None)
         self._m_requests.inc(status=status)
         handle._events.put(("done", status))
         handle._done.set()
@@ -2631,6 +2950,15 @@ class ServingEngine:
         self._m_page_util.set(self._bm.utilization())
         self._m_pages_used.set(self._bm.used_pages)
         self._m_health.set(_HEALTH_CODE.get(self.health, 1))
+        if self._qos is not None:
+            for tname, depth in self._queue.depths().items():
+                self._m_tier_depth.set(depth, tier=tname)
+            active = dict.fromkeys(self._qos.names, 0)
+            for s in self._slots:
+                if s is not None and s.req.tier in active:
+                    active[s.req.tier] += 1
+            for tname, cnt in active.items():
+                self._m_tier_active.set(cnt, tier=tname)
         if self.weight_dtype == "int8" and now - self._drift_t > 5.0:
             # quant drift is a slow dashboard (host-side weight walk):
             # one sampled layer every few seconds, never per step
@@ -2674,6 +3002,12 @@ class ServingEngine:
                 time.monotonic() - self._last_restart_t \
                 < self._restart_cooldown_s:
             reasons.append(f"recent_restart:{self._engine_restarts}")
+        if self._qos is not None:
+            bo = self._brownout()
+            if bo["level"]:
+                # a brownout is degraded-but-serving: high tiers are fine
+                # BY CONSTRUCTION of the shed, but operators must see it
+                reasons.append(f"brownout:L{bo['level']}:{bo['state']}")
         return {"state": "degraded" if reasons else "healthy",
                 "reasons": reasons}
 
@@ -2762,6 +3096,22 @@ class ServingEngine:
         st["typical_request_s"] = self._ema_request_s
         if self._slo is not None:
             st["slo"] = self._slo.summary()
+        if self._qos is not None:
+            # per-tier queue table + ladder rung: makes a brownout's shed
+            # decisions attributable from the status page alone
+            active = dict.fromkeys(self._qos.names, 0)
+            for s in self._slots:
+                if s is not None and s.req.tier in active:
+                    active[s.req.tier] += 1
+            st["qos"] = {
+                "config": self._qos.to_dict(),
+                "brownout": self._brownout(),
+                "queue_by_tier": self._queue.depths(),
+                "active_by_tier": active,
+                "typical_request_s_by_tier": dict(self._tier_ema),
+                "slo_by_tier": {name: acct.summary()
+                                for name, acct in self._tier_slo.items()},
+            }
         if self._progress_t is not None:
             st["last_progress_age_s"] = time.monotonic() - self._progress_t
         slots = []

@@ -199,6 +199,7 @@ def test_sep_alltoall_manual_roundtrip():
     np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_sep_attention_matches_plain():
     from paddle_tpu.distributed.fleet.meta_parallel import sep_attention
     from paddle_tpu.distributed import topology as topo
@@ -238,6 +239,7 @@ def test_native_pipeline_kernels():
 
 
 # ================================================== PP-YOLOE proper (r3)
+@pytest.mark.slow
 def test_cspresnet_backbone_and_pan():
     from paddle_tpu.vision.models.cspresnet import CSPRepResNet, CustomCSPPAN
 
@@ -315,6 +317,7 @@ def test_ppyoloe_trains_and_evals():
     np.testing.assert_allclose(s0, s1, rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ppyoloe_loss_on_non_divisible_input():
     """Centers must come from the REAL conv grid, not img_size//stride
     (they differ when H,W aren't divisible by 32)."""
@@ -352,6 +355,7 @@ def test_rcnn_delta_coder_roundtrip():
                                [5.0, 0.0, 0.0, 0.0], atol=1e-5)
 
 
+@pytest.mark.slow
 def test_rcnn_class_specific_regression_shapes():
     from paddle_tpu.vision.models import faster_rcnn
 

@@ -110,6 +110,7 @@ def test_pipeline_forward_interleaved_parity():
     np.testing.assert_allclose(hidden_ref, hi, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_gpt_pipe_interleaved_trains():
     """GPTForCausalLMPipe(schedule='interleaved') trains and matches the
     unsharded model's losses."""

@@ -425,6 +425,7 @@ def test_embed_score_ride_scheduler_without_pages(model, store):
 
 
 # ================================================== quant x LoRA + chaos
+@pytest.mark.slow
 def test_quant_lora_composition_and_restart_byte_stable():
     """ISSUE-9 satellite: int8 KV pages + int8 base weights + full-
     precision adapter pools keep top-1 agreement >= 0.99 against the

@@ -71,6 +71,7 @@ def test_ring_attention_matches_single_device(causal):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_grad():
     from jax.sharding import Mesh
 

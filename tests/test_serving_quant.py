@@ -282,6 +282,7 @@ def test_int8_engine_serves_and_agrees(cyclic_model):
     assert top1_agreement(ref, got) >= 0.99
 
 
+@pytest.mark.slow
 def test_int8_speculative_verify_parity(model):
     """Speculative verify + chunk writes over quantized pools: greedy
     accept-by-argmax is exact, so the int8 speculative engine must be
@@ -407,6 +408,7 @@ def test_pool_byte_gauges_and_statusz(model):
 
 
 # ======================================================== weights + calib
+@pytest.mark.slow
 def test_weight_int8_path_agreement():
     """weight_dtype="int8": the decoder Linears convert (in place,
     idempotently) to Int8Linear on the shared grid; the converted engine's

@@ -157,6 +157,7 @@ def test_verifier_rejection_sampling_marginals():
 
 
 # ======================================================== greedy parity
+@pytest.mark.slow
 def test_greedy_parity_with_and_without_repetition(model):
     """Speculative greedy ids are byte-identical to generate() and to the
     non-speculative engine — repetitive prompts (drafts fire constantly)
@@ -272,6 +273,7 @@ def test_rollback_after_fully_rejected_drafts(model):
 
 
 # ======================================================= acceptance rate
+@pytest.mark.slow
 def test_acceptance_rate_repetitive_vs_random(cyclic_model):
     """Metric sanity: a repetitive (cyclic) prompt on a model that learned
     the cycle accepts nearly all drafts; a random prompt accepts far
@@ -353,6 +355,7 @@ def test_spec_metrics_and_statusz(model):
 
 
 # ==================================================== prefill bucketing
+@pytest.mark.slow
 def test_prefill_bucketing_plateaus(model):
     """Long prompts (above _PREFILL_POW2_PAGES pages) bucket to
     power-of-two page counts: one compiled prefill program serves the

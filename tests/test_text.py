@@ -170,6 +170,7 @@ def test_bert_pretraining_tied_head_single_param():
     np.testing.assert_allclose(emb.numpy(), before - 0.1 * g, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_gpt_generate_jitted_cache_matches_eager():
     """KV-cache decode (fixed-shape donated buffers, one compiled step per
     token) produces IDENTICAL greedy tokens to the eager full-prefix loop."""
@@ -210,6 +211,7 @@ def _small_llama():
     return LlamaForCausalLM(cfg), cfg
 
 
+@pytest.mark.slow
 def test_llama_trains_and_generates():
     m, _ = _small_llama()
     ids = paddle.to_tensor(
@@ -344,6 +346,7 @@ def test_llama_no_biases_even_under_mp():
         topo.set_hybrid_communicate_group(None)
 
 
+@pytest.mark.slow
 def test_llama_jitted_cache_generate_matches_eager():
     """Static KV-cache decode (pre-rotated keys, donated buffers) produces
     IDENTICAL greedy tokens to the eager full-prefix loop, GQA included."""

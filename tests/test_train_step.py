@@ -126,6 +126,7 @@ def test_static_cache_unhashable_statics_hit():
     assert len(traces) == n_first, "equal unhashable statics must hit the cache"
 
 
+@pytest.mark.slow
 def test_train_step_bf16_native_model():
     """model.bfloat16() + f32 batches: convs compute in the weight dtype."""
     from paddle_tpu.vision.models import resnet18
