@@ -766,6 +766,255 @@ def _serving_cluster_report(replicas):
     return out
 
 
+def _zipf_prefix_workload(rs, n_requests, prefix_groups, shared_tokens,
+                          tail_len, zipf_s=1.2, oneoff_frac=0.2):
+    """Zipfian shared-prefix traffic: ``prefix_groups`` shared prefixes
+    with popularity ~ 1/rank^s (a few hot system prompts, a long tail),
+    each request a group prefix + fresh tail — the workload the radix
+    prefix index exists for.  ``oneoff_frac`` of the requests carry a
+    FRESH full-length prefix (one-off long-document queries): they evict
+    idle hot prefixes, so the next hot hit must resurrect from the spill
+    tier (or recompute, in the tiers below it)."""
+    ranks = np.arange(1, prefix_groups + 1, dtype="float64")
+    pz = 1.0 / ranks ** zipf_s
+    pz /= pz.sum()
+    shared = [rs.randint(1, 500, (shared_tokens,))
+              for _ in range(prefix_groups)]
+    groups = rs.choice(prefix_groups, size=n_requests, p=pz)
+    oneoff = rs.rand(n_requests) < oneoff_frac
+    prompts = []
+    for i, g in enumerate(groups):
+        if oneoff[i]:
+            prompts.append(rs.randint(
+                1, 500, (shared_tokens + tail_len,)).astype("int64"))
+        else:
+            prompts.append(np.concatenate(
+                [shared[g], rs.randint(1, 500, (tail_len,))])
+                .astype("int64"))
+    return shared, prompts
+
+
+def _measure_serving_prefix(arm="lru", n_requests=24, num_slots=4, S0=512,
+                            page_size=32, max_new=16, prefix_groups=4,
+                            num_pages=72, model_kwargs=None):
+    """ONE arm of the hierarchical-KV-cache comparison over Zipfian
+    shared-prefix traffic (README "Hierarchical KV cache"):
+
+    - ``lru``         — legacy exact-key sharing (``prefix_sharing=True``):
+      shares page MEMORY but always recomputes prefill from token 0;
+    - ``radix``       — ``prefix_cache="radix"``: partial prefix hits skip
+      prefill compute (``shared_pages * page_size`` tokens);
+    - ``radix_spill`` — radix + host-DRAM spill tier (``kv_spill=True``):
+      LRU-evicted prefix pages resurrect from host instead of recomputing.
+
+    All arms share num_pages (undersized: in-flight slots + every group's
+    idle prefix exceed the pool, so eviction pressure is real), the same
+    seeded workload, and return the full greedy ids so the parent asserts
+    byte-identity — partial reuse changes TTFT, never tokens."""
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models import GPTForCausalLM
+
+    paddle.seed(0)
+    kw = dict(vocab_size=512, hidden_size=256, num_hidden_layers=4,
+              num_attention_heads=4, max_position_embeddings=S0 + max_new)
+    kw.update(model_kwargs or {})
+    m = GPTForCausalLM(**kw).eval()
+    rs = np.random.RandomState(0)
+    shared_pages = S0 // page_size - 1           # one fresh tail page
+    tail_len = S0 - shared_pages * page_size
+    shared, prompts = _zipf_prefix_workload(
+        rs, n_requests, prefix_groups, shared_pages * page_size, tail_len)
+    max_len = S0 + max_new
+
+    engine_kw = {"lru": {"prefix_sharing": True},
+                 "radix": {"prefix_cache": "radix"},
+                 "radix_spill": {"prefix_cache": "radix",
+                                 "kv_spill": True}}[arm]
+    engine = ServingEngine(m, num_slots=num_slots, page_size=page_size,
+                           max_model_len=max_len, num_pages=num_pages,
+                           **engine_kw)
+    with engine:
+        # warm the full-prompt prefill + decode step
+        warm0 = rs.randint(1, 500, (S0,)).astype("int64")
+        engine.generate(warm0, max_new_tokens=4, timeout=900)
+        if arm != "lru":
+            # compile EVERY cached-tail bucket the measured phase can
+            # dispatch: evictions leave arbitrary residual match depths,
+            # and a cold chunk-program compile inside a measured TTFT
+            # would swamp the compute skip being measured.  Each warm
+            # prompt shares a progressively shorter prefix with warm0's
+            # resident run (descending, while the deep pages are still
+            # resident), so warm k dispatches a tail of S0 - k tokens.
+            for k in range(S0 - page_size, 0, -page_size):
+                wp = np.concatenate(
+                    [warm0[:k],
+                     rs.randint(1, 500, (S0 - k,))]).astype("int64")
+                engine.generate(wp, max_new_tokens=1, timeout=900)
+        # waves of num_slots with a drain between them: shared prefixes
+        # go IDLE at wave boundaries (in a single always-full batch some
+        # in-flight request pins the hot prefix forever), so the one-off
+        # flush traffic can evict them — the churn the spill tier's
+        # resurrection path exists for
+        t0 = time.time()
+        ids, handles = [], []
+        for w in range(0, len(prompts), num_slots):
+            wave = [engine.submit(p, max_new_tokens=max_new)
+                    for p in prompts[w:w + num_slots]]
+            handles += wave
+            ids += [h.result(timeout=900) for h in wave]
+        dt = time.time() - t0
+        stats = engine.stats()
+        mem = _bench_memory_section(engine)
+
+    pc = stats.get("prefix_cache") or {}
+    total = n_requests * max_new
+    # per-handle TTFTs (PR-16 decomposition): exactly the measured
+    # requests — the warm-up's compile-paying samples never enter
+    ttfts = sorted(h.ttft for h in handles)
+    return {
+        "arm": arm,
+        "n_requests": n_requests,
+        "num_pages": num_pages,
+        "tokens": total,
+        "tokens_per_sec": round(total / dt, 2),
+        "ttft_p50_s": round(ttfts[len(ttfts) // 2], 4),
+        "ttft_p95_s": round(ttfts[min(len(ttfts) - 1,
+                                      int(len(ttfts) * 0.95))], 4),
+        "prefix_cache": {k: pc.get(k) for k in
+                         ("hits", "misses", "evictions", "saved_tokens")},
+        "spill": pc.get("spill"),
+        "memory": mem,
+        "ids": [list(map(int, r)) for r in ids],
+    }
+
+
+def _measure_serving_prefix_cluster(prefix_match=True, replicas=2,
+                                    n_requests=16, num_slots=4, S0=48,
+                                    page_size=8, max_new=8,
+                                    prefix_groups=4, model_kwargs=None):
+    """ONE arm of the cross-replica prefix-placement comparison:
+    deepest-match routing (the router walks each prompt's page-boundary
+    digests against every replica's resident radix summary) vs pure
+    rendezvous.  ``affinity_tokens`` deliberately exceeds the shared
+    prefix, so the rendezvous key covers the FRESH tail and scatters a
+    group across replicas — consolidating it is exactly the new placement
+    policy's job, visible as cross-replica saved prefill tokens.
+    Sequential submission: each routed request lands (and its prefix
+    becomes resident/exported) before the next routes."""
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingCluster
+    from paddle_tpu.text.models import GPTForCausalLM
+
+    paddle.seed(0)
+    kw = dict(vocab_size=512, hidden_size=256, num_hidden_layers=4,
+              num_attention_heads=4, max_position_embeddings=S0 + max_new)
+    kw.update(model_kwargs or {})
+    m = GPTForCausalLM(**kw).eval()
+    rs = np.random.RandomState(0)
+    shared_pages = S0 // page_size - 1
+    tail_len = S0 - shared_pages * page_size
+    shared, prompts = _zipf_prefix_workload(
+        rs, n_requests, prefix_groups, shared_pages * page_size, tail_len)
+    max_len = S0 + max_new
+
+    cluster = ServingCluster(
+        m, replicas=replicas, policy="affinity",
+        devices="auto" if replicas > 1 else None,
+        affinity_tokens=S0, prefix_match=bool(prefix_match),
+        num_slots=num_slots, page_size=page_size, max_model_len=max_len,
+        prefix_cache="radix", saturation_queue=n_requests)
+    with cluster:
+        warm = rs.randint(1, 500, (S0,)).astype("int64")
+        for e in cluster.engines:
+            e.generate(warm, max_new_tokens=4, timeout=900)
+        t0 = time.time()
+        ids = [cluster.submit(p, max_new_tokens=max_new).result(timeout=900)
+               for p in prompts]
+        dt = time.time() - t0
+        per_replica = {}
+        for e in cluster.engines:
+            pc = e.stats().get("prefix_cache") or {}
+            per_replica[e.replica] = {
+                "saved_tokens": pc.get("saved_tokens", 0),
+                "hits": pc.get("hits", 0)}
+
+    total = n_requests * max_new
+    return {
+        "prefix_match": bool(prefix_match),
+        "replicas": replicas,
+        "tokens": total,
+        "tokens_per_sec": round(total / dt, 2),
+        "saved_tokens": sum(r["saved_tokens"]
+                            for r in per_replica.values()),
+        "per_replica": per_replica,
+        "ids": [list(map(int, r)) for r in ids],
+    }
+
+
+def _serving_prefix_report():
+    """Hierarchical-KV-cache bench (README "Hierarchical KV cache"):
+    three single-engine arms (separate subprocesses via _section) on the
+    same Zipfian shared-prefix workload, gated on the radix+spill arm
+    beating legacy LRU sharing on TTFT p50 AND tokens/sec with greedy
+    byte-identity across all three — plus the 2-replica placement arms
+    (deepest-match vs pure rendezvous) compared on cross-replica saved
+    prefill tokens."""
+    import os
+
+    lru = _section("serving_prefix", BENCH_PFX_ARM="lru")
+    radix = _section("serving_prefix", BENCH_PFX_ARM="radix")
+    spill = _section("serving_prefix", BENCH_PFX_ARM="radix_spill")
+    flags = (os.environ.get("XLA_FLAGS", "")
+             + " --xla_force_host_platform_device_count=2").strip()
+    deep = _section("serving_prefix_cluster", BENCH_PFX_MATCH="1",
+                    XLA_FLAGS=flags)
+    rdv = _section("serving_prefix_cluster", BENCH_PFX_MATCH="0",
+                   XLA_FLAGS=flags)
+    ident = [a == b == c for a, b, c in
+             zip(lru["ids"], radix["ids"], spill["ids"])]
+    cluster_ident = [a == b for a, b in zip(deep["ids"], rdv["ids"])]
+    out = {
+        # gated ratios (perf_baselines.json serving_prefix.*): radix+spill
+        # vs legacy LRU sharing; higher = better for both
+        "ttft_p50": round(lru["ttft_p50_s"]
+                          / max(spill["ttft_p50_s"], 1e-9), 3),
+        "tokens_per_sec": round(spill["tokens_per_sec"]
+                                / max(lru["tokens_per_sec"], 1e-9), 3),
+        "greedy_identical": 1.0 if all(ident) and all(cluster_ident)
+        else 0.0,
+        # raw per-arm numbers (ungated)
+        "lru_ttft_p50_s": lru["ttft_p50_s"],
+        "radix_ttft_p50_s": radix["ttft_p50_s"],
+        "radix_spill_ttft_p50_s": spill["ttft_p50_s"],
+        "lru_tokens_per_sec": lru["tokens_per_sec"],
+        "radix_tokens_per_sec": radix["tokens_per_sec"],
+        "radix_spill_tokens_per_sec": spill["tokens_per_sec"],
+        "radix_saved_tokens": radix["prefix_cache"]["saved_tokens"],
+        "radix_spill_saved_tokens": spill["prefix_cache"]["saved_tokens"],
+        "spill_stats": spill["spill"],
+        "cluster": {
+            "deepest_match_saved_tokens": deep["saved_tokens"],
+            "rendezvous_saved_tokens": rdv["saved_tokens"],
+            "saved_tokens_ratio": round(
+                deep["saved_tokens"] / max(rdv["saved_tokens"], 1), 3),
+            "deepest_match_tokens_per_sec": deep["tokens_per_sec"],
+            "rendezvous_tokens_per_sec": rdv["tokens_per_sec"],
+            "per_replica": deep["per_replica"],
+        },
+        "note": ("Zipfian shared-prefix traffic, undersized page pool; "
+                 "gates are radix+spill vs legacy-LRU ratios (TTFT p50, "
+                 "tokens/sec) with greedy byte-identity across every arm "
+                 "as the invariant; cluster arms compare deepest-match "
+                 "prefix placement vs pure rendezvous on saved tokens"),
+    }
+    return out
+
+
 def _measure_serving_mp(mp=1, n_requests=16, num_slots=4, S0=48,
                         page_size=16, max_new=64):
     """ONE arm of the tensor-parallel comparison (mp=1 is the unsharded
@@ -1543,6 +1792,16 @@ def _run_section(name):
             policy=os.environ.get("BENCH_ROUTE_POLICY", "affinity"),
             workload_replicas=int(os.environ.get("BENCH_FLEET", "0"))
             or None)
+    if name == "serving_prefix":
+        import os
+
+        return _measure_serving_prefix(
+            arm=os.environ.get("BENCH_PFX_ARM", "lru"))
+    if name == "serving_prefix_cluster":
+        import os
+
+        return _measure_serving_prefix_cluster(
+            prefix_match=os.environ.get("BENCH_PFX_MATCH", "1") == "1")
     if name == "serving_mp":
         import os
 
@@ -1892,6 +2151,12 @@ def main():
             # --speculative k: n-gram-draft + multi-token-verify engine vs
             # the non-speculative engine on a repetitive-suffix workload
             out = {"serving_speculative": _serving_speculative_report(spec_k)}
+        elif _argv_has("--prefix-cache"):
+            # --prefix-cache: hierarchical KV cache on Zipfian
+            # shared-prefix traffic — legacy LRU sharing vs radix vs
+            # radix + host spill (TTFT p50, tokens/sec, greedy identity)
+            # plus deepest-match vs rendezvous cross-replica placement
+            out = {"serving_prefix": _serving_prefix_report()}
         elif _argv_has("--mixed"):
             # --mixed: long-prompt admissions into a decode-heavy steady
             # state — chunked prefill (prefill_chunk_tokens) vs monolithic

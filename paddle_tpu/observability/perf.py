@@ -184,6 +184,17 @@ def mp_degree(family):
     return 1
 
 
+def is_cached_prefill_family(family):
+    """True for the prefix-cached prefill/encode families — the engine
+    attributes a dispatch that reused ``p`` resident radix pages as
+    ``prefill/<bucket>@cached<p>`` (``prefill/<bucket>@embed@cached<p>``
+    for passthrough encodes): the family rides the chunked-prefill
+    program shape but starts at the cached token offset, so its
+    device-time per prompt token is already the minimum the cache can
+    buy."""
+    return "@cached" in family
+
+
 def is_chunked_prefill_family(family):
     """True for the chunked-prefill ingestion families — the engine
     attributes them as ``prefill_chunk/<chunk_tokens>`` (plus the usual
@@ -205,7 +216,8 @@ def _multi_chip_host():
         return False
 
 
-def candidate_hint(family, regime, temp_bytes=None, pool_bytes=None):
+def candidate_hint(family, regime, temp_bytes=None, pool_bytes=None,
+                   prefix_stats=None):
     """The regime-driven recommendation :meth:`ProgramTable.report` prints
     for a top device-time program.  Recognizes the quantized serving
     families: a bandwidth-bound UNQUANTIZED serving program's first lever
@@ -220,7 +232,16 @@ def candidate_hint(family, regime, temp_bytes=None, pool_bytes=None):
     ``memory_analysis``, ``pool_bytes`` = the ledger's KV pool total):
     a prefill family whose peak scratch dwarfs the whole paged cache is
     capacity-bound before it is time-bound — the hint becomes 'chunk the
-    prefill', whatever the roofline regime says."""
+    prefill', whatever the roofline regime says.
+
+    Prefix-cache attribution (``prefix_stats`` = the registry's
+    ``serving.prefix_cache_*`` / ``serving.kv_spill_*`` totals): a plain
+    prefill family dominating device time while sharable pages mostly
+    MISS means the workload recomputes prefixes the radix index would
+    have kept resident — skipping the compute beats any bytes/flops
+    lever, so that hint wins; a spill tier resurrecting pages about as
+    fast as the cache hits is thrashing host<->device and wants a bigger
+    ``PADDLE_KV_SPILL_BUDGET_BYTES``."""
     quant = is_quantized_family(family)
     flash = is_flash_family(family)
     mp = is_mp_family(family)
@@ -242,6 +263,27 @@ def candidate_hint(family, regime, temp_bytes=None, pool_bytes=None):
                 "prompt through the chunked cache variant in N-token "
                 "slices so scratch stays O(chunk), and long prompts stop "
                 "spiking HBM at admission")
+    if prefix_stats:
+        hits = int(prefix_stats.get("hits") or 0)
+        misses = int(prefix_stats.get("misses") or 0)
+        res = int(prefix_stats.get("resurrections") or 0)
+        prefill_like = family.split("@")[0].startswith(
+            ("prefill/", "prefill_chunk/"))
+        if prefill_like and not is_cached_prefill_family(family) \
+                and misses >= 8 and misses > 4 * max(hits, 1):
+            return ("prefill dominates while sharable prefix pages miss "
+                    f"{misses}:{hits} against the cache: enable the radix "
+                    "prefix index (ServingEngine(prefix_cache=\"radix\")) "
+                    "— partial-prefix matches reuse the longest shared "
+                    "page run and prefill starts past the cached tokens, "
+                    "skipping that compute entirely")
+        if res >= 8 and res * 2 >= max(hits, 1):
+            return ("KV spill tier is thrashing: "
+                    f"{res} resurrections against {hits} cache hits "
+                    "means hot prefix pages keep falling to host and "
+                    "re-paging back — raise PADDLE_KV_SPILL_BUDGET_BYTES "
+                    "(or shrink the working set) so resident prefixes "
+                    "stay on-device")
     if regime == "bandwidth-bound":
         if is_lora_family(family):
             if quant:
@@ -547,12 +589,30 @@ class ProgramTable:
                 pool_bytes = _memory.ledger().kv_pool_bytes()
             except Exception:
                 pool_bytes = None
+            # prefix-cache workload evidence for the radix/spill hints
+            # (best-effort: zero everywhere -> no evidence -> no hint)
+            try:
+                from ..profiler import metrics as _pm
+
+                prefix_stats = {
+                    "hits": _pm.counter(
+                        "serving.prefix_cache_hits").total() or 0,
+                    "misses": _pm.counter(
+                        "serving.prefix_cache_misses").total() or 0,
+                    "resurrections": _pm.counter(
+                        "serving.kv_spill_resurrections").total() or 0,
+                }
+                if not any(prefix_stats.values()):
+                    prefix_stats = None
+            except Exception:
+                prefix_stats = None
             lines.append("")
             lines.append("Top kernel/fusion candidates (by device time):")
             for i, r in enumerate(cands, 1):
                 hint = candidate_hint(r["program"], r["regime"],
                                       temp_bytes=r.get("temp_bytes"),
-                                      pool_bytes=pool_bytes)
+                                      pool_bytes=pool_bytes,
+                                      prefix_stats=prefix_stats)
                 lines.append(f"  {i}. {r['program']} "
                              f"({r['device_seconds']:.3f}s over "
                              f"{r['calls']} calls) — {hint}")

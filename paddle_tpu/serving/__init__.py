@@ -6,6 +6,15 @@ cache (ROADMAP north star: "serves heavy traffic from millions of users").
   iteration, donated page pools, per-slot positions.
 - :mod:`.block_manager` — :class:`BlockManager`: vLLM-style paged KV block
   allocation, capacity-based admission control, optional prefix sharing.
+- :mod:`.prefix_index` — :class:`RadixPrefixIndex`: page-granular radix
+  tree over prompt ids (``ServingEngine(prefix_cache="radix")``) — partial
+  prefix matches reuse the longest shared page run and prefill starts
+  past the cached tokens (README "Hierarchical KV cache").
+- :mod:`.kv_spill` — :class:`KVSpillTier`: host-DRAM middle tier
+  (``kv_spill=True``): idle pages evicted by the radix index spill to
+  host buffers under ``PADDLE_KV_SPILL_BUDGET_BYTES`` and resurrect into
+  free device slots on the next prefix hit, accounted by the MemoryLedger
+  as ``kv.spilled``.
 - :mod:`.adapter` — model adapters (:class:`GPTAdapter`) reducing a causal
   LM to the prefill/step closures the engine compiles.
 - :mod:`.api` — :class:`ContinuousBatchingPredictor`, the
@@ -39,12 +48,15 @@ cache (ROADMAP north star: "serves heavy traffic from millions of users").
 Metrics (PR-1 registry, README "Serving"): ``serving.*`` histograms /
 gauges / counters — TTFT, inter-token latency, queue depth, slot
 occupancy, page-pool utilization, admission/preemption/trace counters,
-speculative proposal/acceptance, prefix-cache hit/miss/eviction.
+speculative proposal/acceptance, prefix-cache hit/miss/eviction/saved
+tokens, KV-spill pages/resurrections/drops/bytes.
 """
 
 from .adapter import GPTAdapter  # noqa: F401
 from .api import ContinuousBatchingPredictor  # noqa: F401
 from .block_manager import BlockManager, PageAllocation  # noqa: F401
+from .prefix_index import RadixPrefixIndex, prefix_digest  # noqa: F401
+from .kv_spill import KVSpillTier  # noqa: F401
 from .engine import (  # noqa: F401
     EngineStoppedError, Request, RequestHandle, RequestRejectedError,
     SamplingParams, ServingEngine,
@@ -69,6 +81,7 @@ from .qos import (  # noqa: F401
 __all__ = [
     "ServingEngine", "Request", "RequestHandle", "RequestRejectedError",
     "EngineStoppedError", "SamplingParams", "BlockManager", "PageAllocation",
+    "RadixPrefixIndex", "KVSpillTier", "prefix_digest",
     "GPTAdapter", "ContinuousBatchingPredictor", "NgramDrafter",
     "make_verifier", "ServingCluster", "ClusterHandle", "ReplicaPool",
     "PrefixAffinityRouter", "RouteDecision", "SLOPolicy",

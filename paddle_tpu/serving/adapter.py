@@ -235,6 +235,31 @@ class GPTAdapter:
                                 pos_ids, self.tag, lora=lora)
         return (x.astype(jnp.float32), w.astype(jnp.float32)) + pools
 
+    def encode_chunk(self, params, bufs, ids, *args):
+        """Prefix-cached embed/score forward: run ``ids [B, C]`` — the
+        UNSHARED tail of each prompt — at per-slot positions
+        ``lens[b]..lens[b]+C-1`` through the chunk cache variant, attending
+        the resident shared-run pages the table points at.  Because K/V at
+        position p is a pure function of tokens 0..p, hiddens for the tail
+        computed this way are byte-identical to a full-prompt
+        :meth:`encode`, which is what lets multi-tenant embed/score skip
+        recompute of a cached system prompt.  The tail's own K/V lands in
+        the table rows past the shared run — the caller points those at
+        the scratch page (tail < page_size means every lane gets a
+        DISTINCT in-page offset, so within-dispatch causality still
+        holds) or at transient pages for longer tails.
+
+        Returns ``(hidden [B, C, H] f32, w [V, H] f32, *pools)`` — the
+        :meth:`encode` contract over tail positions only."""
+        pools, table, lens, lora = self._split_extra(args)
+        C = ids.shape[1]
+        pos_ids = lens[:, None].astype(jnp.int64) \
+            + jnp.arange(C, dtype=jnp.int64)[None, :]
+        pos_ids = jnp.minimum(pos_ids, self.max_model_len - 1)
+        x, w, pools = self._run(params, bufs, ids, pools, table, lens,
+                                pos_ids, self.chunk_tag, lora=lora)
+        return (x.astype(jnp.float32), w.astype(jnp.float32)) + pools
+
     def step(self, params, bufs, last, *args):
         pools, table, lens, lora = self._split_extra(args)
         pos_ids = lens[:, None].astype(jnp.int64)

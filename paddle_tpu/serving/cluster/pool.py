@@ -240,6 +240,14 @@ class ReplicaPool:
         out = []
         for e in engines:
             hs = e.health_state()
+            # radix-index export for cross-replica prefix placement: the
+            # router matches an incoming prompt's page-boundary digests
+            # against each replica's resident set (None outside radix
+            # mode, or for non-engine stand-ins in tests)
+            try:
+                summ = e.prefix_index_summary()
+            except AttributeError:
+                summ = None
             out.append({
                 "replica": e.replica,
                 "state": hs["state"],
@@ -249,6 +257,7 @@ class ReplicaPool:
                 "queue_depth": len(e._queue),
                 "active": sum(1 for s in e._slots if s is not None),
                 "num_slots": e.num_slots,
+                "prefix_index": summ,
             })
         return out
 

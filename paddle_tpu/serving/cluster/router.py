@@ -17,6 +17,20 @@ stalled past its degraded threshold) falls back to the **least-loaded**
 routable replica, trading a prefix-cache hit for latency only when the
 affine replica could not serve promptly anyway.
 
+**Deepest-match placement** (the hierarchical-KV-cache tier of routing):
+when replicas run ``prefix_cache="radix"``, their state snapshots carry a
+radix-index summary — :func:`~paddle_tpu.serving.prefix_index
+.prefix_digest` of every resident page-boundary prefix.  The affinity
+policy digests the incoming prompt the same way and routes to the
+unsaturated replica with the DEEPEST matching resident run (most cached
+pages, i.e. most prefill compute skipped), falling back to rendezvous
+when no replica has any match — so cold prefixes still spread by the
+stable hash, and a prefix that went warm on a non-affine replica (e.g.
+after a saturation fallback) keeps landing where its pages actually
+live.  Equal-depth ties break by rendezvous score, keeping the choice
+stable per prefix.  ``prefix_match=False`` restores pure rendezvous
+(the bench's control arm).
+
 Control policies for benchmarking the affinity win (``bench.py --serving
 --replicas N``): ``random`` (seeded uniform over routable replicas) and
 ``round_robin`` and ``least_loaded``.  Every decision still records the
@@ -34,6 +48,8 @@ import dataclasses
 import hashlib
 import itertools
 import random
+
+from ..prefix_index import prefix_digest
 
 #: health states a replica may receive traffic in
 ROUTABLE_STATES = ("healthy", "degraded")
@@ -54,6 +70,10 @@ class RouteDecision:
     hit: bool
     reason: str
     policy: str
+    #: resident radix pages the chosen replica already holds for this
+    #: prompt (0 outside deepest-match routing) — the placement win in
+    #: pages, observable per decision
+    prefix_pages: int = 0
 
 
 def prefix_key(prompt_ids, affinity_tokens):
@@ -89,7 +109,7 @@ class PrefixAffinityRouter:
     """
 
     def __init__(self, n_replicas, affinity_tokens=16, policy="affinity",
-                 saturation_queue=None, seed=0):
+                 saturation_queue=None, seed=0, prefix_match=True):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         if policy not in POLICIES:
@@ -100,6 +120,7 @@ class PrefixAffinityRouter:
         self.policy = policy
         self.saturation_queue = None if saturation_queue is None \
             else int(saturation_queue)
+        self.prefix_match = bool(prefix_match)
         self._rng = random.Random(seed)
         self._rr = itertools.count()
 
@@ -142,6 +163,27 @@ class PrefixAffinityRouter:
             else max(1, int(st.get("num_slots", 1)))
         return st.get("queue_depth", 0) >= cap
 
+    def _match_depth(self, prompt_ids, st):
+        """Resident radix pages this replica already holds for the
+        prompt: walk the prompt's page-boundary digests against the
+        replica's exported summary until the first miss (a resident run
+        exports every boundary along its path, so matches are contiguous
+        from the root).  0 without a summary — non-radix replicas never
+        attract deepest-match traffic."""
+        summ = st.get("prefix_index") or {}
+        digests = summ.get("digests")
+        ps = int(summ.get("page_size") or 0)
+        if not digests or ps < 1:
+            return 0
+        dig = set(digests)
+        toks = [int(t) for t in list(prompt_ids)]
+        depth = 0
+        for k in range(1, len(toks) // ps + 1):
+            if prefix_digest(toks[:k * ps]) not in dig:
+                break
+            depth = k
+        return depth
+
     def _least_loaded(self, key, candidates, states):
         # rendezvous score as the tie-break so equal-load choices are
         # stable per prefix instead of always index 0
@@ -164,6 +206,7 @@ class PrefixAffinityRouter:
                     if st.get("state") in ROUTABLE_STATES]
         if not routable:
             return None
+        pages = 0
         if self.policy == "random":
             chosen = self._rng.choice(routable)
             reason = "random"
@@ -173,16 +216,37 @@ class PrefixAffinityRouter:
         elif self.policy == "least_loaded":
             chosen = self._least_loaded(key, routable, states)
             reason = "least_loaded"
-        elif affine in routable and not self._saturated(states[affine]):
-            chosen, reason = affine, "affinity"
         else:
-            # affine replica down or saturated: least-loaded fallback,
-            # preferring unsaturated replicas so a wedged replica's queue
-            # doesn't keep accreting
-            unsat = [i for i in routable if not self._saturated(states[i])]
-            chosen = self._least_loaded(key, unsat or routable, states)
-            reason = "fallback_unroutable" if affine not in routable \
-                else "fallback_saturated"
+            # affinity: deepest resident radix match first (adapter
+            # affinity keeps tenant keys on the rendezvous path — the
+            # LoRA pools, not the KV pages, are the scarce resource
+            # there), then the rendezvous winner, then fallback
+            chosen = None
+            if self.prefix_match and adapter is None:
+                unsat = [i for i in routable
+                         if not self._saturated(states[i])]
+                depths = {i: self._match_depth(prompt_ids, states[i])
+                          for i in unsat}
+                best = max(depths.values(), default=0)
+                if best > 0:
+                    chosen = max(
+                        (i for i in unsat if depths[i] == best),
+                        key=lambda i: self._score(key, i))
+                    reason, pages = "prefix_match", best
+            if chosen is None:
+                if affine in routable \
+                        and not self._saturated(states[affine]):
+                    chosen, reason = affine, "affinity"
+                else:
+                    # affine replica down or saturated: least-loaded
+                    # fallback, preferring unsaturated replicas so a
+                    # wedged replica's queue doesn't keep accreting
+                    unsat = [i for i in routable
+                             if not self._saturated(states[i])]
+                    chosen = self._least_loaded(key, unsat or routable,
+                                                states)
+                    reason = "fallback_unroutable" \
+                        if affine not in routable else "fallback_saturated"
         return RouteDecision(replica=chosen, affine=affine,
                              hit=chosen == affine, reason=reason,
-                             policy=self.policy)
+                             policy=self.policy, prefix_pages=pages)

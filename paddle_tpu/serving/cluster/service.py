@@ -112,13 +112,18 @@ class ServingCluster:
     traces.  Pass a prebuilt ``pool=`` / ``router=`` to override
     construction; ``policy`` picks the routing policy (``affinity``
     default, ``random`` / ``round_robin`` / ``least_loaded`` as
-    controls)."""
+    controls).  With ``prefix_cache="radix"`` replicas the affinity
+    policy routes to the replica holding the DEEPEST resident prefix
+    match (pool states carry each radix index's digest summary),
+    falling back to rendezvous for cold prefixes; ``prefix_match=False``
+    restores pure rendezvous placement."""
 
     def __init__(self, model=None, replicas=2, devices=None, pool=None,
                  router=None, policy="affinity", affinity_tokens=None,
-                 saturation_queue=None, seed=0, max_reroutes=None,
-                 poll_s=0.002, replica_prefix="", name=None, slo=None,
-                 qos=None, autoscale=None, **engine_kwargs):
+                 saturation_queue=None, seed=0, prefix_match=True,
+                 max_reroutes=None, poll_s=0.002, replica_prefix="",
+                 name=None, slo=None, qos=None, autoscale=None,
+                 **engine_kwargs):
         if pool is None:
             if model is None:
                 raise ValueError("need a model (or a prebuilt pool=)")
@@ -146,7 +151,8 @@ class ServingCluster:
                 affinity_tokens = 2 * pool.engines[0].page_size
             router = PrefixAffinityRouter(
                 n, affinity_tokens=affinity_tokens, policy=policy,
-                saturation_queue=saturation_queue, seed=seed)
+                saturation_queue=saturation_queue, seed=seed,
+                prefix_match=prefix_match)
         if router.n_replicas != n:
             raise ValueError(f"router built for {router.n_replicas} "
                              f"replicas, pool has {n}")

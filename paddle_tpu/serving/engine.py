@@ -375,7 +375,9 @@ class ServingEngine:
                  speculative_k=0, draft_max_ngram=3, draft_min_ngram=1,
                  replica="0", device=None, health_gating=True, slo=None,
                  kv_dtype=None, weight_dtype=None, numeric_guard=None,
-                 prefill_chunk_tokens=None, mesh=None, qos=None):
+                 prefill_chunk_tokens=None, mesh=None, qos=None,
+                 prefix_cache=None, kv_spill=False,
+                 kv_spill_budget_bytes=None):
         self._model = model
         # chunked prefill (README "Flash decode & chunked prefill"):
         # prompts longer than N tokens are admitted IMMEDIATELY and
@@ -492,7 +494,35 @@ class ServingEngine:
         if num_pages is None:
             num_pages = self.num_slots * self.table_width  # full residency
         self._num_pages = int(num_pages)
-        self._prefix_sharing = bool(prefix_sharing)
+        # hierarchical KV cache (README "Hierarchical KV cache"):
+        # prefix_cache="radix" swaps the BlockManager's exact-key prefix
+        # matching for the page-granular radix index (serving/
+        # prefix_index.py) — allocate reuses the LONGEST shared page run,
+        # and prefill starts past the cached tokens instead of
+        # recomputing the run; "lru" is an explicit alias for the legacy
+        # exact-key sharing (memory reuse, full recompute).  kv_spill=True
+        # adds the host-DRAM tier (serving/kv_spill.py): idle pages
+        # evicted off-device re-page on the next matching prefix instead
+        # of recomputing, bounded by PADDLE_KV_SPILL_BUDGET_BYTES (or the
+        # kv_spill_budget_bytes arg) and accounted to the ledger's
+        # kv.spilled host owner.
+        if prefix_cache not in (None, "lru", "radix"):
+            raise ValueError(f"prefix_cache must be None, 'lru' or "
+                             f"'radix', got {prefix_cache!r}")
+        self._prefix_cache = prefix_cache
+        self._radix = prefix_cache == "radix"
+        self._prefix_sharing = bool(prefix_sharing) \
+            or prefix_cache is not None
+        self._spill = None
+        if kv_spill:
+            if not self._radix:
+                raise ValueError(
+                    "kv_spill=True needs prefix_cache='radix': spilled "
+                    "pages are content-addressed through the radix index")
+            from .kv_spill import KVSpillTier
+
+            self._spill = KVSpillTier(replica=self.replica,
+                                      budget_bytes=kv_spill_budget_bytes)
         # HBM accounting (quantized serving satellite): every page costs
         # adapter.page_bytes() across all layers, K+V, scale pools
         # included — BlockManager carries it so capacity math, stats()
@@ -531,6 +561,11 @@ class ServingEngine:
             self._params = self._shard_tree(self._params)
             self._bufs = self._shard_tree(self._bufs)
             self._pools = self._shard_pools(self._pools)
+        if self._spill is not None:
+            # transport callables close over self: every spill/resurrect
+            # reads the CURRENT pool tuple, so donation rebinds and
+            # post-crash pool rebuilds need no re-attachment
+            self._spill.attach(self._spill_snapshot, self._spill_restore)
         from ..text.models._decode import (make_batched_sampler,
                                            make_guarded_batched_sampler)
 
@@ -863,6 +898,22 @@ class ServingEngine:
                 "model.weights_int8", _named_src("bufs", is_q),
                 replica=self.replica, meta={"kind": "weights_int8"}))
 
+        if self._spill is not None:
+            sref = weakref.ref(self._spill)
+
+            def _spill_src():
+                tier = sref()
+                return None if tier is None else tier.nbytes()
+
+            # host-DRAM tier: device="host" rows are bookkeeping only —
+            # outside the jax.live_arrays reconciliation, exactly like
+            # checkpoint.snapshot's pinned host buffers
+            self._mem_regs.append(led.register(
+                "kv.spilled", _spill_src, replica=self.replica,
+                device="host",
+                meta={"kind": "kv-spill",
+                      "budget_bytes": self._spill.budget_bytes}))
+
     # --------------------------------------------------------- mp sharding
     def _shard_tree(self, tree):
         """Commit a params/buffers dict to the mesh with each leaf's
@@ -893,7 +944,33 @@ class ServingEngine:
                             replica=self.replica,
                             bytes_per_page=self._bytes_per_page,
                             pool_dtype=self._pool_dtype,
-                            shards=self._mp)
+                            shards=self._mp,
+                            radix=self._radix, spill=self._spill)
+
+    # ------------------------------------------------- hierarchical KV cache
+    def _spill_snapshot(self, page):
+        """Device->host copy of ONE page row across EVERY pool array —
+        the KVSpillTier's snapshot callable.  Walking the whole tuple is
+        what keeps int8 payload+scale pairs together: the quantized
+        adapter's (kp, vp, ks, vs) all slice at the same page index."""
+        return tuple(np.asarray(p[:, page]) for p in self._pools)
+
+    def _spill_restore(self, page, payload):
+        """Host->device re-page of a resurrected entry into device slot
+        ``page``: one scatter per pool (eager ``.at[].set`` — a
+        device_put of the host bytes plus a copy that preserves the
+        pool's placement/sharding), rebinding the pool tuple like every
+        dispatch does."""
+        self._pools = tuple(
+            p.at[:, page].set(jnp.asarray(a, p.dtype))
+            for p, a in zip(self._pools, payload))
+
+    def prefix_index_summary(self):
+        """Resident-prefix digests for cross-replica placement (None
+        outside radix mode) — ReplicaPool folds this into router states
+        and stats() so the PrefixAffinityRouter can send a request to the
+        replica with the deepest matching resident run."""
+        return self._bm.index_summary()
 
     def _set_pool_gauges(self):
         self._m_kv_bytes_tok.set(self._bytes_per_page / self.page_size)
@@ -1908,7 +1985,12 @@ class ServingEngine:
         # fresh device state: the page pools were donated into the crashed
         # dispatch; re-admission prefills rewrite every sequence's K/V
         # (a quantized engine rebuilds int8 + scale pools the same way —
-        # the adapter owns the layout)
+        # the adapter owns the layout).  The host spill tier resets with
+        # it: spilled bytes would still be valid (K/V is deterministic in
+        # tokens + weights) but the rebuilt radix index starts empty, and
+        # a coherent cold cache beats a warm one that needs cross-checks.
+        if self._spill is not None:
+            self._spill.clear()
         self._bm = self._new_block_manager()
         self._pools = tuple(self._adapter.init_pools(self._num_pages + 1))
         if self._device is not None:
@@ -2214,6 +2296,14 @@ class ServingEngine:
         if req.handle.admitted_at is None:   # TTFT decomposition: queue_s
             req.handle.admitted_at = time.time()
         S0 = len(req.prompt)
+        # hierarchical KV cache: leading pages the radix index matched
+        # (or the spill tier resurrected) already hold byte-valid K/V —
+        # dispatch only the divergent tail, clamped so at least the last
+        # prompt position is computed (its logits seed the first token)
+        if alloc.cached_pages:
+            cached = min(alloc.cached_pages * self.page_size, S0 - 1)
+            if cached > 0:
+                return self._prefill_cached(req, alloc, slot_idx, cached)
         s_pad = self._prefill_bucket(S0)
         ids = np.zeros((1, s_pad), np.int64)
         ids[0, :S0] = req.prompt
@@ -2316,6 +2406,112 @@ class ServingEngine:
         self._emit_token(slot, tok)
         self._retire_if_done(slot_idx)
 
+    def _prefill_cached(self, req, alloc, slot_idx, cached):
+        """Partial-prefix prefill: the first ``cached`` prompt tokens are
+        covered by radix-matched / spill-resurrected pages whose K/V is
+        already byte-valid, so ONE chunk-variant dispatch runs just the
+        divergent tail at positions ``cached..S0-1`` (the chunk cache
+        machinery reused at a nonzero offset — a scheduler change, not a
+        program change) and its sampled token seeds decode exactly like a
+        monolithic prefill.  Greedy output stays byte-identical: K/V at a
+        position is a pure function of the token prefix and the weights,
+        so reading the cached run is the same bytes recompute would have
+        written.  Attributed to its own ``prefill/<b>@cached<p>`` perf
+        family so the roofline table separates tail-only dispatches from
+        full prefills."""
+        S0 = len(req.prompt)
+        tail = S0 - cached
+        C = self._prefill_bucket(tail)
+        ids = np.zeros((1, C), np.int64)
+        ids[0, :tail] = req.prompt[cached:]
+        table_row = np.asarray(alloc.pages, np.int32)
+        table = np.full((1, self.table_width), self._scratch, np.int32)
+        table[0, :len(table_row)] = table_row
+        lens = np.asarray([cached], np.int32)
+        nvalid = np.asarray([tail], np.int32)
+        temps = np.asarray([req.sampling.temperature], np.float32)
+        prog, traces = self._prefill_chunk_program(C)
+        n0 = traces[0]
+        rkey = self._next_key()
+        extra = self._prefill_extra(req)
+        guard = self._numeric_guard
+        gtail = (self._numeric_inject(1),) if guard else ()
+        fam = self._prefill_cached_family(C, alloc.cached_pages)
+        if _perf.needs_cost(fam):
+            _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
+                prog, (self._params, self._bufs, ids, nvalid, *self._pools,
+                       table, lens, temps, rkey, *extra, *gtail)))
+        win = _programs.ledger().compile_window(
+            self._prefill_chunk_store_key(C), family=fam,
+            replica=self.replica, device=self._device_label(),
+            store=self._store(), owner=self._model,
+            handles=(req.handle,), engine=self, cold=n0 == 0)
+        win.attach(prog, (self._params, self._bufs, ids, nvalid,
+                          *self._pools, table, lens, temps, rkey,
+                          *extra, *gtail))
+        t0 = time.perf_counter()
+        bad = nstats = None
+        try:
+            with _tracing.span("serving.prefill_cached",
+                               trace_id=req.handle.trace_id,
+                               request_id=req.handle.request_id,
+                               slot=slot_idx, prompt_len=S0,
+                               cached_tokens=cached):
+                if guard:
+                    tok, bad, nstats, *pools = prog(
+                        self._params, self._bufs, ids, nvalid,
+                        *self._pools, table, lens, temps, rkey,
+                        *extra, *gtail)
+                else:
+                    tok, *pools = prog(self._params, self._bufs, ids,
+                                       nvalid, *self._pools, table, lens,
+                                       temps, rkey, *extra)
+                self._pools = tuple(pools)
+                tok = int(np.asarray(tok)[0])
+        finally:
+            win.close(traced=traces[0] > n0)
+            self._progress_t = time.monotonic()
+        if traces[0] > n0:
+            self._m_prefill_traces.inc(traces[0] - n0)
+        elif traces[0]:
+            _perf.record(fam, time.perf_counter() - t0)
+        self._m_prefill_seconds.observe(time.perf_counter() - t0)
+        if guard:
+            _numerics.submit(f"serving/{self.replica}", ("logits",), nstats,
+                             step=self._iteration)
+            if bool(np.asarray(bad)[0]):
+                h = req.handle
+                h._error = NumericFault(
+                    "non-finite logits at prefill", site="logits",
+                    stream=f"serving/{self.replica}", step=self._iteration)
+                self._m_numeric_faults.inc()
+                self._bm.free(alloc)
+                self._release_tenant(req)
+                self._admitting = None
+                self._finish(h, "error")
+                return
+        slot = _Slot(req, alloc, table_row)
+        slot.idx = slot_idx
+        slot.last = tok
+        slot.produced = 1
+        req.handle.status = "running"
+        self._slots[slot_idx] = slot
+        self._admitting = None
+        i = slot_idx
+        self._h_table[i, :] = self._scratch
+        self._h_table[i, :len(table_row)] = table_row
+        self._h_lens[i] = slot.length
+        self._h_temps[i] = slot.temp
+        self._h_last[i, 0] = tok
+        self._on_admitted(slot, slot_idx)
+        if slot.temp > 0:
+            self._n_temp += 1
+        if self._drafter is not None:
+            self._drafter.register(i, req.prompt)
+            self._drafter.extend(i, [tok])
+        self._emit_token(slot, tok)
+        self._retire_if_done(slot_idx)
+
     # ------------------------------------------------- chunked prefill
     def _admit_chunked(self, req, alloc, slot_idx):
         """Admit a long prompt WITHOUT running its prefill: the slot goes
@@ -2329,7 +2525,13 @@ class ServingEngine:
             req.handle.admitted_at = time.time()
         slot = _Slot(req, alloc, table_row)
         slot.idx = slot_idx
-        slot.prefilled = 0
+        # hierarchical KV cache: ingestion starts PAST the cached shared
+        # run (chunked prefill already admits at arbitrary offsets — the
+        # radix hit just moves the starting offset); clamped so the final
+        # chunk computes at least the last prompt position, whose logits
+        # seed decode
+        slot.prefilled = min(alloc.cached_pages * self.page_size,
+                             max(len(req.prompt) - 1, 0))
         req.handle.status = "running"
         self._slots[slot_idx] = slot
         self._admitting = None
@@ -2507,6 +2709,15 @@ class ServingEngine:
 
     def _prefill_chunk_family(self, c):
         return f"prefill_chunk/{c}{self._fam_suffix}{self._mp_suffix}"
+
+    def _prefill_cached_family(self, c, cached_pages):
+        """Partial-prefix prefill attribution: the dispatch runs the
+        chunk program at width ``c`` but only because ``cached_pages``
+        leading pages were served from the hierarchical cache — a
+        different roofline (tail-only compute) than a full prefill, so
+        perf.is_cached_prefill_family can key hints on it."""
+        return (f"prefill/{c}@cached{cached_pages}"
+                f"{self._fam_suffix}{self._mp_suffix}")
 
     def _decode_family(self):
         return f"decode{self._flash_tag}{self._fam_suffix}{self._mp_suffix}"
@@ -3065,6 +3276,16 @@ class ServingEngine:
                 "accepted": self._spec_accepted_total,
                 "acceptance_rate": self.acceptance_rate,
             }
+        if self._prefix_sharing:
+            # hierarchical-cache surface: hit/saved-token accounting (hit
+            # TOKENS, not counts — the satellite fix) plus, in radix
+            # mode, the resident-prefix summary the cluster's
+            # deepest-match placement consumes via ReplicaPool.stats()
+            bm_stats = self._bm.stats()
+            st["prefix_cache"] = bm_stats.get("prefix_cache")
+            summ = self.prefix_index_summary()
+            if summ is not None:
+                st["prefix_index"] = summ
         return st
 
     def _statusz(self):

@@ -33,6 +33,7 @@ them.
 
 from __future__ import annotations
 
+import collections
 import functools
 import time
 
@@ -99,6 +100,13 @@ class MultiTenantEngine(ServingEngine):
             self._dev_allowed3 = jnp.ones(
                 (self.num_slots, self._spec_k + 1, self._vsize), jnp.bool_)
         self._tenant_live = {}       # adapter name -> live request count
+        # score-value memo for prefix-cached scoring: value[j] (the
+        # logprob of prompt[j+1] given prompt[:j+1]) is a pure function
+        # of prompt[:j+2], so entries up to a page boundary c are reusable
+        # by ANY prompt sharing those c tokens — keyed by the boundary
+        # prefix, populated at every boundary a score dispatch covers
+        self._score_memo = collections.OrderedDict()
+        self._score_memo_cap = 128
         self._m_tenant_req = _metrics.bind(_metrics.counter(
             "serving.tenant.requests",
             "submitted requests by tenant (adapter name, or 'base')"),
@@ -506,14 +514,77 @@ class MultiTenantEngine(ServingEngine):
 
         return self._program(key, build)
 
+    def _embed_chunk_program(self, c_pad, mode, pooling):
+        """Prefix-cached encode: :meth:`GPTAdapter.encode_chunk` over the
+        UNSHARED tail of an embed/score prompt, attending the resident
+        shared-run pages the table addresses.  ``nvalid`` carries the real
+        tail length (embed/last selects that lane in-program; score's
+        host-side slice uses it)."""
+        key = ("mt_encode_chunk", mode, pooling, c_pad, self.table_width,
+               self._pools[0].shape, str(self._pools[0].dtype),
+               self._mt_sig)
+        n = len(self._pools)
+
+        def build():
+            traces = [0]
+            adapter = self._adapter
+
+            @functools.partial(jax.jit,
+                               donate_argnums=tuple(range(4, 4 + n)))
+            def run(params, bufs, ids, nvalid, *rest):
+                traces[0] += 1
+                pools = rest[:n]
+                table, lens = rest[n:n + 2]
+                mt = rest[n + 2:]
+                x, w, *pools2 = adapter.encode_chunk(
+                    params, bufs, ids, *pools, table, lens, *mt)
+                if mode == "embed":     # pooling == "last" by construction
+                    idx = jnp.maximum(
+                        nvalid.astype(jnp.int32) - 1, 0)[:, None, None]
+                    out = jnp.take_along_axis(x, idx, axis=1)[:, 0]
+                else:                   # score: logprob of each tail token
+                    logits = x @ w.T    # given its full (cached) prefix
+                    lp = jax.nn.log_softmax(logits, -1)
+                    tgt = ids[:, 1:].astype(jnp.int32)
+                    out = jnp.take_along_axis(
+                        lp[:, :-1], tgt[..., None], axis=-1)[..., 0]
+                return (out,) + tuple(pools2)
+
+            return run, traces
+
+        return self._program(key, build)
+
     # --------------------------------------------------------- passthrough
     def _run_passthrough(self, req):
         """One embed/score request: a single prefill-family dispatch with
         every table row pointed at the scratch page — the BlockManager is
         never touched (asserted by the page-accounting test) and no
-        decode slot is occupied; the request retires immediately."""
+        decode slot is occupied; the request retires immediately.
+
+        Under ``prefix_cache="radix"``, embed (``pooling="last"``) and
+        score requests first pin the longest resident shared run
+        (``BlockManager.acquire_run``) and dispatch only the unshared
+        tail through :meth:`_embed_chunk_program` — a system-prompt-heavy
+        embed flood skips recomputing the cached pages entirely.  The
+        scratch-page invariant survives: the table addresses only the
+        refcounted shared run plus the scratch page (the sub-page tail's
+        K/V lands at distinct in-page scratch offsets), and the run is
+        released — parked idle, resident for the next sharer — the moment
+        the dispatch returns.  ``pooling="mean"`` stays on the monolithic
+        path: mean-pooling reduces over every position, so a cached run
+        saves nothing and the full dispatch keeps reduction-order parity
+        with the uncached engine."""
         h = req.handle
         S0 = len(req.prompt)
+        if self._radix and (req.mode == "score" or (
+                req.mode == "embed" and req.pooling == "last")):
+            run = self._bm.acquire_run(req.prompt)
+            if run is not None and run[0]:
+                pages, cached = run
+                try:
+                    return self._run_passthrough_cached(req, pages, cached)
+                finally:
+                    self._bm.release_run(req.prompt, len(pages))
         s_pad = self._prefill_bucket(S0)
         ids = np.zeros((1, s_pad), np.int64)
         ids[0, :S0] = req.prompt
@@ -549,6 +620,96 @@ class MultiTenantEngine(ServingEngine):
             h.value = val[0]                        # [H] f32
         else:
             h.value = [float(v) for v in val[0][:max(S0 - 1, 0)]]
+        self._release_tenant(req)
+        self._admitting = None
+        self._finish(h, "cancelled" if h.cancelled else "completed")
+
+    def _run_passthrough_cached(self, req, pages, cached):
+        """The prefix-cached half of :meth:`_run_passthrough`: dispatch
+        the tail from offset ``l0`` against the pinned run.
+
+        - embed/last: ``l0 = min(cached * ps, S0 - 1)`` — only the lanes
+          needed to reach the last real position are computed (at least
+          one, so a fully-covered prompt still recomputes its final
+          position against cached K/V).
+        - score: value entry j needs logits at position j, so a cached
+          boundary ``c`` alone cannot produce entry ``c - 1`` — the
+          dispatch starts at ``l0 = c' - 1`` where ``c'`` is the deepest
+          page boundary with a score-memo hit (entries ``[:c' - 1]`` come
+          from the memo; position ``c' - 1`` is recomputed against cached
+          K/V for its logits).  No memo hit means a full-tail dispatch
+          (``l0 = 0``) that self-warms both the memo and any freshly
+          registered run pages.
+
+        Fresh pages ``acquire_run`` registered start at ``cached * ps``
+        >= every possible ``l0``, so the dispatch's pool writes always
+        cover them with real K/V before the run is released."""
+        h = req.handle
+        S0 = len(req.prompt)
+        ps = self.page_size
+        prefix_vals = None
+        if req.mode == "score":
+            l0 = 0
+            for k in range(min(cached, S0 // ps), 0, -1):
+                mkey = tuple(int(t) for t in req.prompt[:k * ps])
+                got = self._score_memo.get(mkey)
+                if got is not None:
+                    self._score_memo.move_to_end(mkey)
+                    prefix_vals = list(got)
+                    l0 = k * ps - 1
+                    break
+        else:
+            l0 = min(cached * ps, S0 - 1)
+        tail = S0 - l0
+        c_pad = self._prefill_bucket(tail)
+        ids = np.zeros((1, c_pad), np.int64)
+        ids[0, :tail] = req.prompt[l0:]
+        table = np.full((1, self.table_width), self._scratch, np.int32)
+        table[0, :len(pages)] = pages
+        lens = np.asarray([l0], np.int32)
+        nvalid = np.asarray([tail], np.int32)
+        mt = self._mt_args(self._aid_row(req))
+        prog, traces = self._embed_chunk_program(c_pad, req.mode,
+                                                 req.pooling)
+        n0 = traces[0]
+        fam = (f"prefill/{c_pad}@{req.mode}@cached{cached}"
+               f"{self._fam_suffix}{self._lora_fam}")
+        if _perf.needs_cost(fam):
+            _perf.register_cost_thunk(fam, _perf.jit_cost_thunk(
+                prog, (self._params, self._bufs, ids, nvalid, *self._pools,
+                       table, lens, *mt)))
+        self._compiling = n0 == 0
+        t0 = time.perf_counter()
+        try:
+            with _tracing.span(f"serving.{req.mode}_cached",
+                               trace_id=h.trace_id,
+                               request_id=h.request_id, prompt_len=S0,
+                               cached_tokens=l0):
+                val, *pools = prog(self._params, self._bufs, ids, nvalid,
+                                   *self._pools, table, lens, *mt)
+                self._pools = tuple(pools)
+                val = np.asarray(val)
+        finally:
+            self._compiling = False
+            self._progress_t = time.monotonic()
+        if traces[0] > n0:
+            self._m_prefill_traces.inc(traces[0] - n0)
+        else:
+            _perf.record(fam, time.perf_counter() - t0)
+        self._m_prefill_seconds.observe(time.perf_counter() - t0)
+        if req.mode == "embed":
+            h.value = val[0]                    # [H] f32, last-position row
+        else:
+            vals = [float(v) for v in val[0][:max(tail - 1, 0)]]
+            if prefix_vals is not None:
+                vals = prefix_vals + vals       # memo covers [:l0]
+            h.value = vals
+            for k in range(1, S0 // ps + 1):    # warm every boundary
+                mkey = tuple(int(t) for t in req.prompt[:k * ps])
+                self._score_memo[mkey] = tuple(vals[:k * ps - 1])
+                self._score_memo.move_to_end(mkey)
+            while len(self._score_memo) > self._score_memo_cap:
+                self._score_memo.popitem(last=False)
         self._release_tenant(req)
         self._admitting = None
         self._finish(h, "cancelled" if h.cancelled else "completed")

@@ -25,6 +25,14 @@ inherited :meth:`GPTAdapter.prefill_chunk` unchanged: ``chunk_tag`` is
 and the engine's ``prefill_chunk/<c>@int8`` program family stays
 byte-identical to the monolithic int8 prefill.  On TPU the decode side of
 the same batch runs the int8 flash kernel (``decode@flash@int8``).
+
+The hierarchical KV cache (``prefix_cache="radix"`` + ``kv_spill=True``)
+needs no int8-specific code: the engine's spill snapshot/restore hooks
+walk the WHOLE pool tuple, so an evicted page's int8 payload rows and
+their float32 absmax scale rows spill to host DRAM — and resurrect into a
+device slot — together as one unit.  A re-paged page is byte-identical to
+the one evicted (payload and scales both round-trip losslessly), so
+partial-prefix reuse stays exact under quantized pools too.
 """
 
 from __future__ import annotations

@@ -1,0 +1,567 @@
+"""Hierarchical KV cache (ISSUE-20): radix prefix index, host-DRAM spill
+tier, cross-replica prefix placement.
+
+Tier structure under test — device pages -> host spill -> recompute:
+
+- radix index units: insert/match/split/evict ordering, content-address
+  keys, refcount safety under concurrent allocate/free;
+- partial-prefix reuse byte-parity vs cold prefill across plain, chunked,
+  int8 and speculative engines (K/V at position p is a pure function of
+  tokens 0..p, so reusing a shared page run never changes tokens);
+- spill -> resurrect byte-parity with the freed device slot POISONED, so
+  the test fails unless the re-paged host bytes actually win;
+- MemoryLedger reconciliation with the ``kv.spilled`` host owner live;
+- deepest-match routing with rendezvous fallback;
+- hit-TOKEN accounting (saved_tokens weights a 3-page hit 3x a 1-page
+  hit) on stats()//statusz/the metrics registry;
+- perf attribution: ``@cached<p>`` families and the radix/spill-budget
+  candidate hints.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import perf as obs_perf
+from paddle_tpu.profiler import metrics as prof_metrics
+from paddle_tpu.serving import BlockManager, KVSpillTier, ServingEngine
+from paddle_tpu.serving.cluster.router import PrefixAffinityRouter
+from paddle_tpu.serving.kv_spill import spill_budget_bytes
+from paddle_tpu.serving.prefix_index import RadixPrefixIndex, prefix_digest
+from paddle_tpu.text.models.gpt import GPTForCausalLM
+
+pytestmark = pytest.mark.pfx
+
+PS = 8
+MAXLEN = 64
+
+
+def _tiny_gpt(train_steps=5, seed=0):
+    import paddle_tpu.optimizer as opt
+
+    paddle.seed(seed)
+    m = GPTForCausalLM(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                       num_attention_heads=2, max_position_embeddings=MAXLEN)
+    if train_steps:
+        o = opt.AdamW(learning_rate=1e-2, parameters=m.parameters())
+        step = paddle.jit.TrainStep(m, o, loss_fn=None)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(1, 96, (8, 20)).astype("int64"))
+        for _ in range(train_steps):
+            step({"input_ids": ids, "labels": ids})
+    return m.eval()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_gpt()
+
+
+def _prompt(n, seed=1):
+    return np.random.RandomState(seed).randint(1, 96, (n,)).tolist()
+
+
+def _ref_tokens(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray([prompt], "int64"))
+    out = model.generate(ids, max_new_tokens=n, temperature=0.0,
+                         cache_impl="paged", page_size=PS,
+                         max_len=len(prompt) + n)
+    return [int(t) for t in out.numpy()[0, len(prompt):]]
+
+
+def _settle(bm, free0, timeout=5.0):
+    """Wait for the scheduler thread to finish post-result releases."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if bm.free_pages == free0 and bm.used_pages == 0:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ========================================================= radix index units
+def _blocks(index, toks):
+    return index.blocks_of(toks, len(toks) // index.page_size)
+
+
+def test_radix_insert_match_acquire_release():
+    ix = RadixPrefixIndex(page_size=4)
+    a = list(range(100, 112))                       # 3 blocks
+    blocks = _blocks(ix, a)
+    pages, reactivated, tip = ix.acquire(blocks)
+    assert pages == [] and reactivated == 0 and tip is ix._root
+    ix.insert(tip, blocks, [0, 1, 2])
+    assert ix.resident_pages == 3 and ix.idle_pages == 0
+    # exact re-acquire pins the whole run
+    p2, _, tip2 = ix.acquire(blocks)
+    assert p2 == [0, 1, 2]
+    depth, idle = ix.match_depth(a, 3)
+    assert depth == 3 and idle == 0                 # refs > 0: not idle
+    ix.release(blocks)
+    ix.release(blocks)
+    assert ix.idle_pages == 3
+    depth, idle = ix.match_depth(a, 3)
+    assert depth == 3 and idle == 3
+
+
+def test_radix_partial_match_splits_at_page_boundary():
+    ix = RadixPrefixIndex(page_size=4)
+    a = list(range(100, 112))                       # blocks A0 A1 A2
+    ba = _blocks(ix, a)
+    _, _, tip = ix.acquire(ba)
+    ix.insert(tip, ba, [0, 1, 2])
+    # b shares blocks A0 A1, diverges in block 2
+    b = a[:8] + [7, 7, 7, 7]
+    bb = _blocks(ix, b)
+    pages, _, tip = ix.acquire(bb)
+    assert pages == [0, 1]                          # longest shared run
+    assert ix.stats()["splits"] == 1                # [A0 A1 A2] -> [A0 A1]+[A2]
+    ix.insert(tip, bb[2:], [3])
+    assert ix.resident_pages == 4
+    # the shared half now carries refs from b; A2's suffix node is
+    # released-by-construction (a's release path still covers it)
+    ix.release(ba)
+    ix.release(bb)
+    assert ix.idle_pages == 4
+    # full matches still resolve across the split nodes
+    assert ix.match_depth(a, 3)[0] == 3
+    assert ix.match_depth(b, 3)[0] == 3
+
+
+def test_radix_evict_deepest_tail_first_with_content_keys():
+    ix = RadixPrefixIndex(page_size=2)
+    a = [1, 2, 3, 4, 5, 6]                          # 3 blocks
+    ba = _blocks(ix, a)
+    _, _, tip = ix.acquire(ba)
+    ix.insert(tip, ba, [10, 11, 12])
+    ix.release(ba)
+    # tail-first: deepest page out first, keyed by its FULL token prefix
+    key, page = ix.evict_one()
+    assert page == 12 and key == (1, 2, 3, 4, 5, 6)
+    key, page = ix.evict_one()
+    assert page == 11 and key == (1, 2, 3, 4)
+    key, page = ix.evict_one()
+    assert page == 10 and key == (1, 2)
+    assert ix.evict_one() is None
+    assert ix.resident_pages == 0 and ix.idle_pages == 0
+
+
+def test_radix_eviction_never_orphans_an_interior_page():
+    ix = RadixPrefixIndex(page_size=2)
+    a = [1, 2, 3, 4]
+    b = [1, 2, 9, 9]
+    _, _, tip = ix.acquire(_blocks(ix, a))
+    ix.insert(tip, _blocks(ix, a), [0, 1])
+    pages, _, tip = ix.acquire(_blocks(ix, b))
+    assert pages == [0]
+    ix.insert(tip, _blocks(ix, b)[1:], [2])
+    ix.release(_blocks(ix, a))
+    ix.release(_blocks(ix, b))
+    # evict everything; at no point may a page be reclaimed while a
+    # DESCENDANT page is still resident (prefix contiguity)
+    resident = {0: {1, 2}, 1: set(), 2: set()}      # page -> descendants
+    alive = {0, 1, 2}
+    while True:
+        ev = ix.evict_one()
+        if ev is None:
+            break
+        _, page = ev
+        assert not (resident[page] & alive), \
+            f"page {page} evicted before its descendants"
+        alive.discard(page)
+    assert not alive
+
+
+def test_radix_summary_digests_every_boundary():
+    ix = RadixPrefixIndex(page_size=4)
+    a = list(range(50, 62))
+    ba = _blocks(ix, a)
+    _, _, tip = ix.acquire(ba)
+    ix.insert(tip, ba, [0, 1, 2])
+    summ = ix.summary()
+    assert summ["page_size"] == 4 and summ["resident_pages"] == 3
+    for k in (1, 2, 3):
+        assert prefix_digest(a[:k * 4]) in summ["digests"]
+    # cache invalidates on structural change
+    ix.release(ba)
+    ix.evict_one()
+    assert prefix_digest(a) not in ix.summary()["digests"]
+
+
+def test_radix_release_of_unregistered_prefix_raises():
+    ix = RadixPrefixIndex(page_size=2)
+    a = [1, 2, 3, 4]
+    _, _, tip = ix.acquire(_blocks(ix, a))
+    ix.insert(tip, _blocks(ix, a), [0, 1])
+    with pytest.raises(KeyError):
+        ix.release(_blocks(ix, [9, 9, 9, 9]))
+
+
+# ============================================== allocator + concurrency
+def test_block_manager_radix_partial_prefix_allocation():
+    bm = BlockManager(num_pages=16, page_size=4, radix=True)
+    a = list(range(100, 116))                       # 4 pages, all sharable
+    al1 = bm.allocate(a, len(a) + 4)
+    assert al1 is not None and al1.cached_pages == 0
+    bm.free(al1)                                    # run parks idle
+    # same prefix, divergent tail: longest shared run reused
+    b = a[:12] + [7, 7, 7, 7]
+    al2 = bm.allocate(b, len(b) + 4)
+    assert al2.cached_pages == 3                    # 3 shared pages valid
+    st = bm.stats()["prefix_cache"]
+    assert st["mode"] == "radix"
+    assert st["saved_tokens"] == 3 * 4              # hit TOKENS, not hits
+    bm.free(al2)
+    assert bm.used_pages == 0
+
+
+def test_block_manager_radix_concurrent_allocate_free():
+    bm = BlockManager(num_pages=48, page_size=4, radix=True)
+    shared = _prompt(16, seed=3)
+    errs = []
+
+    def worker(seed):
+        rng = np.random.RandomState(seed)
+        try:
+            for _ in range(60):
+                tail = rng.randint(1, 96, rng.randint(1, 9)).tolist()
+                p = shared[:int(rng.choice([0, 4, 8, 12, 16]))] + tail
+                alloc = bm.allocate(p, len(p) + 4)
+                if alloc is None:
+                    continue
+                assert len(set(alloc.pages)) == len(alloc.pages)
+                time.sleep(0.0005)
+                bm.free(alloc)
+        except Exception as e:                      # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    # every allocation returned: nothing pinned, accounting balanced
+    assert bm.used_pages == 0
+    assert bm.free_pages == 48
+    ix = bm._index
+    assert ix.resident_pages == ix.idle_pages
+    # the pool is still fully allocatable
+    big = bm.allocate(_prompt(40, seed=99), 44 + 4 * 12)
+    assert big is not None
+    bm.free(big)
+
+
+def test_spill_tier_budget_lru_and_pair_atomicity():
+    tier = KVSpillTier(replica="t", budget_bytes=3 * 256)
+    store = {}
+
+    def snap(page):
+        # payload + scale pairs travel as ONE tuple (the int8 contract)
+        return (np.full((16,), page, np.int8),
+                np.full((60,), page, np.float32))
+
+    def restore(page, payload):
+        store[page] = payload
+
+    tier.attach(snap, restore)
+    assert spill_budget_bytes(123) == 123
+    for k in range(4):                              # 256 B each, budget 3
+        assert tier.spill((k,), k)
+    assert len(tier) == 3 and tier.stats()["drops"] == 1
+    assert not tier.contains((0,))                  # LRU-dropped
+    assert tier.resurrect((2,), 9)
+    pay = store[9]
+    assert pay[0].dtype == np.int8 and pay[1].dtype == np.float32
+    assert int(pay[0][0]) == 2 and float(pay[1][0]) == 2.0
+    assert not tier.resurrect((2,), 9)              # single-shot
+    assert tier.nbytes() == 256 * len(tier)
+
+
+# ====================================== engine byte-parity (device tier)
+def test_partial_prefix_reuse_byte_parity_plain_and_chunked(model):
+    shared = _prompt(24, seed=42)                   # 3 pages
+    prompts = [shared + _prompt(6, seed=s) for s in (1, 2, 3)]
+    for kw in (dict(), dict(prefill_chunk_tokens=16)):
+        eng = ServingEngine(model, num_slots=2, page_size=PS,
+                            max_model_len=MAXLEN, num_pages=14,
+                            prefix_cache="radix", **kw)
+        with eng:
+            outs = [list(eng.submit(p, max_new_tokens=6, temperature=0.0)
+                         .result(timeout=600)) for p in prompts]
+        for p, out in zip(prompts, outs):
+            assert out == _ref_tokens(model, p, 6)
+        st = eng.stats()["prefix_cache"]
+        assert st["hits"] >= 6                      # prompts 2+3 reuse 3 pages
+        assert st["saved_tokens"] >= 6 * PS
+
+
+@pytest.mark.slow
+def test_partial_prefix_reuse_byte_parity_int8_and_speculative(model):
+    """Cached-path outputs == the same config's cold outputs (a separate
+    non-radix engine), for quantized pools and draft-and-verify."""
+    shared = _prompt(24, seed=42)
+    prompts = [shared + _prompt(6, seed=s) for s in (1, 2)]
+    for kw in (dict(kv_dtype="int8"), dict(speculative_k=3)):
+        cold, warm = [], []
+        for radix in (False, True):
+            eng = ServingEngine(model, num_slots=2, page_size=PS,
+                                max_model_len=MAXLEN, num_pages=14,
+                                prefix_cache="radix" if radix else None,
+                                **kw)
+            with eng:
+                dst = warm if radix else cold
+                for p in prompts:
+                    dst.append(list(
+                        eng.submit(p, max_new_tokens=6, temperature=0.0)
+                        .result(timeout=600)))
+            if radix:
+                assert eng.stats()["prefix_cache"]["hits"] >= 3
+        assert warm == cold, f"cached decode diverged under {kw}"
+
+
+# ===================================== spill -> resurrect (host tier)
+def test_spill_resurrect_byte_parity_with_poisoned_slots(model):
+    """Evict a shared run to the host tier, POISON every free device
+    slot, then re-request the prefix: only the re-paged host bytes can
+    produce the reference tokens."""
+    import jax.numpy as jnp
+
+    shared = _prompt(16, seed=42)                   # 2 pages
+    pA = shared + _prompt(6, seed=1)
+    pB = _prompt(40, seed=9)                        # disjoint, 5 pages
+    pA2 = shared + _prompt(6, seed=3)
+    eng = ServingEngine(model, num_slots=1, page_size=PS,
+                        max_model_len=MAXLEN, num_pages=6,
+                        prefix_cache="radix", kv_spill=True)
+    with eng:
+        bm = eng.block_manager
+        free0 = bm.free_pages
+        assert list(eng.submit(pA, max_new_tokens=6, temperature=0.0)
+                    .result(timeout=600)) == _ref_tokens(model, pA, 6)
+        # B needs 5 of 6 pages: A's idle run must spill
+        assert list(eng.submit(pB, max_new_tokens=6, temperature=0.0)
+                    .result(timeout=600)) == _ref_tokens(model, pB, 6)
+        assert _settle(bm, free0)
+        st = eng.stats()["prefix_cache"]
+        assert st["spill"]["spills"] >= 2
+        # poison EVERY free-list slot so stale bytes cannot pass
+        pools = eng._pools
+        for page in list(bm._free):
+            pools = tuple(
+                p.at[:, page].set(jnp.full((), 99, p.dtype)) for p in pools)
+        eng._pools = pools
+        out = list(eng.submit(pA2, max_new_tokens=6, temperature=0.0)
+                   .result(timeout=600))
+        st = eng.stats()["prefix_cache"]
+        assert st["resurrections"] >= 1
+        assert out == _ref_tokens(model, pA2, 6)
+
+
+def test_spill_ledger_reconciliation_and_recover_clears(model):
+    """The kv.spilled host owner tracks the tier's bytes, stays out of
+    the device-side reconciled total, and a chaos recovery cold-starts
+    the tier with the rebuilt BlockManager."""
+    from paddle_tpu.observability.memory import ledger
+
+    shared = _prompt(16, seed=42)
+    eng = ServingEngine(model, num_slots=1, page_size=PS,
+                        max_model_len=MAXLEN, num_pages=6,
+                        prefix_cache="radix", kv_spill=True,
+                        replica="pfx-led")
+    with eng:
+        bm = eng.block_manager
+        free0 = bm.free_pages
+        eng.submit(shared + _prompt(6, 1), max_new_tokens=6,
+                   temperature=0.0).result(timeout=600)
+        eng.submit(_prompt(40, 9), max_new_tokens=6,
+                   temperature=0.0).result(timeout=600)
+        assert _settle(bm, free0)
+        tier = eng._spill
+        assert tier.nbytes() > 0
+        rep = ledger().report()
+        rows = [r for r in rep["owners"] if r["owner"] == "kv.spilled"
+                and r["replica"] == "pfx-led"]
+        assert len(rows) == 1
+        assert rows[0]["device"] == "host"
+        assert rows[0]["bytes"] == tier.nbytes()
+        assert rows[0]["meta"]["budget_bytes"] == tier.budget_bytes
+        # host rows are excluded from the jax.live_arrays reconciliation
+        assert rep["tracked_bytes"] >= 0
+        eng._recover(RuntimeError("chaos"))
+        assert tier.nbytes() == 0 and len(tier) == 0
+
+
+# =============================================== hit-token accounting
+def test_saved_tokens_statusz_and_registry(model):
+    saved = prof_metrics.counter("serving.prefix_cache_saved_tokens")
+    eng = ServingEngine(model, num_slots=2, page_size=PS,
+                        max_model_len=MAXLEN, num_pages=14,
+                        prefix_cache="radix", replica="pfx-st")
+    shared = _prompt(24, seed=42)
+    base = saved.get(replica="pfx-st") or 0
+    with eng:
+        eng.submit(shared + _prompt(5, 1), max_new_tokens=4,
+                   temperature=0.0).result(timeout=600)
+        eng.submit(shared + _prompt(5, 2), max_new_tokens=4,
+                   temperature=0.0).result(timeout=600)
+        sz = eng._statusz()
+    pc = sz["kv_cache"]["prefix_cache"]
+    assert pc["saved_tokens"] == 3 * PS             # 3 pages x 8 tokens
+    assert pc["hits"] == 3
+    assert (saved.get(replica="pfx-st") or 0) - base == 3 * PS
+    assert sz["kv_cache"]["prefix_cache"]["mode"] == "radix"
+
+
+# ========================================== passthrough (embed/score)
+def test_multitenant_embed_score_prefix_reuse(model):
+    """A cached shared run feeds embed/score dispatches: values match
+    the monolithic (uncached) path, the scratch invariant holds (zero
+    pages pinned beyond the released shared run), and reuse is counted
+    in saved tokens."""
+    from paddle_tpu.serving.multitenant import MultiTenantEngine
+
+    shared = _prompt(24, seed=42)
+    pA = shared + _prompt(5, 1)
+    pB = shared + _prompt(5, 2)
+    ref = MultiTenantEngine(model, num_slots=2, page_size=PS,
+                            max_model_len=MAXLEN, num_pages=14)
+    with ref:
+        r_last = np.asarray(ref.submit(
+            pA, mode="embed", pooling="last").result(timeout=600))
+        r_mean = np.asarray(ref.submit(
+            pA, mode="embed").result(timeout=600))
+        r_scA = ref.submit(pA, mode="score").result(timeout=600)
+        r_scB = ref.submit(pB, mode="score").result(timeout=600)
+    eng = MultiTenantEngine(model, num_slots=2, page_size=PS,
+                            max_model_len=MAXLEN, num_pages=14,
+                            prefix_cache="radix")
+    with eng:
+        bm = eng.block_manager
+        free0 = bm.free_pages
+        scA = eng.submit(pA, mode="score").result(timeout=600)
+        last = np.asarray(eng.submit(
+            pA, mode="embed", pooling="last").result(timeout=600))
+        scB = eng.submit(pB, mode="score").result(timeout=600)
+        mean = np.asarray(eng.submit(pA, mode="embed").result(timeout=600))
+        # runs are released the moment each dispatch retires: no page
+        # stays pinned by a passthrough row
+        assert _settle(bm, free0)
+        st = eng.stats()["prefix_cache"]
+    assert np.allclose(last, r_last, atol=1e-4)
+    assert np.allclose(mean, r_mean, atol=1e-4)     # mean: monolithic path
+    assert len(scA) == len(pA) - 1
+    assert np.allclose(scA, r_scA, atol=1e-4)
+    assert np.allclose(scB, r_scB, atol=1e-4)       # stitched via memo
+    assert st["hits"] >= 3 and st["saved_tokens"] >= 3 * PS
+
+
+# ====================================== cross-replica prefix placement
+def _router_state(**kw):
+    st = {"state": "healthy", "stalled": False, "queue_depth": 0,
+          "active": 0, "num_slots": 4, "prefix_index": None}
+    st.update(kw)
+    return st
+
+
+def _summary_for(tokens, depth, page_size=PS):
+    return {"page_size": page_size, "resident_pages": depth,
+            "digests": [prefix_digest(tokens[:k * page_size])
+                        for k in range(1, depth + 1)]}
+
+
+def test_router_deepest_match_beats_rendezvous_with_fallback():
+    shared = _prompt(24, seed=42)                   # 3 pages
+    prompt = shared + _prompt(5, 7)
+    r = PrefixAffinityRouter(3, affinity_tokens=2 * PS)
+    states = [_router_state(),
+              _router_state(prefix_index=_summary_for(shared, 2)),
+              _router_state(prefix_index=_summary_for(shared, 3))]
+    d = r.route(prompt, states)
+    assert (d.replica, d.reason, d.prefix_pages) == (2, "prefix_match", 3)
+    # saturated deepest replica: next-deepest wins
+    states[2]["queue_depth"] = 99
+    d = r.route(prompt, states)
+    assert (d.replica, d.prefix_pages) == (1, 2)
+    # cold prefix (no resident match anywhere): rendezvous fallback —
+    # same winner the pure-rendezvous router picks
+    cold = _prompt(29, seed=5)
+    pure = PrefixAffinityRouter(3, affinity_tokens=2 * PS,
+                                prefix_match=False)
+    d = r.route(cold, [_router_state() for _ in range(3)])
+    d0 = pure.route(cold, [_router_state() for _ in range(3)])
+    assert d.reason in ("affinity", "fallback_saturated")
+    assert d.replica == d0.replica
+    # adapter traffic keeps tenant affinity (never prefix_match)
+    d = r.route(prompt, states, adapter="t0")
+    assert d.reason != "prefix_match"
+    # prefix_match=False ignores summaries entirely
+    states[2]["queue_depth"] = 0
+    assert pure.route(prompt, states).reason != "prefix_match"
+
+
+def test_pool_states_export_radix_summaries(model):
+    from paddle_tpu.serving.cluster import ReplicaPool
+
+    shared = _prompt(16, seed=42)
+    pool = ReplicaPool(model, replicas=2, num_slots=1, page_size=PS,
+                       max_model_len=MAXLEN, num_pages=8,
+                       prefix_cache="radix", replica_prefix="pfxpool")
+    with pool:
+        pool.engines[0].submit(shared + _prompt(4, 1), max_new_tokens=2,
+                               temperature=0.0).result(timeout=600)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            states = pool.states()
+            if states[0]["prefix_index"] \
+                    and states[0]["prefix_index"]["digests"]:
+                break
+            time.sleep(0.05)
+    assert states[0]["prefix_index"]["page_size"] == PS
+    assert prefix_digest(shared[:PS]) in states[0]["prefix_index"]["digests"]
+    assert states[1]["prefix_index"]["digests"] == []
+
+
+# ==================================================== perf attribution
+def test_cached_prefill_family_and_hints():
+    assert obs_perf.is_cached_prefill_family("prefill/32@cached3")
+    assert obs_perf.is_cached_prefill_family("prefill/16@embed@cached2")
+    assert not obs_perf.is_cached_prefill_family("prefill/32")
+    # unshared-heavy prefill -> enable the radix index
+    hint = obs_perf.candidate_hint(
+        "prefill/64", "bandwidth-bound",
+        prefix_stats={"hits": 1, "misses": 120, "resurrections": 0})
+    assert 'prefix_cache="radix"' in hint
+    # a cached family never gets told to enable what it already runs
+    hint = obs_perf.candidate_hint(
+        "prefill/64@cached3", "bandwidth-bound",
+        prefix_stats={"hits": 1, "misses": 120, "resurrections": 0})
+    assert 'prefix_cache="radix"' not in hint
+    # spill thrash -> raise the host budget
+    hint = obs_perf.candidate_hint(
+        "decode", "bandwidth-bound",
+        prefix_stats={"hits": 20, "misses": 4, "resurrections": 18})
+    assert "PADDLE_KV_SPILL_BUDGET_BYTES" in hint
+    # healthy cache: the regime hint is untouched
+    hint = obs_perf.candidate_hint(
+        "prefill/64", "bandwidth-bound",
+        prefix_stats={"hits": 500, "misses": 10, "resurrections": 0})
+    assert "radix" not in hint
+
+
+def test_engine_attributes_cached_prefill_family(model):
+    shared = _prompt(24, seed=42)
+    eng = ServingEngine(model, num_slots=2, page_size=PS,
+                        max_model_len=MAXLEN, num_pages=14,
+                        prefix_cache="radix")
+    with eng:
+        eng.submit(shared + _prompt(5, 1), max_new_tokens=3,
+                   temperature=0.0).result(timeout=600)
+        eng.submit(shared + _prompt(5, 2), max_new_tokens=3,
+                   temperature=0.0).result(timeout=600)
+    snap = obs_perf.table().snapshot()
+    fams = [r["program"] for r in snap]
+    assert any(obs_perf.is_cached_prefill_family(f) for f in fams), fams
